@@ -1,0 +1,20 @@
+"""PaddleFleetX-TPU: a TPU-native large-model training framework.
+
+A from-scratch re-design of the capabilities of PaddleFleetX
+(reference: ceci3/PaddleFleetX) for TPU hardware, built on JAX / XLA /
+pjit / Pallas. One unified engine expresses DP / TP(MP) / SP / ZeRO
+(FSDP) / PP over a single ``jax.sharding.Mesh``; compute runs in
+bfloat16 on the MXU with fp32 master weights; collectives are emitted
+by GSPMD from sharding annotations instead of hand-written NCCL calls.
+
+Layer map (mirrors reference SURVEY.md section 1):
+  - ``paddlefleetx_tpu.utils``    config / logging / env     (L4c)
+  - ``paddlefleetx_tpu.parallel`` mesh + sharding + pipeline (L0)
+  - ``paddlefleetx_tpu.core``     engine + module contract   (L1/L2)
+  - ``paddlefleetx_tpu.models``   GPT / ERNIE / ViT / Imagen (L3)
+  - ``paddlefleetx_tpu.data``     datasets / samplers / tokenizers (L4a)
+  - ``paddlefleetx_tpu.optims``   optimizers / LR schedules  (L4b)
+  - ``paddlefleetx_tpu.ops``      Pallas kernels + fused ops
+"""
+
+__version__ = "0.1.0"

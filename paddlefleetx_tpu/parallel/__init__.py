@@ -1,0 +1,9 @@
+from .mesh import (  # noqa: F401
+    DP_AXIS, FSDP_AXIS, MP_AXIS, PP_AXIS, DATA_AXES,
+    TopologyConfig, build_mesh, get_mesh, set_mesh, batch_spec,
+    data_world_size,
+)
+from .sharding import (  # noqa: F401
+    make_sharding_rules, logical_to_mesh_spec, shard_logical,
+    param_shardings, with_logical_constraint,
+)

@@ -1,0 +1,174 @@
+"""Device-mesh topology: the TPU-native HybridCommunicateGroup.
+
+The reference builds a 4D NCCL HybridCommunicateGroup from
+``strategy.hybrid_configs{dp,mp,pp,sharding}`` (reference
+``ppfleetx/utils/env.py:49-69``) and queries per-axis ranks throughout.
+On TPU the HCG *is* a ``jax.sharding.Mesh`` with named axes — XLA/GSPMD
+emits the collectives that Fleet issued by hand, and they ride the ICI
+torus because the mesh is laid out with ``mesh_utils`` so neighboring
+mesh coordinates are ICI neighbors.
+
+Axis convention (outermost to innermost):
+  ``pp``   pipeline stages          (slowest-varying; DCN-friendly)
+  ``dp``   pure data parallel
+  ``fsdp`` sharding/ZeRO axis       (reference ``sharding_degree``)
+  ``mp``   tensor parallel          (innermost; highest-bandwidth ICI)
+
+The dataflow axis of the reference — ``dp_degree * sharding_degree``
+(``env.py:76-96``), used for batch sharding, seeds, and checkpoint
+dedup — is ``("dp", "fsdp")`` here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PP_AXIS = "pp"
+DP_AXIS = "dp"
+FSDP_AXIS = "fsdp"
+MP_AXIS = "mp"
+MESH_AXES = (PP_AXIS, DP_AXIS, FSDP_AXIS, MP_AXIS)
+#: the reference's dp x sharding composite dataflow axis (env.py:76-96)
+DATA_AXES = (DP_AXIS, FSDP_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyConfig:
+    """Parsed ``Distributed`` section; mirrors reference degree names."""
+    dp_degree: int = 1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sharding_stage: int = 1
+    sharding_offload: bool = False
+    sequence_parallel: bool = False
+
+    @classmethod
+    def from_config(cls, config) -> "TopologyConfig":
+        dist = config.get("Distributed", {}) if hasattr(config, "get") else {}
+        sharding = dist.get("sharding", {}) or {}
+        model = config.get("Model", {}) if hasattr(config, "get") else {}
+        return cls(
+            dp_degree=dist.get("dp_degree") or 1,
+            mp_degree=dist.get("mp_degree") or 1,
+            pp_degree=dist.get("pp_degree") or 1,
+            sharding_degree=sharding.get("sharding_degree") or 1,
+            sharding_stage=sharding.get("sharding_stage") or 1,
+            sharding_offload=bool(sharding.get("sharding_offload", False)),
+            sequence_parallel=bool(model.get("sequence_parallel", False)),
+        )
+
+    @property
+    def world_size(self) -> int:
+        return (self.dp_degree * self.mp_degree * self.pp_degree
+                * self.sharding_degree)
+
+    @property
+    def data_world_size(self) -> int:
+        return self.dp_degree * self.sharding_degree
+
+
+def build_mesh(topo: TopologyConfig,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the 4-axis mesh ``(pp, dp, fsdp, mp)``.
+
+    On real TPU slices ``mesh_utils.create_device_mesh`` maps mesh
+    coordinates onto the physical ICI torus; elsewhere (CPU test
+    meshes) a plain reshape is used.
+    """
+    shape = (topo.pp_degree, topo.dp_degree, topo.sharding_degree,
+             topo.mp_degree)
+    n = int(np.prod(shape))
+    if devices is None:
+        if n != jax.device_count():
+            raise ValueError(
+                f"topology {dict(zip(MESH_AXES, shape))} covers {n} devices "
+                f"but {jax.device_count()} are available; set Distributed "
+                f"degrees to use every device (reference asserts the same, "
+                f"utils/config.py:54)")
+        if jax.devices()[0].platform == "tpu":
+            from jax.experimental import mesh_utils
+            dev_array = mesh_utils.create_device_mesh(shape)
+        else:
+            dev_array = np.asarray(jax.devices()).reshape(shape)
+    else:
+        if len(devices) != n:
+            raise ValueError(
+                f"topology {shape} needs exactly {n} devices, "
+                f"got {len(devices)}")
+        # caller-supplied order is authoritative (tests, sub-meshes)
+        dev_array = np.asarray(list(devices)).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+_ACTIVE_MESH: Optional[Mesh] = None
+
+
+def set_mesh(mesh: Optional[Mesh]) -> None:
+    global _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _ACTIVE_MESH
+
+
+def batch_spec(extra_dims: int = 0) -> P:
+    """PartitionSpec for a batch-leading array, sharded over dp x fsdp."""
+    return P(DATA_AXES, *([None] * extra_dims))
+
+
+def batch_sharding(mesh: Mesh, extra_dims: int = 0) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(extra_dims))
+
+
+def data_world_size(mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return 1
+    return mesh.shape[DP_AXIS] * mesh.shape[FSDP_AXIS]
+
+
+def process_data_rank(mesh: Optional[Mesh] = None) -> int:
+    """This process's rank among all *processes* ordered along the
+    dataflow (dp x fsdp) axis.
+
+    Used for per-host data loading: host h feeds batch shards
+    ``[process_data_rank :: jax.process_count()]`` and the engine
+    assembles them into a global array. Processes are ordered by the
+    first dataflow coordinate their local devices own, so consecutive
+    ranks feed consecutive slices of the global batch.
+    """
+    mesh = mesh or get_mesh()
+    if mesh is None or jax.process_count() == 1:
+        return 0
+    first_coord = {}
+    for idx, dev in np.ndenumerate(mesh.devices):
+        _, dp_i, fsdp_i, _ = idx
+        pos = int(dp_i * mesh.shape[FSDP_AXIS] + fsdp_i)
+        p = dev.process_index
+        first_coord[p] = min(first_coord.get(p, 1 << 62), pos)
+    order = sorted(first_coord, key=lambda p: (first_coord[p], p))
+    return order.index(jax.process_index())
+
+
+def cpu_mesh_env(n: int = 8) -> None:
+    """Force an ``n``-device CPU platform for mesh tests/dry-runs.
+
+    Works whether or not jax is already imported (site customization
+    may import jax at interpreter start): sets the env vars for a
+    fresh process *and* updates jax.config for the current one. Must
+    run before the first backend initialization.
+    """
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n}")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n)

@@ -1,0 +1,142 @@
+"""Logical-axis sharding rules: TP / SP / ZeRO from annotations.
+
+The reference implements tensor parallelism with hand-written layers
+(``ColumnParallelLinear/RowParallelLinear/VocabParallelEmbedding``,
+reference ``hybrid_model.py:125-163,590``), Megatron sequence
+parallelism with explicit all-gather/reduce-scatter PyLayers
+(``sequence_parallel_utils.py:36-326``), and ZeRO via
+``group_sharded_parallel`` flat buffers (``eager_engine.py:233-247``).
+
+TPU-native design: the model annotates every parameter and key
+activation with *logical* axis names; a single rule table maps logical
+axes to mesh axes, and GSPMD inserts the identity/all-reduce/
+all-gather/reduce-scatter collectives those hand-written layers
+performed. Changing parallelism strategy = changing the rule table,
+not the model.
+
+Logical axes used across models:
+  params:     ``vocab``, ``embed``, ``mlp``, ``heads``, ``kv``,
+              ``layers`` (scan-over-layers leading axis)
+  activations: ``batch``, ``seq``, ``act_embed``, ``act_heads``
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXES, DP_AXIS, FSDP_AXIS, MP_AXIS, TopologyConfig
+
+Rules = Tuple[Tuple[str, Any], ...]
+
+
+def make_sharding_rules(topo: TopologyConfig) -> Rules:
+    """Build the logical→mesh rule table for a topology.
+
+    - TP (Megatron column/row split): ``vocab``/``heads``/``mlp`` → mp.
+    - ZeRO: parameters' ``embed`` axis shards over fsdp when
+      sharding_stage == 3 (param sharding, reference "p_g_os"); for
+      stages 1/2 only optimizer state shards (handled by the engine's
+      optimizer-state out-shardings), params stay replicated.
+    - SP: the activation ``seq`` axis shards over mp, reproducing the
+      ``[s/mp, b, h]`` layout of ``sequence_parallel_utils.py`` without
+      explicit collectives.
+    """
+    embed_axis = FSDP_AXIS if topo.sharding_stage == 3 else None
+    seq_axis = MP_AXIS if (topo.sequence_parallel and topo.mp_degree > 1) \
+        else None
+    return (
+        ("vocab", MP_AXIS),
+        ("heads", MP_AXIS),
+        ("mlp", MP_AXIS),
+        ("kv", None),
+        ("embed", embed_axis),
+        ("norm", None),
+        ("layers", None),
+        ("batch", DATA_AXES),
+        ("seq", seq_axis),
+        ("act_embed", None),
+        ("act_heads", MP_AXIS),
+        ("act_vocab", MP_AXIS),
+    )
+
+
+def logical_to_mesh_spec(logical_axes: Sequence[Optional[str]],
+                         rules: Rules) -> P:
+    table = dict(rules)
+    return P(*[table.get(a) if a is not None else None
+               for a in logical_axes])
+
+
+def shard_logical(mesh: Mesh, logical_axes: Sequence[Optional[str]],
+                  rules: Rules) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_mesh_spec(logical_axes, rules))
+
+
+def param_shardings(abstract_variables, mesh: Mesh, rules: Rules):
+    """Map a tree of flax ``Partitioned`` metadata to NamedShardings."""
+    return nn.logical_to_mesh_sharding(
+        nn.get_partition_spec(abstract_variables), mesh, list(rules))
+
+
+def with_logical_constraint(x, logical_axes: Sequence[Optional[str]]):
+    """Constrain an activation's sharding by logical axes.
+
+    No-op outside a mesh context (single-device runs, ``jax.eval_shape``).
+    Requires ``nn.logical_axis_rules``/``set_logical_axis_rules`` to be
+    active, which the engine establishes around jit-traced functions.
+    """
+    return nn.with_logical_constraint(x, tuple(logical_axes))
+
+
+def optimizer_state_shardings(opt_state_shapes, param_specs, mesh: Mesh,
+                              topo: TopologyConfig):
+    """Shardings for optimizer state: ZeRO shards moments over fsdp.
+
+    Mirrors reference sharding stages (``eager_engine.py:233-247``):
+    stage >= 1 partitions optimizer states over the sharding axis.
+    Param-shaped leaves (Adam moments, master weights) inherit the
+    param's PartitionSpec — matched by path suffix, since optax moment
+    subtrees replicate the param tree structure — and, for stages 1/2
+    where params stay replicated over fsdp, additionally shard their
+    largest still-unsharded divisible dim over fsdp. Non-param leaves
+    (step counts) are replicated.
+
+    ``param_specs`` is a pytree of ``PartitionSpec`` congruent with the
+    params pytree.
+    """
+    flat_params = jax.tree_util.tree_flatten_with_path(param_specs)[0]
+    by_suffix = {tuple(str(k) for k in path): spec
+                 for path, spec in flat_params}
+    max_suffix = max((len(k) for k in by_suffix), default=0)
+
+    def _inherited_spec(path):
+        keys = tuple(str(k) for k in path)
+        for cut in range(max(0, len(keys) - max_suffix), len(keys)):
+            spec = by_suffix.get(keys[cut:])
+            if spec is not None:
+                return spec
+        return None
+
+    def _leaf_sharding(path, shape_dtype):
+        spec = _inherited_spec(path)
+        if spec is None or shape_dtype.ndim == 0:
+            return NamedSharding(mesh, P())
+        dims = list(spec) + [None] * (shape_dtype.ndim - len(spec))
+        if topo.sharding_degree > 1 and topo.sharding_stage < 3:
+            used = {a for d in dims if d is not None
+                    for a in ((d,) if isinstance(d, str) else d)}
+            if FSDP_AXIS not in used:
+                for d in sorted(range(shape_dtype.ndim),
+                                key=lambda i: -shape_dtype.shape[i]):
+                    if dims[d] is None and \
+                            shape_dtype.shape[d] % topo.sharding_degree == 0:
+                        dims[d] = FSDP_AXIS
+                        break
+        return NamedSharding(mesh, P(*dims))
+
+    return jax.tree_util.tree_map_with_path(_leaf_sharding,
+                                            opt_state_shapes)

@@ -1,0 +1,6 @@
+from .config import (  # noqa: F401
+    AttrDict, get_config, parse_config, override_config, process_configs,
+    parse_args, print_config,
+)
+from .log import logger  # noqa: F401
+from . import env  # noqa: F401

@@ -1,0 +1,94 @@
+"""Logging with extra TRAIN / EVAL levels and per-step throughput lines.
+
+Behavior parity: reference ``ppfleetx/utils/log.py:30-118`` defines a
+logger with custom TRAIN/EVAL levels whose output lines (``loss:``,
+``ips:``) are grepped by the TIPC benchmark harness
+(``benchmarks/test_tipc/.../run_benchmark.sh:17-21``). We keep the same
+level names and line grammar so the same harness works unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from contextlib import contextmanager
+
+TRAIN = 21
+EVAL = 22
+IMPORT = 23
+
+logging.addLevelName(TRAIN, "TRAIN")
+logging.addLevelName(EVAL, "EVAL")
+logging.addLevelName(IMPORT, "IMPORT")
+
+_COLORS = {
+    "DEBUG": "\033[36m",      # cyan
+    "INFO": "\033[32m",       # green
+    "TRAIN": "\033[35m",      # magenta
+    "EVAL": "\033[34m",       # blue
+    "WARNING": "\033[33m",    # yellow
+    "ERROR": "\033[31m",      # red
+    "CRITICAL": "\033[31;1m",
+}
+_RESET = "\033[0m"
+
+
+class _Formatter(logging.Formatter):
+    def __init__(self, use_color: bool):
+        super().__init__("[%(asctime)s] [%(levelname)8s] - %(message)s",
+                         "%Y-%m-%d %H:%M:%S")
+        self._use_color = use_color
+
+    def format(self, record):
+        msg = super().format(record)
+        if self._use_color:
+            color = _COLORS.get(record.levelname)
+            if color:
+                msg = f"{color}{msg}{_RESET}"
+        return msg
+
+
+class Logger(logging.Logger):
+    """`logging.Logger` with `.train()` / `.eval()` convenience levels."""
+
+    def train(self, msg, *args, **kwargs):
+        if self.isEnabledFor(TRAIN):
+            self._log(TRAIN, msg, args, **kwargs)
+
+    def eval(self, msg, *args, **kwargs):
+        if self.isEnabledFor(EVAL):
+            self._log(EVAL, msg, args, **kwargs)
+
+
+def _build_logger() -> Logger:
+    logging.setLoggerClass(Logger)
+    lg = logging.getLogger("paddlefleetx_tpu")
+    logging.setLoggerClass(logging.Logger)
+    if not lg.handlers:
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(_Formatter(use_color=sys.stdout.isatty()))
+        lg.addHandler(handler)
+        lg.setLevel(logging.INFO)
+        lg.propagate = False
+    return lg  # type: ignore[return-value]
+
+
+logger: Logger = _build_logger()
+
+
+@contextmanager
+def timed(name: str):
+    """Log wall-clock time of a block at INFO level."""
+    start = time.perf_counter()
+    yield
+    logger.info("%s took %.3fs", name, time.perf_counter() - start)
+
+
+def advertise():
+    banner = r"""
+=======================================================================
+    PaddleFleetX-TPU  —  TPU-native large-model training (JAX/XLA)
+=======================================================================
+"""
+    logger.info(banner)

@@ -1,0 +1,20 @@
+"""Test harness: force an 8-device virtual CPU platform.
+
+Multi-host/multi-chip semantics are tested without a pod by giving XLA
+eight host devices (SURVEY.md section 4 implication). jax may already
+be imported by site customization before this file runs, so the
+platform/device-count knobs are set through jax.config as well as the
+environment; both happen before any backend is initialized.
+"""
+
+import os
+
+os.environ.setdefault("PFX_SKIP_DOWNLOAD", "1")
+
+from paddlefleetx_tpu.parallel.mesh import cpu_mesh_env  # noqa: E402
+
+cpu_mesh_env(8)
+
+import jax  # noqa: E402
+
+assert jax.device_count() == 8, jax.devices()

@@ -1,0 +1,116 @@
+import sys
+
+import pytest
+
+from paddlefleetx_tpu.utils.config import (
+    AttrDict, get_config, override_config, parse_config, process_configs,
+)
+
+
+@pytest.fixture
+def cfg_tree(tmp_path):
+    (tmp_path / "base.yaml").write_text("""
+Global:
+  seed: 1024
+  local_batch_size: 8
+  micro_batch_size: 8
+Engine:
+  max_steps: 100
+  eval_iters: 10
+Model:
+  hidden_size: 64
+  fused_linear: False
+Data:
+  Train:
+    dataset: {name: GPTDataset, max_seq_len: 128}
+""")
+    (tmp_path / "child.yaml").write_text("""
+_base_: ./base.yaml
+Model:
+  hidden_size: 128
+  num_layers: 2
+Distributed:
+  dp_degree: 2
+  mp_degree: 2
+  pp_degree: 1
+  sharding:
+    sharding_degree: 2
+    sharding_stage: 1
+""")
+    return tmp_path
+
+
+def test_base_inheritance_merges_recursively(cfg_tree):
+    cfg = parse_config(str(cfg_tree / "child.yaml"))
+    assert cfg.Model.hidden_size == 128          # child wins
+    assert cfg.Model.fused_linear is False       # base preserved
+    assert cfg.Global.seed == 1024
+    assert cfg.Data.Train.dataset.name == "GPTDataset"
+
+
+def test_inherited_false_replaces_subtree(tmp_path):
+    (tmp_path / "base.yaml").write_text(
+        "Model: {a: 1, b: 2}\nGlobal: {local_batch_size: 1}\n")
+    (tmp_path / "child.yaml").write_text(
+        "_base_: ./base.yaml\nModel:\n  _inherited_: False\n  c: 3\n")
+    cfg = parse_config(str(tmp_path / "child.yaml"))
+    assert "a" not in cfg.Model and cfg.Model.c == 3
+
+
+def test_override_dotted_paths_and_lists():
+    cfg = AttrDict({"Global": AttrDict({"seed": 1}),
+                    "split": [949, 50, 1]})
+    override_config(cfg, ["Global.seed=7", "split.1=99",
+                          "Model.hidden_size=256"])
+    assert cfg.Global.seed == 7
+    assert cfg.split[1] == 99
+    assert cfg.Model.hidden_size == 256
+
+
+def test_literal_eval_coercion(tmp_path):
+    (tmp_path / "c.yaml").write_text(
+        "Global:\n  local_batch_size: 2\n  lr: '1.0e-5'\n  flag: 'True'\n")
+    cfg = parse_config(str(tmp_path / "c.yaml"))
+    assert cfg.Global.lr == pytest.approx(1e-5)
+    assert cfg.Global.flag is True
+
+
+def test_dist_degree_inference(cfg_tree):
+    cfg = parse_config(str(cfg_tree / "child.yaml"))
+    process_configs(cfg, nranks=8)
+    d = cfg.Distributed
+    assert (d.dp_degree, d.mp_degree, d.pp_degree,
+            d.sharding.sharding_degree) == (2, 2, 1, 2)
+    # dataflow axis = dp*sharding = 4
+    assert cfg.Global.global_batch_size == 8 * 4
+
+
+def test_dp_degree_adjusted_when_mismatched(cfg_tree):
+    cfg = parse_config(str(cfg_tree / "child.yaml"))
+    cfg.Distributed.dp_degree = 4  # wrong for 8 ranks with mp2 x sh2
+    process_configs(cfg, nranks=8)
+    assert cfg.Distributed.dp_degree == 2
+
+
+def test_batch_algebra_infers_local(cfg_tree):
+    cfg = parse_config(str(cfg_tree / "child.yaml"))
+    cfg.Global.global_batch_size = 32
+    cfg.Global.local_batch_size = None
+    cfg.Global.micro_batch_size = 4
+    process_configs(cfg, nranks=8)
+    assert cfg.Global.local_batch_size == 8
+    assert cfg.Engine.accumulate_steps == 2
+
+
+def test_engine_defaults(cfg_tree):
+    cfg = parse_config(str(cfg_tree / "child.yaml"))
+    process_configs(cfg, nranks=8)
+    assert cfg.Engine.save_load.save_steps == sys.maxsize
+    assert cfg.Engine.test_iters == 100
+    assert cfg.Engine.accumulate_steps == 1
+
+
+def test_get_config_end_to_end(cfg_tree):
+    cfg = get_config(str(cfg_tree / "child.yaml"),
+                     overrides=["Model.num_layers=4"], nranks=8)
+    assert cfg.Model.num_layers == 4
