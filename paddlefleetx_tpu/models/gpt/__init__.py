@@ -1,0 +1,5 @@
+from .config import GPTConfig  # noqa: F401
+from .model import (  # noqa: F401
+    GPTEmbeddings, GPTForPretraining, GPTModel, MultiHeadAttention,
+    TransformerDecoderLayer, cross_entropy_loss,
+)

@@ -1,0 +1,70 @@
+"""GPT model hyper-parameter container.
+
+Field names and defaults follow the reference's ``Model`` YAML section
+(reference ``single_model.py:475-510`` constructor signature and
+``models/language_model/utils.py:39-110`` derivations: ffn defaults to
+4*hidden, recompute granularity defaults to "full").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 51200
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_attention_heads: int = 12
+    ffn_hidden_size: Optional[int] = None
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 16
+    initializer_range: float = 0.02
+    use_recompute: bool = False
+    recompute_granularity: str = "full"   # full | full_attn | core_attn
+    fused_linear: bool = False            # no-op on TPU: XLA fuses bias
+    fuse_attn_qkv: bool = True
+    sequence_parallel: bool = False
+    virtual_pp_degree: int = 1
+    # TPU-specific knobs (absent in reference):
+    scan_layers: bool = True              # lax.scan over layers
+    use_flash_attention: bool = False     # Pallas kernel on TPU
+    dtype: str = "float32"                # compute dtype (bf16 for AMP-O2)
+    param_dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.ffn_hidden_size is None:
+            object.__setattr__(self, "ffn_hidden_size", 4 * self.hidden_size)
+        if self.hidden_size % self.num_attention_heads != 0:
+            raise ValueError(
+                f"num_attention_heads ({self.num_attention_heads}) must "
+                f"divide hidden_size ({self.hidden_size})")
+        if self.recompute_granularity not in ("full", "full_attn",
+                                              "core_attn"):
+            raise ValueError(
+                f"unknown recompute_granularity "
+                f"{self.recompute_granularity!r}")
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def from_config(cls, config) -> "GPTConfig":
+        """Build from a parsed YAML tree (Model + Engine sections)."""
+        model = dict(config.get("Model", {}))
+        mix = config.get("Engine", {}).get("mix_precision", {})
+        fields = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in model.items()
+                  if k in fields and v is not None}
+        if model.get("use_recompute") and \
+                not model.get("recompute_granularity"):
+            kwargs["recompute_granularity"] = "full"
+        # AMP-O2 / use_pure_fp16 maps to bf16 compute on TPU
+        if mix.get("use_pure_fp16") or mix.get("dtype") == "bfloat16":
+            kwargs.setdefault("dtype", "bfloat16")
+        return cls(**kwargs)

@@ -1,0 +1,325 @@
+"""TPU-native GPT: one sharding-annotated flax model for every topology.
+
+The reference maintains three GPT implementations — single-card
+(``gpt/dygraph/single_model.py``), hybrid TP/PP/SP
+(``gpt/dygraph/hybrid_model.py``) and auto-parallel
+(``gpt/auto/auto_model.py``). Under GSPMD one definition covers all of
+them: parameters and activations carry *logical* axis names
+(``parallel/sharding.py``) and the partitioner inserts the collectives
+the hybrid model wrote by hand (ColumnParallelLinear all-reduces,
+sequence-parallel all-gather/reduce-scatter, vocab-parallel logits).
+
+Architecture parity (reference ``single_model.py``):
+  - learned word + position embeddings, dropout (:435-473)
+  - pre-LayerNorm decoder blocks, eps 1e-5, tanh-approx GELU (:340-427)
+  - fused QKV projection option (:86-87), causal fused-mask softmax
+    (:198), attention-prob dropout
+  - final LayerNorm (:278-279); logits tied to the word embedding
+    (:608-611); masked cross-entropy criterion (:619-653)
+
+TPU-first choices: batch-major ``[b, s, h]`` activations; compute in
+bf16 with fp32 params/softmax; ``nn.scan`` over layers (one compiled
+block, weights stacked on a ``layers`` axis — compile time independent
+of depth); ``jax.checkpoint`` policies reproduce the reference's
+recompute granularities full / full_attn / core_attn
+(``hybrid_model.py:406-408,537-539,332-333``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
+
+from ...ops.attention import dot_product_attention
+from ...parallel.sharding import with_logical_constraint
+from .config import GPTConfig
+
+Dtype = Any
+
+
+def _dense_init(cfg: GPTConfig):
+    return nn.initializers.normal(stddev=cfg.initializer_range)
+
+
+def _remat_policy(granularity: str):
+    """Map reference recompute granularities onto checkpoint policies.
+
+    ``full`` recomputes the whole block; ``full_attn`` saves everything
+    except attention internals (tagged "attn"/"core_attn"); ``core_attn``
+    saves everything except the softmax(QK)V internals ("core_attn").
+    """
+    cp = jax.checkpoint_policies
+    if granularity == "full":
+        return None  # nothing saveable
+    if granularity == "full_attn":
+        return cp.save_anything_except_these_names("attn", "core_attn")
+    if granularity == "core_attn":
+        return cp.save_anything_except_these_names("core_attn")
+    raise ValueError(granularity)
+
+
+class MultiHeadAttention(nn.Module):
+    """Self-attention with fused QKV and a fixed-capacity decode cache.
+
+    The reference grows its KV cache by concatenation
+    (``single_model.py:179-184``), which would retrace under jit; here
+    the cache is a preallocated ``[b, max_len, h, d]`` buffer updated
+    with ``dynamic_update_slice`` — the dy2static-friendly design the
+    reference approximates in ``hybrid_model.py:1322-1347``.
+    """
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, attn_bias=None, use_cache: bool = False,
+                 deterministic: bool = True):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        h, nh, hd = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
+        dense = lambda feats, name, axes: nn.DenseGeneral(  # noqa: E731
+            feats, axis=-1, name=name, dtype=dtype,
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            kernel_init=nn.with_logical_partitioning(
+                _dense_init(cfg), ("embed",) + axes),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), axes))
+
+        if cfg.fuse_attn_qkv:
+            qkv = dense((3, nh, hd), "qkv_proj", (None, "heads", "kv"))(x)
+            q, k, v = (qkv[..., i, :, :] for i in range(3))
+        else:
+            q = dense((nh, hd), "q_proj", ("heads", "kv"))(x)
+            k = dense((nh, hd), "k_proj", ("heads", "kv"))(x)
+            v = dense((nh, hd), "v_proj", ("heads", "kv"))(x)
+        q = checkpoint_name(q, "attn")
+        k = checkpoint_name(k, "attn")
+        v = checkpoint_name(v, "attn")
+        q, k, v = (with_logical_constraint(
+            t, ("batch", None, "act_heads", None)) for t in (q, k, v))
+
+        query_offset = 0
+        if use_cache:
+            # Decode: roll the new keys/values into the preallocated
+            # cache. Capacity is max_position_embeddings; the caller
+            # (generation loop) must bound prompt+decode length by it —
+            # dynamic_update_slice clamps rather than raises on overrun.
+            cache_k = self.variable(
+                "cache", "cached_key", jnp.zeros,
+                (x.shape[0], cfg.max_position_embeddings, nh, hd), dtype)
+            cache_v = self.variable(
+                "cache", "cached_value", jnp.zeros,
+                (x.shape[0], cfg.max_position_embeddings, nh, hd), dtype)
+            cache_index = self.variable(
+                "cache", "cache_index",
+                lambda: jnp.zeros((), jnp.int32))
+            idx = cache_index.value
+            cache_k.value = jax.lax.dynamic_update_slice(
+                cache_k.value, k, (0, idx, 0, 0))
+            cache_v.value = jax.lax.dynamic_update_slice(
+                cache_v.value, v, (0, idx, 0, 0))
+            k, v = cache_k.value, cache_v.value
+            query_offset = idx
+            cache_index.value = idx + x.shape[1]
+
+        dropout_rng = None
+        if cfg.attention_probs_dropout_prob > 0.0 and not deterministic:
+            dropout_rng = self.make_rng("dropout")
+        out = dot_product_attention(
+            q, k, v, bias=attn_bias, causal=True,
+            query_offset=query_offset,
+            dropout_rate=cfg.attention_probs_dropout_prob,
+            dropout_rng=dropout_rng, deterministic=deterministic,
+            use_flash=cfg.use_flash_attention)
+        out = checkpoint_name(out, "attn")
+
+        out = nn.DenseGeneral(
+            h, axis=(-2, -1), name="out_proj", dtype=dtype,
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            kernel_init=nn.with_logical_partitioning(
+                _dense_init(cfg), ("heads", "kv", "embed")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("embed",)))(out)
+        return out
+
+
+class TransformerDecoderLayer(nn.Module):
+    """Pre-LN decoder block (reference ``single_model.py:340-427``).
+
+    With ``scanned=True`` the call returns ``(x, None)`` — the
+    ``(carry, ys)`` pair ``nn.scan`` requires.
+    """
+    config: GPTConfig
+    scanned: bool = False
+
+    @nn.compact
+    def __call__(self, x, attn_bias=None, use_cache: bool = False,
+                 deterministic: bool = True):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        pdtype = jnp.dtype(cfg.param_dtype)
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            epsilon=1e-5, dtype=dtype, param_dtype=pdtype, name=name,
+            scale_init=nn.with_logical_partitioning(
+                nn.initializers.ones_init(), ("norm",)),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("norm",)))
+
+        residual = x
+        y = ln("norm1")(x)
+        y = MultiHeadAttention(cfg, name="self_attn")(
+            y, attn_bias, use_cache, deterministic)
+        y = nn.Dropout(cfg.hidden_dropout_prob, name="dropout1")(
+            y, deterministic=deterministic)
+        x = residual + y
+        x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
+
+        residual = x
+        y = ln("norm2")(x)
+        y = nn.DenseGeneral(
+            cfg.ffn_hidden_size, name="linear1", dtype=dtype,
+            param_dtype=pdtype,
+            kernel_init=nn.with_logical_partitioning(
+                _dense_init(cfg), ("embed", "mlp")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("mlp",)))(y)
+        y = nn.gelu(y, approximate=True)
+        y = with_logical_constraint(y, ("batch", None, "act_mlp"))
+        y = nn.DenseGeneral(
+            cfg.hidden_size, name="linear2", dtype=dtype,
+            param_dtype=pdtype,
+            kernel_init=nn.with_logical_partitioning(
+                _dense_init(cfg), ("mlp", "embed")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("embed",)))(y)
+        y = nn.Dropout(cfg.hidden_dropout_prob, name="dropout2")(
+            y, deterministic=deterministic)
+        x = residual + y
+        x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
+        return (x, None) if self.scanned else x
+
+
+class GPTEmbeddings(nn.Module):
+    """Word + learned position embeddings (reference :435-473)."""
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, position_ids, deterministic: bool = True):
+        cfg = self.config
+        word_emb = self.param(
+            "word_embeddings",
+            nn.with_logical_partitioning(_dense_init(cfg),
+                                         ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), jnp.dtype(cfg.param_dtype))
+        pos_emb = self.param(
+            "position_embeddings",
+            nn.with_logical_partitioning(_dense_init(cfg),
+                                         ("pos", "embed")),
+            (cfg.max_position_embeddings, cfg.hidden_size),
+            jnp.dtype(cfg.param_dtype))
+        dtype = jnp.dtype(cfg.dtype)
+        x = jnp.take(word_emb, input_ids, axis=0).astype(dtype) + \
+            jnp.take(pos_emb, position_ids, axis=0).astype(dtype)
+        x = nn.Dropout(cfg.hidden_dropout_prob)(
+            x, deterministic=deterministic)
+        return with_logical_constraint(x, ("batch", "seq", "act_embed"))
+
+
+class GPTModel(nn.Module):
+    """Embeddings -> N decoder blocks -> final LayerNorm."""
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, position_ids=None, attn_bias=None,
+                 use_cache: bool = False, deterministic: bool = True,
+                 position_offset=0):
+        cfg = self.config
+        if input_ids.shape[-1] > cfg.max_position_embeddings:
+            raise ValueError(
+                f"sequence length {input_ids.shape[-1]} exceeds "
+                f"max_position_embeddings {cfg.max_position_embeddings}")
+        if position_ids is None:
+            position_ids = position_offset + jnp.arange(
+                input_ids.shape[-1], dtype=jnp.int32)[None, :]
+            position_ids = jnp.broadcast_to(position_ids, input_ids.shape)
+        x = GPTEmbeddings(cfg, name="embeddings")(
+            input_ids, position_ids, deterministic)
+
+        block = TransformerDecoderLayer
+        if cfg.use_recompute:
+            block = nn.remat(
+                block, policy=_remat_policy(cfg.recompute_granularity),
+                prevent_cse=not cfg.scan_layers,
+                static_argnums=(3, 4))
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                block,
+                variable_axes={"params": 0, "cache": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=nn.broadcast,
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, scanned=True, name="decoder")(
+                x, attn_bias, use_cache, deterministic)
+        else:
+            for i in range(cfg.num_layers):
+                x = block(cfg, name=f"decoder_{i}")(
+                    x, attn_bias, use_cache, deterministic)
+
+        x = nn.LayerNorm(
+            epsilon=1e-5, dtype=jnp.dtype(cfg.dtype),
+            param_dtype=jnp.dtype(cfg.param_dtype), name="final_norm",
+            scale_init=nn.with_logical_partitioning(
+                nn.initializers.ones_init(), ("norm",)),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("norm",)))(x)
+        return x
+
+
+class GPTForPretraining(nn.Module):
+    """GPT with tied-embedding LM head (reference :577-616).
+
+    The hybrid reference computes tied logits through
+    ``parallel_matmul`` with an mp all-gather (``hybrid_model.py:45-66``);
+    here the einsum against the vocab-sharded embedding produces
+    vocab-sharded logits and GSPMD inserts the same collective exactly
+    where needed (only if the consumer demands replication).
+    """
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, position_ids=None, attn_bias=None,
+                 use_cache: bool = False, deterministic: bool = True,
+                 position_offset=0):
+        x = GPTModel(self.config, name="gpt")(
+            input_ids, position_ids, attn_bias, use_cache, deterministic,
+            position_offset)
+        word_emb = self.variables["params"]["gpt"]["embeddings"][
+            "word_embeddings"]
+        if isinstance(word_emb, nn.Partitioned):
+            word_emb = word_emb.value
+        logits = jnp.einsum("bsh,vh->bsv", x,
+                            word_emb.astype(x.dtype))
+        return with_logical_constraint(logits,
+                                       ("batch", "seq", "act_vocab"))
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
+                       loss_mask: jax.Array) -> jax.Array:
+    """Masked LM criterion (reference ``GPTPretrainingCriterion``,
+    ``single_model.py:619-653``): mean NLL over unmasked positions.
+
+    Computed in fp32 regardless of compute dtype; with vocab-sharded
+    logits GSPMD turns the log-sum-exp and gather into the same
+    psum-based sharded softmax the reference's ``ParallelCrossEntropy``
+    (``hybrid_model.py:799``) implements by hand.
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(
+        logits, labels[..., None], axis=-1)[..., 0]
+    nll = logz - label_logits
+    loss_mask = loss_mask.astype(jnp.float32).reshape(nll.shape)
+    return jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)

@@ -1,0 +1,77 @@
+"""Scaled dot-product attention with fused-causal-softmax semantics.
+
+The reference fuses mask+softmax through a CUDA kernel
+(``incubate.softmax_mask_fuse_upper_triangle``, reference
+``single_model.py:198``) and otherwise materializes the full
+``[b, heads, s, s]`` score matrix. On TPU the XLA path below already
+fuses mask+softmax into the matmul epilogue; the Pallas flash-attention
+kernel (``ops/pallas/flash_attention.py``) replaces it on real TPU
+devices for long sequences, never materializing the score matrix.
+
+Layout: ``q [b, sq, h, d]``, ``k/v [b, skv, h, d]`` (batch-major,
+head-split), output ``[b, sq, h, d]``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
+
+NEG_INF = -1e9
+
+
+def _xla_attention(q, k, v, bias, causal, query_offset, dropout_rate,
+                   dropout_rng, deterministic, softmax_in_fp32):
+    head_dim = q.shape[-1]
+    scale = head_dim ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
+    if softmax_in_fp32:
+        scores = scores.astype(jnp.float32)
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        # query i attends to keys <= i + query_offset (offset > 0 during
+        # cached decode where keys include the past)
+        q_pos = jnp.arange(sq)[:, None] + query_offset
+        k_pos = jnp.arange(sk)[None, :]
+        scores = jnp.where(k_pos <= q_pos, scores, NEG_INF)
+    if bias is not None:
+        scores = scores + bias.astype(scores.dtype)
+    weights = jax.nn.softmax(scores, axis=-1)
+    weights = checkpoint_name(weights, "core_attn")
+    if dropout_rate > 0.0 and not deterministic:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    weights.shape)
+        weights = weights * keep / (1.0 - dropout_rate)
+    weights = weights.astype(v.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+    return checkpoint_name(out, "core_attn")
+
+
+def dot_product_attention(
+        q: jax.Array, k: jax.Array, v: jax.Array,
+        bias: Optional[jax.Array] = None,
+        causal: bool = True,
+        query_offset=0,
+        dropout_rate: float = 0.0,
+        dropout_rng: Optional[jax.Array] = None,
+        deterministic: bool = True,
+        softmax_in_fp32: bool = True,
+        use_flash: bool = False) -> jax.Array:
+    """Causal attention; dispatches to the Pallas flash kernel on TPU.
+
+    ``bias`` is an additive mask broadcastable to ``[b, h, sq, sk]``
+    (the reference's ``attn_mask`` convention, additive -1e4 style).
+    """
+    if use_flash and bias is None and dropout_rate == 0.0:
+        try:
+            from .pallas.flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=causal,
+                                   query_offset=query_offset)
+        except (ImportError, NotImplementedError):
+            pass
+    return _xla_attention(q, k, v, bias, causal, query_offset, dropout_rate,
+                          dropout_rng, deterministic, softmax_in_fp32)

@@ -54,12 +54,14 @@ def make_sharding_rules(topo: TopologyConfig) -> Rules:
         ("mlp", MP_AXIS),
         ("kv", None),
         ("embed", embed_axis),
+        ("pos", None),
         ("norm", None),
         ("layers", None),
         ("batch", DATA_AXES),
         ("seq", seq_axis),
         ("act_embed", None),
         ("act_heads", MP_AXIS),
+        ("act_mlp", MP_AXIS),
         ("act_vocab", MP_AXIS),
     )
 

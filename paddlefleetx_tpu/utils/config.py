@@ -98,6 +98,7 @@ def _merge(child: Dict, base: Dict) -> Dict:
             out[key] = _merge(val, out[key])
         else:
             out[key] = val
+    out.pop("_inherited_", None)
     return out
 
 

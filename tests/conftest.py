@@ -7,11 +7,7 @@ platform/device-count knobs are set through jax.config as well as the
 environment; both happen before any backend is initialized.
 """
 
-import os
-
-os.environ.setdefault("PFX_SKIP_DOWNLOAD", "1")
-
-from paddlefleetx_tpu.parallel.mesh import cpu_mesh_env  # noqa: E402
+from paddlefleetx_tpu.parallel.mesh import cpu_mesh_env
 
 cpu_mesh_env(8)
 

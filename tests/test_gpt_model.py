@@ -1,0 +1,154 @@
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlefleetx_tpu.models.gpt import (
+    GPTConfig, GPTForPretraining, GPTModel, cross_entropy_loss,
+)
+
+TINY = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                 num_attention_heads=4, max_position_embeddings=64,
+                 hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+
+
+def _init(model, cfg=TINY, batch=2, seq=16):
+    ids = jnp.zeros((batch, seq), jnp.int32)
+    variables = model.init({"params": jax.random.key(0)}, ids)
+    return variables, ids
+
+
+def test_forward_shapes_and_dtype():
+    model = GPTForPretraining(TINY)
+    variables, ids = _init(model)
+    logits = model.apply(variables, ids)
+    assert logits.shape == (2, 16, 128)
+    assert logits.dtype == jnp.float32
+
+
+def test_param_count_345m_formula():
+    """Sanity: parameter count matches the analytic transformer formula."""
+    cfg = TINY
+    variables, _ = _init(GPTForPretraining(cfg))
+    n = sum(x.size for x in jax.tree_util.tree_leaves(variables["params"]))
+    h, L, v, p, f = (cfg.hidden_size, cfg.num_layers, cfg.vocab_size,
+                     cfg.max_position_embeddings, cfg.ffn_hidden_size)
+    per_layer = (3 * h * h + 3 * h) + (h * h + h) \
+        + (h * f + f) + (f * h + h) + 4 * h
+    expect = v * h + p * h + L * per_layer + 2 * h
+    assert n == expect
+
+
+def test_causality():
+    """Changing a future token must not affect earlier logits."""
+    model = GPTForPretraining(TINY)
+    variables, _ = _init(model)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 128, (1, 16)), jnp.int32)
+    ids2 = ids.at[0, 10].set((ids[0, 10] + 1) % 128)
+    a = model.apply(variables, ids)
+    b = model.apply(variables, ids2)
+    np.testing.assert_allclose(np.asarray(a[0, :10]), np.asarray(b[0, :10]),
+                               atol=1e-5)
+    assert not np.allclose(np.asarray(a[0, 10:]), np.asarray(b[0, 10:]))
+
+
+def test_scan_matches_unrolled():
+    """nn.scan over layers == python-loop layers, given equal weights."""
+    cfg_scan = TINY
+    cfg_loop = GPTConfig(**{**vars(TINY), "scan_layers": False})
+    ids = jnp.asarray(
+        np.random.default_rng(1).integers(0, 128, (2, 8)), jnp.int32)
+    m_scan, m_loop = GPTModel(cfg_scan), GPTModel(cfg_loop)
+    v_scan = m_scan.init(jax.random.key(0), ids)
+    # transplant scanned (stacked) weights into the unrolled layout
+    p = v_scan["params"]
+    loop_params = {"embeddings": p["embeddings"],
+                   "final_norm": p["final_norm"]}
+    stacked = p["decoder"]
+    for i in range(cfg_loop.num_layers):
+        loop_params[f"decoder_{i}"] = jax.tree.map(
+            lambda x: x[i], stacked)
+    out_scan = m_scan.apply(v_scan, ids)
+    out_loop = m_loop.apply({"params": loop_params}, ids)
+    np.testing.assert_allclose(np.asarray(out_scan), np.asarray(out_loop),
+                               atol=1e-5)
+
+
+def test_recompute_granularities_same_loss_and_grads():
+    base = GPTForPretraining(TINY)
+    variables, _ = _init(base)
+    rng = np.random.default_rng(2)
+    ids = jnp.asarray(rng.integers(0, 128, (2, 16)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, 128, (2, 16)), jnp.int32)
+    mask = jnp.ones((2, 16), jnp.float32)
+
+    def loss_fn(cfg):
+        model = GPTForPretraining(cfg)
+
+        def f(params):
+            logits = model.apply({"params": params}, ids)
+            return cross_entropy_loss(logits, labels, mask)
+        return jax.value_and_grad(f)(variables["params"])
+
+    ref_loss, ref_grad = loss_fn(TINY)
+    for gran in ("full", "full_attn", "core_attn"):
+        cfg = GPTConfig(**{**vars(TINY), "use_recompute": True,
+                           "recompute_granularity": gran})
+        loss, grad = loss_fn(cfg)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5),
+            ref_grad, grad)
+
+
+def test_kv_cache_decode_matches_full_forward():
+    """Prefill + step-by-step cached decode == one full forward."""
+    cfg = GPTConfig(**{**vars(TINY), "scan_layers": True})
+    model = GPTForPretraining(cfg)
+    variables, _ = _init(model)
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(0, 128, (1, 12)), jnp.int32)
+
+    full = model.apply(variables, ids)
+
+    prefix, rest = ids[:, :8], ids[:, 8:]
+    logits, mutated = model.apply(
+        variables, prefix, use_cache=True, mutable=["cache"])
+    outs = [logits]
+    cache = mutated["cache"]
+    for t in range(rest.shape[1]):
+        step = rest[:, t:t + 1]
+        logits, mutated = model.apply(
+            {**variables, "cache": cache}, step, use_cache=True,
+            position_offset=8 + t, mutable=["cache"])
+        cache = mutated["cache"]
+        outs.append(logits)
+    stitched = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(stitched), np.asarray(full),
+                               atol=2e-4)
+
+
+def test_cross_entropy_matches_naive():
+    rng = np.random.default_rng(4)
+    logits = jnp.asarray(rng.normal(size=(2, 4, 8)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 8, (2, 4)), jnp.int32)
+    mask = jnp.asarray([[1, 1, 0, 1], [0, 1, 1, 1]], jnp.float32)
+    got = cross_entropy_loss(logits, labels, mask)
+    probs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -np.take_along_axis(np.asarray(probs),
+                              np.asarray(labels)[..., None], -1)[..., 0]
+    expect = (nll * np.asarray(mask)).sum() / np.asarray(mask).sum()
+    np.testing.assert_allclose(float(got), expect, rtol=1e-6)
+
+
+def test_bf16_compute_keeps_fp32_params():
+    cfg = GPTConfig(**{**vars(TINY), "dtype": "bfloat16"})
+    model = GPTForPretraining(cfg)
+    variables, ids = _init(model, cfg)
+    for leaf in jax.tree_util.tree_leaves(variables["params"]):
+        assert leaf.dtype == jnp.float32
+    logits = model.apply(variables, ids)
+    assert logits.dtype == jnp.bfloat16
