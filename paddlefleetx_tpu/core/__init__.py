@@ -1,0 +1,2 @@
+from .engine import BasicEngine, Engine  # noqa: F401
+from .module import BasicModule, LanguageModule  # noqa: F401

@@ -1,0 +1,105 @@
+"""The Lightning-style module contract between engine and models.
+
+Parity: reference ``ppfleetx/core/module/basic_module.py:29-86``
+(``BasicModule``: get_model / training_step / validation_step /
+``*_step_end`` hooks / input_spec) and
+``ppfleetx/models/language_model/language_module.py:31-110``
+(``LanguageModule``: loss + tokens/s throughput logging in the exact
+``ips:`` line grammar the TIPC harness greps).
+
+JAX twist: ``training_step`` must be a pure function traced under jit,
+so the contract splits into pure parts (``loss_fn``) the engine jits,
+and host-side hooks (``*_step_end``) for logging.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ..utils.log import logger
+
+
+class BasicModule:
+    """Subclasses implement ``get_model``/``loss_fn``; the engine owns
+    the step loop and calls the hooks."""
+
+    def __init__(self, configs):
+        self.configs = configs
+        self.nranks = None  # filled by the engine with mesh world size
+        self.model = self.get_model()
+
+    # -- pure (jit-traced) ---------------------------------------------
+    def get_model(self):
+        raise NotImplementedError
+
+    def loss_fn(self, params, batch, rng, train: bool = True):
+        """Return scalar loss. ``batch`` is the collated tuple."""
+        raise NotImplementedError
+
+    # -- host-side hooks -----------------------------------------------
+    def pretreating_batch(self, batch):
+        return batch
+
+    def training_step_end(self, log_dict: Dict[str, Any]) -> None:
+        logger.train(
+            "[train] epoch: %d, batch: %d, loss: %.9f, avg_batch_cost: "
+            "%.5f sec", log_dict["epoch"], log_dict["batch"],
+            log_dict["loss"], log_dict["train_cost"])
+
+    def validation_step_end(self, log_dict: Dict[str, Any]) -> None:
+        logger.eval(
+            "[eval] epoch: %d, batch: %d, loss: %.9f, avg_eval_cost: "
+            "%.5f sec", log_dict["epoch"], log_dict["batch"],
+            log_dict["loss"], log_dict["eval_cost"])
+
+    def validation_epoch_end(self, log_dict: Dict[str, Any]) -> None:
+        pass
+
+    def test_step_end(self, log_dict: Dict[str, Any]) -> None:
+        pass
+
+    def training_epoch_end(self, log_dict: Dict[str, Any]) -> None:
+        logger.info("[Training] epoch: %d, total time: %.5f sec",
+                    log_dict["epoch"], log_dict["train_cost"])
+
+    def input_spec(self):
+        """Abstract input shapes/dtypes for export (AOT compile)."""
+        return None
+
+
+class LanguageModule(BasicModule):
+    """Adds the LM throughput logging contract
+    (reference ``language_module.py:58-95``)."""
+
+    def training_step_end(self, log_dict: Dict[str, Any]) -> None:
+        speed = 1.0 / log_dict["train_cost"]
+        default_global_tokens_num = (
+            self.configs.Global.global_batch_size *
+            log_dict["max_seq_len"])
+        logger.train(
+            "[train] epoch: %d, batch: %d, loss: %.9f, "
+            "avg_batch_cost: %.5f sec, speed: %.2f step/s, "
+            "ips_total: %.0f tokens/s, ips: %.0f tokens/s, "
+            "learning rate: %.5e",
+            log_dict["epoch"], log_dict["batch"], log_dict["loss"],
+            log_dict["train_cost"], speed,
+            speed * default_global_tokens_num,
+            speed * default_global_tokens_num / max(self.nranks or 1, 1),
+            log_dict["lr"])
+
+    def validation_step_end(self, log_dict: Dict[str, Any]) -> None:
+        speed = 1.0 / log_dict["eval_cost"]
+        logger.eval(
+            "[eval] epoch: %d, batch: %d, loss: %.9f, avg_eval_cost: "
+            "%.5f sec, speed: %.2f step/s", log_dict["epoch"],
+            log_dict["batch"], log_dict["loss"], log_dict["eval_cost"],
+            speed)
+
+    def test_step_end(self, log_dict: Dict[str, Any]) -> None:
+        speed = 1.0 / log_dict["test_cost"]
+        logger.info(
+            "[test] epoch: %d, batch: %d, loss: %.9f, avg_test_cost: "
+            "%.5f sec, speed: %.2f step/s", log_dict["epoch"],
+            log_dict["batch"], log_dict["loss"], log_dict["test_cost"],
+            speed)

@@ -1,0 +1,86 @@
+"""Data layer: datasets, samplers, collate, loader factories.
+
+Name-driven builders with the same YAML contract as reference
+``ppfleetx/data/__init__.py:25-90`` (dataset/sampler/loader sections),
+via explicit registries instead of ``eval``.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from ..utils.log import logger
+from .dataset.gpt_dataset import GPTDataset  # noqa: F401
+from .loader import DataLoader
+from .sampler.batch_sampler import (  # noqa: F401
+    DistributedBatchSampler, GPTBatchSampler,
+)
+from .sampler.collate import (  # noqa: F401
+    COLLATE_FNS, Dict, Pad, Stack, Tuple, gpt_collate_fn,
+    gpt_eval_collate_fn,
+)
+
+DATASETS = {}
+SAMPLERS = {
+    "GPTBatchSampler": GPTBatchSampler,
+    "DistributedBatchSampler": DistributedBatchSampler,
+}
+
+
+def register_dataset(name):
+    def deco(cls):
+        DATASETS[name] = cls
+        return cls
+    return deco
+
+
+def _populate():
+    DATASETS.setdefault("GPTDataset", GPTDataset)
+    try:
+        from .dataset.gpt_dataset_eval import (
+            Lambada_Eval_Dataset, LM_Eval_Dataset)
+        DATASETS.setdefault("LM_Eval_Dataset", LM_Eval_Dataset)
+        DATASETS.setdefault("Lambada_Eval_Dataset", Lambada_Eval_Dataset)
+    except ImportError:
+        pass
+
+
+def build_dataset(config, mode: str):
+    if mode not in ("Train", "Eval", "Test"):
+        raise ValueError("mode must be Train, Eval or Test")
+    if mode not in config:
+        return None
+    _populate()
+    cfg = copy.deepcopy(dict(config[mode]["dataset"]))
+    name = cfg.pop("name")
+    if name not in DATASETS:
+        raise ValueError(
+            f"unknown dataset {name!r}; available: {sorted(DATASETS)}")
+    dataset = DATASETS[name](**cfg)
+    logger.debug("built dataset %s for %s", name, mode)
+    return dataset
+
+
+def build_dataloader(config, mode: str, num_replicas: int = 1,
+                     rank: int = 0):
+    """Build dataset + rank-sliced sampler + prefetching loader.
+
+    ``num_replicas``/``rank`` are the dataflow (dp x sharding) world
+    size and this process's dataflow rank (reference wires these from
+    the HCG inside the sampler; here the engine passes them in).
+    """
+    dataset = build_dataset(config, mode)
+    if dataset is None:
+        return None
+    sampler_cfg = copy.deepcopy(dict(config[mode].get("sampler", {})))
+    name = sampler_cfg.pop("name", "GPTBatchSampler")
+    if name not in SAMPLERS:
+        raise ValueError(
+            f"unknown sampler {name!r}; available: {sorted(SAMPLERS)}")
+    sampler = SAMPLERS[name](dataset, num_replicas=num_replicas, rank=rank,
+                             **sampler_cfg)
+    loader_cfg = copy.deepcopy(dict(config[mode].get("loader", {})))
+    loader_cfg.pop("return_list", None)
+    collate_name = loader_cfg.pop("collate_fn", None)
+    collate = COLLATE_FNS[collate_name] if collate_name else None
+    return DataLoader(dataset, sampler, collate, **loader_cfg)

@@ -1,0 +1,264 @@
+"""Megatron-style GPT pretraining dataset over memory-mapped token files.
+
+Behavior parity with reference ``ppfleetx/data/dataset/gpt_dataset.py``:
+  - data files: ``{prefix}_ids.npy`` (all token ids, 1-D) +
+    ``{prefix}_idx.npz`` with ``lens`` per document (:84-96)
+  - train/valid/test doc split from ratio list (:229-250)
+  - doc/sample/shuffle index construction, cached next to the data as
+    ``.npy`` (:253-375); sample index semantics defined by the Python
+    builder (:410-440) — one sample spans ``seq_len + 1`` tokens,
+    consecutive samples overlap by one token (label shift)
+  - sample = (tokens, position_ids, labels, loss_mask) with EOS
+    positions masked out of the loss (:132-150)
+
+The index builders are pure functions here; the C++ fast path
+(``data_tools/cpp``) plugs in via ``_sample_idx_builder`` when built.
+Index construction runs on process rank 0 while other processes wait
+on the cached files (:47-69 spin-wait), using mtime+size validation.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ...utils.log import logger
+
+MODE_TO_INDEX = {"Train": 0, "Eval": 1, "Test": 2}
+
+
+def get_train_data_file(input_dir: str) -> List[str]:
+    """All dataset prefixes in a directory (files named ``*_idx.npz``)."""
+    files = sorted(
+        os.path.join(input_dir, f[: -len("_idx.npz")])
+        for f in os.listdir(input_dir)
+        if f.endswith("_idx.npz")
+        and os.path.isfile(os.path.join(input_dir, f)))
+    if not files:
+        raise RuntimeError(
+            f"no dataset (xxx_ids.npy + xxx_idx.npz) found in {input_dir!r}")
+    return files
+
+
+def get_train_valid_test_split_(splits: Sequence[float],
+                                size: int) -> List[int]:
+    """Split ``size`` docs by normalized ratios into 4 boundary indices."""
+    splits = [float(s) for s in splits]
+    splits += [0.0] * (3 - len(splits))
+    splits = splits[:3]
+    total = sum(splits)
+    if total <= 0:
+        raise ValueError("split ratios must sum to > 0")
+    bounds = [0]
+    for ratio in splits:
+        bounds.append(bounds[-1] + int(round(ratio / total * size)))
+    bounds[-1] = size if len(bounds) == 4 else bounds[-1]
+    diff = bounds[3] - size
+    for i in range(1, 4):
+        bounds[i] -= diff
+    return bounds
+
+
+def _num_epochs(tokens_per_epoch: int, seq_length: int,
+                num_samples: int) -> int:
+    epochs = 0
+    total_tokens = 0
+    while True:
+        epochs += 1
+        total_tokens += tokens_per_epoch
+        if (total_tokens - 1) // seq_length >= num_samples:
+            return epochs
+
+
+def _build_doc_idx(documents: np.ndarray, num_epochs: int,
+                   np_rng: np.random.RandomState,
+                   separate_last_epoch: bool) -> np.ndarray:
+    """Documents repeated per epoch. The reference keeps document order
+    (no shuffle — sample-level shuffling happens in the shuffle index)."""
+    if not separate_last_epoch or num_epochs == 1:
+        return np.tile(np.asarray(documents, np.int32),
+                       num_epochs).astype(np.int32)
+    head = _build_doc_idx(documents, num_epochs - 1, np_rng, False)
+    tail = _build_doc_idx(documents, 1, np_rng, False)
+    return np.concatenate([head, tail])
+
+
+def _build_sample_idx_py(sizes: np.ndarray, doc_idx: np.ndarray,
+                         seq_length: int, num_epochs: int,
+                         tokens_per_epoch: int) -> np.ndarray:
+    """Python sample-index builder — the semantic oracle for the C++
+    fast path (reference ``gpt_dataset.py:410-440``). Row i holds
+    (doc_idx position, in-doc offset) of sample i's first token; row
+    i+1 points one past sample i's last token minus the label overlap."""
+    num_samples = (num_epochs * tokens_per_epoch - 1) // seq_length
+    sample_idx = np.zeros((num_samples + 1, 2), np.int32)
+    di, offset = 0, 0
+    sample_idx[0] = (0, 0)
+    for s in range(1, num_samples + 1):
+        remaining = seq_length + 1
+        while remaining != 0:
+            doc_len = sizes[doc_idx[di]] - offset
+            remaining -= doc_len
+            if remaining <= 0:
+                offset += remaining + doc_len - 1
+                remaining = 0
+            else:
+                di += 1
+                offset = 0
+        sample_idx[s] = (di, offset)
+    return sample_idx
+
+
+def _build_sample_idx(sizes, doc_idx, seq_length, num_epochs,
+                      tokens_per_epoch) -> np.ndarray:
+    try:
+        from ...data.data_tools.cpp import fast_index_map
+        return fast_index_map.build_sample_idx(
+            np.asarray(sizes, np.int32), np.asarray(doc_idx, np.int32),
+            seq_length, num_epochs, tokens_per_epoch)
+    except ImportError:
+        return _build_sample_idx_py(sizes, doc_idx, seq_length, num_epochs,
+                                    tokens_per_epoch)
+
+
+def _build_shuffle_idx(num_samples: int, total_size: int,
+                       np_rng: np.random.RandomState) -> np.ndarray:
+    dtype = np.uint32 if total_size < np.iinfo(np.uint32).max - 1 \
+        else np.int64
+    first = np.arange(num_samples, dtype=dtype)
+    np_rng.shuffle(first)
+    if num_samples == total_size:
+        return first
+    last = np.arange(num_samples, total_size, dtype=dtype)
+    np_rng.shuffle(last)
+    return np.concatenate([first, last])
+
+
+def construct_samples_and_shuffle_data(name: str, data_prefix: str,
+                                       documents: np.ndarray,
+                                       sizes: np.ndarray, num_samples: int,
+                                       seq_length: int, seed: int,
+                                       build_data_file: bool):
+    """Build (or load cached) doc/sample/shuffle indices."""
+    tokens_per_epoch = int(np.sum(sizes[documents]))
+    num_epochs = _num_epochs(tokens_per_epoch, seq_length, num_samples)
+    np_rng = np.random.RandomState(seed=seed)
+
+    stem = f"{data_prefix}_{name}_indexmap_{num_samples}ns_{seq_length}sl"
+    fn_doc = stem + "_doc_idx.npy"
+    fn_sample = stem + "_sample_idx.npy"
+    fn_shuffle = stem + "_shuffle_idx.npy"
+    filenames = (fn_doc, fn_sample, fn_shuffle)
+
+    if build_data_file and not all(os.path.isfile(f) for f in filenames):
+        if num_epochs == 1:
+            separate_last_epoch = False
+        else:
+            samples_before_last = ((num_epochs - 1) * tokens_per_epoch
+                                   - 1) // seq_length
+            last_epoch_samples = num_samples - samples_before_last
+            samples_per_epoch = (tokens_per_epoch - 1) // seq_length
+            if not 0 <= last_epoch_samples <= samples_per_epoch:
+                raise ValueError("inconsistent sample/epoch accounting")
+            separate_last_epoch = (
+                last_epoch_samples < int(0.80 * samples_per_epoch))
+        t0 = time.time()
+        doc_idx = _build_doc_idx(documents, num_epochs, np_rng,
+                                 separate_last_epoch)
+        np.save(fn_doc, doc_idx)
+        sample_idx = _build_sample_idx(sizes, doc_idx, seq_length,
+                                       num_epochs, tokens_per_epoch)
+        np.save(fn_sample, sample_idx)
+        if separate_last_epoch:
+            shuffle_n = samples_before_last
+        else:
+            shuffle_n = sample_idx.shape[0] - 1
+        shuffle_idx = _build_shuffle_idx(shuffle_n,
+                                         sample_idx.shape[0] - 1, np_rng)
+        np.save(fn_shuffle, shuffle_idx)
+        logger.info("built index mappings for %s in %.2fs (%d samples)",
+                    name, time.time() - t0, sample_idx.shape[0] - 1)
+    elif not build_data_file:
+        while not all(os.path.isfile(f) for f in filenames):
+            time.sleep(1)
+
+    doc_idx = np.load(fn_doc, mmap_mode="r")
+    sample_idx = np.load(fn_sample, mmap_mode="r")
+    shuffle_idx = np.load(fn_shuffle, mmap_mode="r")
+    return doc_idx, sample_idx, shuffle_idx
+
+
+class GPTDataset:
+    """Index-mapped LM dataset; ``__getitem__`` returns
+    ``[tokens, position_ids, labels, loss_mask]`` (Test mode: first 2).
+    """
+
+    def __init__(self, input_dir: str, split: Sequence[float],
+                 max_seq_len: int, num_samples: int, mode: str,
+                 seed: int = 1234, eos_id: int = 50256,
+                 build_data_file: Optional[bool] = None):
+        if mode not in MODE_TO_INDEX:
+            raise ValueError(f"mode must be one of {list(MODE_TO_INDEX)}")
+        prefix = get_train_data_file(input_dir)[0]
+        for suffix in ("_ids.npy", "_idx.npz"):
+            if not os.path.isfile(prefix + suffix):
+                raise ValueError(f"file not found: {prefix + suffix}")
+        self.sample_ids = np.load(prefix + "_ids.npy", mmap_mode="r",
+                                  allow_pickle=True)
+        lens = np.load(prefix + "_idx.npz")["lens"].astype(np.int32)
+        self.sample_lens = lens
+
+        bounds = get_train_valid_test_split_(split, len(lens))
+        idx = MODE_TO_INDEX[mode]
+        documents = np.arange(bounds[idx], bounds[idx + 1], dtype=np.int32)
+
+        self.mode = mode
+        self.max_seq_len = max_seq_len
+        self.eos_id = eos_id
+        self.name = "gpt_" + mode
+        if build_data_file is None:
+            import jax
+            build_data_file = jax.process_index() == 0
+        self.doc_idx, self.sample_idx, self.shuffle_idx = \
+            construct_samples_and_shuffle_data(
+                self.name, prefix, documents, lens, num_samples,
+                max_seq_len, seed, build_data_file)
+        self.start_pos = np.concatenate(
+            [[0], np.cumsum(self.sample_lens)]).astype(np.int64)
+
+    def _tokens_for(self, doc_f: int, doc_l: int, off_f: int,
+                    off_l: int) -> np.ndarray:
+        if doc_f == doc_l:
+            start = self.start_pos[self.doc_idx[doc_f]]
+            return np.asarray(
+                self.sample_ids[start + off_f: start + off_l + 1])
+        chunks = []
+        start = self.start_pos[self.doc_idx[doc_f]]
+        end = self.start_pos[self.doc_idx[doc_f] + 1]
+        chunks.append(self.sample_ids[start + off_f: end])
+        for i in range(doc_f + 1, doc_l):
+            start = self.start_pos[self.doc_idx[i]]
+            end = self.start_pos[self.doc_idx[i] + 1]
+            chunks.append(self.sample_ids[start:end])
+        start = self.start_pos[self.doc_idx[doc_l]]
+        chunks.append(self.sample_ids[start: start + off_l + 1])
+        return np.concatenate(chunks)
+
+    def __getitem__(self, index: int):
+        idx = int(self.shuffle_idx[index])
+        doc_f, off_f = self.sample_idx[idx]
+        doc_l, off_l = self.sample_idx[idx + 1]
+        seq = self._tokens_for(int(doc_f), int(doc_l), int(off_f),
+                               int(off_l)).astype(np.int64)
+        tokens, labels = seq[:-1], seq[1:]
+        position_ids = np.arange(len(tokens), dtype=np.int64)
+        if self.mode == "Test":
+            return [tokens, position_ids]
+        loss_mask = (tokens != self.eos_id).astype(np.float32)
+        return [tokens, position_ids, labels, loss_mask]
+
+    def __len__(self) -> int:
+        return self.sample_idx.shape[0] - 1

@@ -1,0 +1,57 @@
+"""Minimal dataloader: sampler-driven batch fetch + thread prefetch.
+
+Replaces ``paddle.io.DataLoader`` (reference ``data/__init__.py:59-90``).
+TPU input pipelines are host-CPU-bound, so a background thread keeps a
+small queue of collated numpy batches ready while the device runs the
+previous step; the engine overlaps the host->HBM transfer with compute
+via ``jax.device_put`` on the next batch.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, Optional
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_sampler,
+                 collate_fn: Optional[Callable] = None,
+                 num_workers: int = 1, prefetch_depth: int = 2, **_):
+        self.dataset = dataset
+        self.batch_sampler = batch_sampler
+        self.collate_fn = collate_fn or (lambda b: b)
+        self.prefetch_depth = max(1, prefetch_depth if num_workers else 1)
+
+    def _produce(self, q: "queue.Queue", stop: threading.Event) -> None:
+        try:
+            for indices in self.batch_sampler:
+                if stop.is_set():
+                    break
+                batch = [self.dataset[i] for i in indices]
+                q.put(("batch", self.collate_fn(batch)))
+        except BaseException as e:  # surface worker errors to consumer
+            q.put(("error", e))
+        finally:
+            q.put(("done", None))
+
+    def __iter__(self) -> Iterator:
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_depth)
+        stop = threading.Event()
+        worker = threading.Thread(target=self._produce, args=(q, stop),
+                                  daemon=True)
+        worker.start()
+        try:
+            while True:
+                kind, payload = q.get()
+                if kind == "batch":
+                    yield payload
+                elif kind == "error":
+                    raise payload
+                else:
+                    break
+        finally:
+            stop.set()
+
+    def __len__(self) -> int:
+        return len(self.batch_sampler)

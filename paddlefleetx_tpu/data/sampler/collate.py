@@ -1,0 +1,89 @@
+"""Batchify combinators (reference ``ppfleetx/data/sampler/collate.py``:
+``Stack``/``Pad``/``Tuple``/``Dict``) and the named collate functions
+dataloaders resolve from YAML (``data/utils/batch_collate_fn.py:94-131``).
+All outputs are numpy — device transfer happens once per step in the
+engine (single host->HBM copy instead of per-field)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+
+class Stack:
+    def __init__(self, dtype: Optional[str] = None, axis: int = 0):
+        self._dtype = dtype
+        self._axis = axis
+
+    def __call__(self, data: List[Any]) -> np.ndarray:
+        out = np.stack(data, axis=self._axis)
+        return out.astype(self._dtype) if self._dtype else out
+
+
+class Pad:
+    def __init__(self, pad_val: float = 0, axis: int = 0,
+                 dtype: Optional[str] = None, pad_right: bool = True):
+        self._pad_val = pad_val
+        self._axis = axis
+        self._dtype = dtype
+        self._pad_right = pad_right
+
+    def __call__(self, data: List[Any]) -> np.ndarray:
+        arrays = [np.asarray(d) for d in data]
+        max_len = max(a.shape[self._axis] for a in arrays)
+        out = []
+        for a in arrays:
+            pad = max_len - a.shape[self._axis]
+            widths = [(0, 0)] * a.ndim
+            widths[self._axis] = (0, pad) if self._pad_right else (pad, 0)
+            out.append(np.pad(a, widths, constant_values=self._pad_val))
+        stacked = np.stack(out)
+        return stacked.astype(self._dtype) if self._dtype else stacked
+
+
+class Tuple:
+    """Apply the i-th combinator to the i-th field of each sample."""
+
+    def __init__(self, *fns):
+        if len(fns) == 1 and isinstance(fns[0], (list, tuple)):
+            fns = tuple(fns[0])
+        self._fns = fns
+
+    def __call__(self, batch) -> tuple:
+        n_fields = len(batch[0])
+        if n_fields != len(self._fns):
+            raise ValueError(
+                f"sample has {n_fields} fields but {len(self._fns)} "
+                f"combinators were given")
+        return tuple(fn([sample[i] for sample in batch])
+                     for i, fn in enumerate(self._fns))
+
+
+class Dict:
+    def __init__(self, fns: dict):
+        self._fns = fns
+
+    def __call__(self, batch) -> dict:
+        return {key: fn([sample[key] for sample in batch])
+                for key, fn in self._fns.items()}
+
+
+def gpt_collate_fn(batch):
+    """(tokens, position_ids, labels, loss_mask) stacked batch."""
+    return Tuple(Stack(), Stack(), Stack(), Stack())(batch)
+
+
+def gpt_inference_collate_fn(batch):
+    return Tuple(Stack(), Stack())(batch)
+
+
+def gpt_eval_collate_fn(batch):
+    return Tuple(Stack(), Stack(), Stack(), Stack(), Stack(), Stack())(batch)
+
+
+COLLATE_FNS: dict[str, Callable] = {
+    "gpt_collate_fn": gpt_collate_fn,
+    "gpt_inference_collate_fn": gpt_inference_collate_fn,
+    "gpt_eval_collate_fn": gpt_eval_collate_fn,
+}

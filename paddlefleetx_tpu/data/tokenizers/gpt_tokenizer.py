@@ -1,0 +1,180 @@
+"""GPT-2 byte-level BPE tokenizer, fully offline.
+
+Behavior parity: reference ``ppfleetx/data/tokenizers/gpt_tokenizer.py``
+(:90-392) implements GPT-2 BPE with downloaded vocab/merges. This
+environment has zero egress, so ``from_pretrained`` resolves files from
+a local directory (``vocab.json`` + ``merges.txt``, standard GPT-2
+format, path or ``PFX_VOCAB_DIR``); without files it falls back to a
+pure byte-level vocab (256 byte tokens + ``<|endoftext|>``) which
+round-trips arbitrary text — enough for pretraining pipelines and
+tests, with the real merges dropped in for production runs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from functools import lru_cache
+from typing import Dict, List, Optional
+
+EOS_TOKEN = "<|endoftext|>"
+#: GPT-2's eos id in the standard 50257-token vocab
+GPT2_EOS_ID = 50256
+
+
+@lru_cache()
+def bytes_to_unicode() -> Dict[int, str]:
+    """GPT-2's reversible byte <-> printable-unicode mapping."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("\xa1"), ord("\xac") + 1))
+          + list(range(ord("\xae"), ord("\xff") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, [chr(c) for c in cs]))
+
+
+def _get_pairs(word):
+    return {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+
+
+# GPT-2 pre-tokenization pattern (contractions / words / numbers /
+# punctuation / whitespace), via the `regex` module when available for
+# \p classes, else a close ASCII approximation.
+try:
+    import regex as _re
+    _PAT = _re.compile(
+        r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+"
+        r"| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+")
+except ImportError:  # pragma: no cover
+    import re as _re
+    _PAT = _re.compile(
+        r"'s|'t|'re|'ve|'m|'ll|'d| ?[A-Za-z]+| ?[0-9]+"
+        r"| ?[^\sA-Za-z0-9]+|\s+(?!\S)|\s+")
+
+
+class GPTTokenizer:
+    """Byte-level BPE; encode/decode/special-token API like the
+    reference's (``gpt_tokenizer.py:90-392``)."""
+
+    def __init__(self, vocab: Optional[Dict[str, int]] = None,
+                 merges: Optional[List[str]] = None,
+                 eos_token: str = EOS_TOKEN):
+        self.byte_encoder = bytes_to_unicode()
+        self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
+        if vocab is None:
+            # byte-level fallback: one token per mapped byte + eos
+            chars = sorted(self.byte_encoder.values())
+            vocab = {c: i for i, c in enumerate(chars)}
+            vocab[eos_token] = len(vocab)
+            merges = []
+        self.encoder = dict(vocab)
+        self.decoder = {v: k for k, v in self.encoder.items()}
+        merges = merges or []
+        self.bpe_ranks = {
+            tuple(m.split()): i for i, m in enumerate(merges)
+            if m and not m.startswith("#version")}
+        self.eos_token = eos_token
+        self.cache: Dict[str, str] = {}
+
+    @property
+    def eos_token_id(self) -> int:
+        return self.encoder[self.eos_token]
+
+    # reference alias: pad/bos default to eos for GPT-2
+    pad_token_id = property(lambda self: self.eos_token_id)
+    bos_token_id = property(lambda self: self.eos_token_id)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.encoder)
+
+    def __len__(self) -> int:
+        return len(self.encoder)
+
+    @classmethod
+    def from_pretrained(cls, path: str = "gpt2") -> "GPTTokenizer":
+        """Load vocab/merges from a directory; fall back to byte-level.
+
+        ``path`` may be a directory containing ``vocab.json`` and
+        ``merges.txt``; the name "gpt2" resolves through the
+        ``PFX_VOCAB_DIR`` env var. Zero-egress: never downloads.
+        """
+        candidates = [path, os.environ.get("PFX_VOCAB_DIR", "")]
+        for cand in candidates:
+            vocab_file = os.path.join(cand, "vocab.json") if cand else ""
+            merges_file = os.path.join(cand, "merges.txt") if cand else ""
+            if os.path.isfile(vocab_file) and os.path.isfile(merges_file):
+                with open(vocab_file, encoding="utf-8") as f:
+                    vocab = json.load(f)
+                with open(merges_file, encoding="utf-8") as f:
+                    merges = f.read().split("\n")
+                return cls(vocab, merges)
+        return cls()
+
+    def _bpe(self, token: str) -> str:
+        if token in self.cache:
+            return self.cache[token]
+        word = tuple(token)
+        pairs = _get_pairs(word)
+        if not pairs:
+            return token
+        while True:
+            bigram = min(
+                pairs, key=lambda p: self.bpe_ranks.get(p, float("inf")))
+            if bigram not in self.bpe_ranks:
+                break
+            first, second = bigram
+            new_word = []
+            i = 0
+            while i < len(word):
+                try:
+                    j = word.index(first, i)
+                except ValueError:
+                    new_word.extend(word[i:])
+                    break
+                new_word.extend(word[i:j])
+                i = j
+                if i < len(word) - 1 and word[i + 1] == second:
+                    new_word.append(first + second)
+                    i += 2
+                else:
+                    new_word.append(word[i])
+                    i += 1
+            word = tuple(new_word)
+            if len(word) == 1:
+                break
+            pairs = _get_pairs(word)
+        out = " ".join(word)
+        self.cache[token] = out
+        return out
+
+    def tokenize(self, text: str) -> List[str]:
+        tokens = []
+        for piece in _PAT.findall(text):
+            piece = "".join(self.byte_encoder[b]
+                            for b in piece.encode("utf-8"))
+            tokens.extend(self._bpe(piece).split(" "))
+        return tokens
+
+    def encode(self, text: str) -> List[int]:
+        return [self.encoder[t] for t in self.tokenize(text)]
+
+    def decode(self, ids) -> str:
+        text = "".join(
+            self.decoder[int(i)] for i in ids
+            if int(i) in self.decoder and self.decoder[int(i)]
+            != self.eos_token)
+        return bytearray(
+            self.byte_decoder[c] for c in text if c in self.byte_decoder
+        ).decode("utf-8", errors="replace")
+
+    def convert_tokens_to_ids(self, tokens: List[str]) -> List[int]:
+        return [self.encoder[t] for t in tokens]
+
+    def convert_ids_to_tokens(self, ids: List[int]) -> List[str]:
+        return [self.decoder[int(i)] for i in ids]

@@ -26,7 +26,7 @@ def build_module(config):
         except ModuleNotFoundError as e:
             # tolerate only the module itself being absent (not yet
             # built); propagate broken imports inside an existing module
-            if e.name is None or not e.name.endswith(mod.split(".")[-1]):
+            if e.name != f"{__package__}.{mod}":
                 raise
     name = config.Model.module
     if name not in _REGISTRY:

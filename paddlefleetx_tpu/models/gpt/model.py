@@ -236,10 +236,15 @@ class GPTModel(nn.Module):
                  use_cache: bool = False, deterministic: bool = True,
                  position_offset=0):
         cfg = self.config
-        if input_ids.shape[-1] > cfg.max_position_embeddings:
+        static_offset = position_offset if isinstance(position_offset, int) \
+            else 0
+        if input_ids.shape[-1] + static_offset > \
+                cfg.max_position_embeddings:
             raise ValueError(
-                f"sequence length {input_ids.shape[-1]} exceeds "
-                f"max_position_embeddings {cfg.max_position_embeddings}")
+                f"sequence length {input_ids.shape[-1]} (+offset "
+                f"{static_offset}) exceeds max_position_embeddings "
+                f"{cfg.max_position_embeddings}; with a traced offset the "
+                f"generation loop must bound prompt+decode length itself")
         if position_ids is None:
             position_ids = position_offset + jnp.arange(
                 input_ids.shape[-1], dtype=jnp.int32)[None, :]

@@ -1,0 +1,58 @@
+"""GPT task modules implementing the BasicModule contract.
+
+Parity: reference ``language_module.py:112-177`` (``GPTModule``: model
+selection by topology, PP batch reshaping, loss wiring). Under GSPMD
+there is no per-topology model class — one ``GPTForPretraining`` with
+logical axes serves single-card, hybrid, and auto; ``GPTModuleAuto``
+is an alias for config compatibility.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+
+from .. import register_module
+from ...core.module import LanguageModule
+from .config import GPTConfig
+from .model import GPTForPretraining, cross_entropy_loss
+
+
+@register_module("GPTModule")
+class GPTModule(LanguageModule):
+    def __init__(self, configs):
+        from ..language_utils import process_configs
+        process_configs(configs)
+        super().__init__(configs)
+
+    def get_model(self):
+        self.model_config = GPTConfig.from_config(self.configs)
+        return GPTForPretraining(self.model_config)
+
+    def loss_fn(self, params, batch, rng, train: bool = True):
+        tokens, position_ids, labels, loss_mask = batch
+        deterministic = not train or (
+            self.model_config.hidden_dropout_prob == 0.0
+            and self.model_config.attention_probs_dropout_prob == 0.0)
+        rngs = None if deterministic else {"dropout": rng}
+        logits = self.model.apply(
+            {"params": params}, tokens, position_ids=position_ids,
+            deterministic=deterministic, rngs=rngs)
+        return cross_entropy_loss(logits, labels, loss_mask)
+
+    def input_spec(self):
+        seq = self.configs.Data.Train.dataset.max_seq_len
+        micro = self.configs.Global.micro_batch_size
+        return [((micro, seq), "int32"), ((micro, seq), "int32")]
+
+    def training_step_end(self, log_dict: Dict[str, Any]) -> None:
+        log_dict.setdefault(
+            "max_seq_len", self.configs.Data.Train.dataset.max_seq_len)
+        super().training_step_end(log_dict)
+
+
+@register_module("GPTModuleAuto")
+class GPTModuleAuto(GPTModule):
+    """The reference's auto-parallel module is the same model here —
+    GSPMD is the auto engine (SURVEY.md §7 design stance)."""

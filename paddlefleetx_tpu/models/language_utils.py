@@ -1,0 +1,66 @@
+"""Language-model config derivations.
+
+Parity: reference ``ppfleetx/models/language_model/utils.py:39-150``:
+  - ``process_data_configs`` (:117-141): per-mode ``num_samples``
+    (train = gbs * max_steps; eval = gbs * (max_steps/eval_freq + 1) *
+    eval_iters; test = gbs * test_iters), seed and batch-size plumbing.
+  - ``process_model_configs`` (:56-110): ffn defaults to 4*hidden,
+    recompute granularity default, virtual-pp divisibility checks.
+"""
+
+from __future__ import annotations
+
+
+def process_model_configs(config) -> None:
+    model = config.Model
+    if model.get("ffn_hidden_size") is None:
+        model["ffn_hidden_size"] = 4 * model["hidden_size"]
+    if model.get("use_recompute"):
+        if not model.get("recompute_granularity"):
+            model["recompute_granularity"] = "full"
+    vpp = model.get("virtual_pp_degree") or 1
+    pp = config.Distributed.pp_degree
+    if vpp > 1:
+        local_batch_size = config.Global.local_batch_size
+        micro_batch_size = config.Global.micro_batch_size
+        if local_batch_size // micro_batch_size % pp != 0:
+            raise ValueError(
+                "micro-batch count must divide pp_degree with virtual "
+                "pipeline stages")
+        if model["num_layers"] % (vpp * pp) != 0:
+            raise ValueError(
+                f"num_layers {model['num_layers']} must be divisible by "
+                f"virtual_pp_degree*pp_degree {vpp * pp}")
+    if model.get("sequence_parallel") and \
+            config.Distributed.mp_degree <= 1:
+        # reference forces SP off when mp<=1 (hybrid_model.py:649-652)
+        model["sequence_parallel"] = False
+
+
+def process_data_configs(config) -> None:
+    g = config.Global
+    engine = config.Engine
+    max_steps = engine.get("max_steps", 500000)
+    eval_freq = engine.get("eval_freq") or max(max_steps, 1)
+    eval_iters = engine.get("eval_iters", 10)
+    test_iters = engine.get("test_iters", eval_iters * 10)
+    mode_to_num_samples = {
+        "Train": g.global_batch_size * max_steps,
+        "Eval": g.global_batch_size *
+        (max_steps // eval_freq + 1) * eval_iters,
+        "Test": g.global_batch_size * test_iters,
+    }
+    for mode, num in mode_to_num_samples.items():
+        if mode in config.get("Data", {}):
+            dataset = config.Data[mode]["dataset"]
+            dataset.setdefault("num_samples", num)
+            dataset.setdefault("mode", mode)
+            dataset.setdefault("seed", g.get("seed", 1024))
+            sampler = config.Data[mode].setdefault("sampler", {})
+            sampler.setdefault("batch_size", g.local_batch_size)
+
+
+def process_configs(config):
+    process_model_configs(config)
+    process_data_configs(config)
+    return config

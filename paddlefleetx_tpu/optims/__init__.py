@@ -1,0 +1,53 @@
+"""Name-driven optimizer / LR factories.
+
+Reference ``ppfleetx/optims/__init__.py:29-62`` resolves YAML names via
+``eval``; here via explicit registries. ``build_optimizer`` folds the
+``grad_clip`` section (ClipGradByGlobalNorm) into the optax chain.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Optional
+
+import optax
+
+from ..utils.log import logger
+from .lr_scheduler import SCHEDULES, cosine_annealing_with_warmup_decay, \
+    vit_lr_scheduler  # noqa: F401
+from .optimizer import OPTIMIZERS, adam, fused_adamw, momentum  # noqa: F401
+
+
+def build_lr_scheduler(lr_config) -> Callable:
+    lr_config = copy.deepcopy(dict(lr_config))
+    name = lr_config.pop("name", None)
+    if name is None:
+        rate = lr_config["learning_rate"]
+        return lambda step: rate
+    if name not in SCHEDULES:
+        raise ValueError(
+            f"unknown lr scheduler {name!r}; available: {sorted(SCHEDULES)}")
+    schedule = SCHEDULES[name](**lr_config)
+    logger.debug("built lr scheduler %s", name)
+    return schedule
+
+
+def build_optimizer(config, lr_scheduler: Optional[Callable] = None
+                    ) -> optax.GradientTransformation:
+    config = copy.deepcopy(dict(config))
+    config.pop("lr", None)
+    config.pop("tensor_fusion", None)       # subsumed by XLA fusion
+    config.pop("multi_precision", None)     # params always fp32 master
+    grad_clip = config.pop("grad_clip", None) or {}
+    clip_name = grad_clip.get("name", "ClipGradByGlobalNorm")
+    if grad_clip and clip_name != "ClipGradByGlobalNorm":
+        raise ValueError(f"unknown grad_clip {clip_name!r}")
+    clip_norm = grad_clip.get("clip_norm")
+    name = config.pop("name")
+    if name not in OPTIMIZERS:
+        raise ValueError(
+            f"unknown optimizer {name!r}; available: {sorted(OPTIMIZERS)}")
+    tx = OPTIMIZERS[name](learning_rate=lr_scheduler,
+                          grad_clip_norm=clip_norm, **config)
+    logger.debug("built optimizer %s", name)
+    return tx

@@ -1,0 +1,66 @@
+"""LR schedules as optax-style callables ``step -> lr``.
+
+Semantics parity with reference ``ppfleetx/optims/lr_scheduler.py``:
+  - ``CosineAnnealingWithWarmupDecay`` (:22-50): linear warmup over
+    ``warmup_rate * decay_steps`` steps to ``max_lr``, cosine decay to
+    ``min_lr`` by ``decay_steps``, flat ``min_lr`` after.
+  - ``ViTLRScheduler`` (:54-91): warmup-scaled cosine or linear decay
+    over ``epochs * step_each_epoch``.
+
+Schedules are pure jnp functions of the step counter so they live
+inside the jitted train step (no host-side LR bookkeeping).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def cosine_annealing_with_warmup_decay(max_lr: float, min_lr: float,
+                                       warmup_rate: float,
+                                       decay_steps: int, **_):
+    warmup_step = warmup_rate * decay_steps
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = max_lr * step / jnp.maximum(warmup_step, 1.0)
+        decay_ratio = (step - warmup_step) / jnp.maximum(
+            decay_steps - warmup_step, 1.0)
+        coeff = 0.5 * (jnp.cos(jnp.pi * decay_ratio) + 1.0)
+        cos = min_lr + coeff * (max_lr - min_lr)
+        lr = jnp.where((warmup_step > 0) & (step <= warmup_step), warm, cos)
+        return jnp.where(step > decay_steps, min_lr, lr)
+
+    return schedule
+
+
+def vit_lr_scheduler(learning_rate: float, step_each_epoch: int, epochs: int,
+                     decay_type: str = "cosine", linear_end: float = 1e-5,
+                     warmup_steps: int = 0, **_):
+    t_max = epochs * step_each_epoch
+    if warmup_steps >= t_max:
+        warmup_steps = t_max - 1
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        progress = (step - warmup_steps) / max(float(t_max - warmup_steps),
+                                               1.0)
+        progress = jnp.clip(progress, 0.0, 1.0)
+        if decay_type == "linear":
+            lr = linear_end + (learning_rate - linear_end) * (1.0 - progress)
+        elif decay_type == "cosine":
+            lr = 0.5 * learning_rate * (1.0 + jnp.cos(jnp.pi * progress))
+        else:
+            raise ValueError(f"unknown decay_type {decay_type!r}")
+        if warmup_steps:
+            lr = lr * jnp.minimum(1.0, step / warmup_steps)
+        return lr
+
+    return schedule
+
+
+# reference class names accepted in YAML `Optimizer.lr.name`
+SCHEDULES = {
+    "CosineAnnealingWithWarmupDecay": cosine_annealing_with_warmup_decay,
+    "ViTLRScheduler": vit_lr_scheduler,
+}

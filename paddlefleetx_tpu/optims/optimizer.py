@@ -1,0 +1,76 @@
+"""Optimizers: optax transforms with reference semantics.
+
+``FusedAdamW`` (reference ``ppfleetx/optims/optimizer.py:29-50``)
+excludes parameters whose name contains "bias" or "norm" from weight
+decay. The tensor-fusion flat-buffer machinery
+(``tensor_fusion_helper.py``) exists because Paddle launches one CUDA
+kernel per parameter; under XLA the whole optimizer update is a single
+fused program, so the knob is accepted and ignored.
+
+``multi_precision`` / AMP-O2 parity: parameters and optimizer moments
+stay fp32 (flax side keeps ``param_dtype=float32``); the model computes
+in bf16. No GradScaler is needed on TPU — bf16 has fp32's exponent
+range, so the reference's ``scale_loss`` knob is accepted and ignored.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import optax
+
+
+def _decay_mask(params) -> Any:
+    """True for leaves that receive weight decay (not bias/norm)."""
+
+    def keyed(path, _):
+        names = [str(getattr(k, "key", k)).lower() for k in path]
+        return not any(("bias" in n) or ("norm" in n) for n in names)
+
+    return jax.tree_util.tree_map_with_path(keyed, params)
+
+
+def fused_adamw(learning_rate: Callable, beta1: float = 0.9,
+                beta2: float = 0.999, epsilon: float = 1e-8,
+                weight_decay: float = 0.01,
+                grad_clip_norm: Optional[float] = None,
+                **_) -> optax.GradientTransformation:
+    txs = []
+    if grad_clip_norm:
+        txs.append(optax.clip_by_global_norm(grad_clip_norm))
+    txs.append(optax.adamw(
+        learning_rate, b1=beta1, b2=beta2, eps=epsilon,
+        weight_decay=weight_decay, mask=_decay_mask))
+    return optax.chain(*txs)
+
+
+def adam(learning_rate: Callable, beta1: float = 0.9, beta2: float = 0.999,
+         epsilon: float = 1e-8, grad_clip_norm: Optional[float] = None,
+         **_) -> optax.GradientTransformation:
+    txs = []
+    if grad_clip_norm:
+        txs.append(optax.clip_by_global_norm(grad_clip_norm))
+    txs.append(optax.adam(learning_rate, b1=beta1, b2=beta2, eps=epsilon))
+    return optax.chain(*txs)
+
+
+def momentum(learning_rate: Callable, momentum: float = 0.9,
+             weight_decay: float = 0.0,
+             grad_clip_norm: Optional[float] = None,
+             **_) -> optax.GradientTransformation:
+    txs = []
+    if grad_clip_norm:
+        txs.append(optax.clip_by_global_norm(grad_clip_norm))
+    if weight_decay:
+        txs.append(optax.add_decayed_weights(weight_decay, mask=_decay_mask))
+    txs.append(optax.sgd(learning_rate, momentum=momentum))
+    return optax.chain(*txs)
+
+
+OPTIMIZERS = {
+    "FusedAdamW": fused_adamw,
+    "AdamW": fused_adamw,
+    "Adam": adam,
+    "Momentum": momentum,
+}

@@ -135,27 +135,46 @@ def data_world_size(mesh: Optional[Mesh] = None) -> int:
     return mesh.shape[DP_AXIS] * mesh.shape[FSDP_AXIS]
 
 
-def process_data_rank(mesh: Optional[Mesh] = None) -> int:
-    """This process's rank among all *processes* ordered along the
-    dataflow (dp x fsdp) axis.
+def _process_data_groups(mesh: Mesh):
+    """Group processes by the set of dataflow coordinates they own.
 
-    Used for per-host data loading: host h feeds batch shards
-    ``[process_data_rank :: jax.process_count()]`` and the engine
-    assembles them into a global array. Processes are ordered by the
-    first dataflow coordinate their local devices own, so consecutive
-    ranks feed consecutive slices of the global batch.
+    Processes whose devices cover the same dataflow (dp x fsdp) slice
+    (e.g. two hosts split along mp or pp) are *replicas* of the same
+    data stream and must load identical batches; distinct coordinate
+    sets are distinct loader ranks. Returns (groups, my_group_index)
+    with groups ordered by their first dataflow coordinate.
     """
-    mesh = mesh or get_mesh()
-    if mesh is None or jax.process_count() == 1:
-        return 0
-    first_coord = {}
+    coords = {}
     for idx, dev in np.ndenumerate(mesh.devices):
         _, dp_i, fsdp_i, _ = idx
         pos = int(dp_i * mesh.shape[FSDP_AXIS] + fsdp_i)
-        p = dev.process_index
-        first_coord[p] = min(first_coord.get(p, 1 << 62), pos)
-    order = sorted(first_coord, key=lambda p: (first_coord[p], p))
-    return order.index(jax.process_index())
+        coords.setdefault(dev.process_index, set()).add(pos)
+    groups = {}
+    for proc, pos_set in coords.items():
+        groups.setdefault(frozenset(pos_set), []).append(proc)
+    ordered = sorted(groups, key=min)
+    me = jax.process_index()
+    mine = next(i for i, g in enumerate(ordered) if me in groups[g])
+    return ordered, mine
+
+
+def process_data_rank(mesh: Optional[Mesh] = None) -> int:
+    """This process's data-loader rank: the index of its dataflow
+    coordinate group. Processes that are mp/pp replicas of the same
+    batch slice share a rank (and must load identical data)."""
+    mesh = mesh or get_mesh()
+    if mesh is None or jax.process_count() == 1:
+        return 0
+    return _process_data_groups(mesh)[1]
+
+
+def process_data_loader_count(mesh: Optional[Mesh] = None) -> int:
+    """Number of distinct data-loader ranks (== distinct dataflow
+    coordinate groups across processes)."""
+    mesh = mesh or get_mesh()
+    if mesh is None or jax.process_count() == 1:
+        return 1
+    return len(_process_data_groups(mesh)[0])
 
 
 def cpu_mesh_env(n: int = 8) -> None:

@@ -125,7 +125,11 @@ def optimizer_state_shardings(opt_state_shapes, param_specs, mesh: Mesh,
 
     def _leaf_sharding(path, shape_dtype):
         spec = _inherited_spec(path)
-        if spec is None or shape_dtype.ndim == 0:
+        if spec is None or shape_dtype.ndim == 0 or \
+                len(spec) > shape_dtype.ndim:
+            # unmatched leaves and factored-optimizer leaves whose rank
+            # differs from the param's (e.g. adafactor row stats) stay
+            # replicated
             return NamedSharding(mesh, P())
         dims = list(spec) + [None] * (shape_dtype.ndim - len(spec))
         if topo.sharding_degree > 1 and topo.sharding_stage < 3:

@@ -58,16 +58,6 @@ class AttrDict(dict):
             out[k] = copy.deepcopy(v, memo)
         return out
 
-    def setdefault_path(self, *keys, default=None):
-        """Walk nested keys, creating AttrDicts; return the leaf."""
-        node = self
-        for k in keys[:-1]:
-            if not isinstance(node.get(k), dict):
-                node[k] = AttrDict()
-            node = node[k]
-        return node.setdefault(keys[-1], default)
-
-
 def _attrify(obj: Any) -> Any:
     """Recursively convert dicts to AttrDict and literal-eval str leaves."""
     if isinstance(obj, dict):
@@ -114,7 +104,14 @@ def parse_config(cfg_file: str) -> AttrDict:
             dic = _merge(dic, base_dic)
         return dic
 
-    return _attrify(_load(cfg_file))
+    def _strip_markers(node):
+        if isinstance(node, dict):
+            node.pop("_inherited_", None)
+            for v in node.values():
+                _strip_markers(v)
+        return node
+
+    return _attrify(_strip_markers(_load(cfg_file)))
 
 
 def _coerce(v: str) -> Any:

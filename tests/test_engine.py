@@ -1,0 +1,140 @@
+"""End-to-end engine tests on the 8-device CPU mesh: loss decreases,
+checkpoints round-trip, resume fast-forwards — the reference's TIPC
+smoke semantics (SURVEY §4) as proper unit tests."""
+
+import numpy as np
+import pytest
+
+from paddlefleetx_tpu.core import Engine
+from paddlefleetx_tpu.data import build_dataloader
+from paddlefleetx_tpu.models import build_module
+from paddlefleetx_tpu.utils.config import AttrDict, process_configs
+
+from test_data import make_corpus
+
+
+def tiny_config(tmp_path, **overrides):
+    cfg = AttrDict({
+        "Global": AttrDict({
+            "device": "cpu", "seed": 1024,
+            "global_batch_size": None, "local_batch_size": 8,
+            "micro_batch_size": 4,
+        }),
+        "Engine": AttrDict({
+            "max_steps": 10, "logging_freq": 5, "eval_freq": 100,
+            "eval_iters": 2,
+            "mix_precision": AttrDict({"use_pure_fp16": False}),
+            "save_load": AttrDict({"save_steps": 100,
+                                   "output_dir": str(tmp_path / "out")}),
+        }),
+        "Model": AttrDict({
+            "module": "GPTModule", "name": "GPT",
+            "vocab_size": 128, "hidden_size": 32, "num_layers": 2,
+            "num_attention_heads": 4, "ffn_hidden_size": 64,
+            "max_position_embeddings": 64,
+            "hidden_dropout_prob": 0.0,
+            "attention_probs_dropout_prob": 0.0,
+        }),
+        "Distributed": AttrDict({
+            "dp_degree": 2, "mp_degree": 2, "pp_degree": 1,
+            "sharding": AttrDict({"sharding_degree": 2,
+                                  "sharding_stage": 1}),
+        }),
+        "Optimizer": AttrDict({
+            "name": "FusedAdamW", "weight_decay": 0.01,
+            "beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8,
+            "lr": AttrDict({"name": "CosineAnnealingWithWarmupDecay",
+                            "decay_steps": 100, "warmup_rate": 0.1,
+                            "max_lr": 1e-2, "min_lr": 1e-3}),
+            "grad_clip": AttrDict({"name": "ClipGradByGlobalNorm",
+                                   "clip_norm": 1.0}),
+        }),
+        "Data": AttrDict({"Train": AttrDict({
+            "dataset": AttrDict({
+                "name": "GPTDataset", "input_dir": str(tmp_path),
+                "split": [1, 0, 0], "max_seq_len": 32,
+                "num_samples": 400, "mode": "Train", "eos_id": 127,
+                "build_data_file": True}),
+            "sampler": AttrDict({"name": "GPTBatchSampler",
+                                 "batch_size": 8, "shuffle": False,
+                                 "drop_last": True}),
+            "loader": AttrDict({"collate_fn": "gpt_collate_fn"}),
+        })}),
+    })
+    for path, value in overrides.items():
+        node = cfg
+        keys = path.split(".")
+        for k in keys[:-1]:
+            node = node[k]
+        node[keys[-1]] = value
+    return process_configs(cfg, nranks=8)
+
+
+def _build(tmp_path, **overrides):
+    make_corpus(tmp_path, n_docs=40, doc_len_range=(20, 60), vocab=128,
+                eos=127)
+    cfg = tiny_config(tmp_path, **overrides)
+    module = build_module(cfg)
+    engine = Engine(cfg, module, mode="train")
+    # global batch: sampler covers all 8 dataflow slots from one process
+    loader = build_dataloader(cfg.Data, "Train", num_replicas=1, rank=0)
+    # sampler batch = per-process batch = global batch (single process)
+    loader.batch_sampler.batch_size = cfg.Global.global_batch_size
+    return cfg, engine, loader
+
+
+def test_fit_loss_decreases(tmp_path):
+    cfg, engine, loader = _build(tmp_path)
+    losses = []
+
+    orig = engine.module.training_step_end
+
+    def capture(log):
+        losses.append(log["loss"])
+        orig(log)
+
+    engine.module.training_step_end = capture
+    engine.fit(epoch=1, train_data_loader=loader)
+    assert len(losses) == 2  # 10 steps, logging_freq 5
+    assert losses[-1] < np.log(128)  # below uniform-random loss
+
+
+def test_grad_accumulation_matches_single_batch(tmp_path):
+    """acc=2 over the same global batch == acc=1 numerics."""
+    cfg1, e1, loader1 = _build(tmp_path, **{"Engine.max_steps": 1})
+    batch = next(iter(loader1))
+    s1, m1 = e1._run_one(batch) if hasattr(e1, "_run_one") else (None, None)
+    # run manually through both engines on the identical batch
+    import flax.linen as nn
+    with e1.mesh, nn.logical_axis_rules(e1.rules):
+        _, metrics1 = e1._train_step(e1.state, e1._put_batch(batch))
+
+    cfg2, e2, _ = _build(tmp_path, **{
+        "Engine.max_steps": 1, "Global.micro_batch_size": 2})
+    assert e2.accumulate_steps == 4
+    with e2.mesh, nn.logical_axis_rules(e2.rules):
+        _, metrics2 = e2._train_step(e2.state, e2._put_batch(batch))
+    np.testing.assert_allclose(float(metrics1["loss"]),
+                               float(metrics2["loss"]), rtol=1e-5)
+
+
+def test_checkpoint_save_load_resume(tmp_path):
+    cfg, engine, loader = _build(tmp_path, **{"Engine.max_steps": 3})
+    engine.fit(epoch=1, train_data_loader=loader)
+    engine.save(epoch=1)
+    step = int(engine.state["step"])
+    params_before = jax.tree.map(np.asarray, engine.state["params"])
+
+    cfg2, engine2, _ = _build(
+        tmp_path, **{"Engine.max_steps": 3,
+                     "Engine.save_load.ckpt_dir": str(tmp_path / "out")})
+    assert int(engine2.state["step"]) == step
+    assert engine2._load_recovery["consumed_samples"] == \
+        step * cfg.Global.global_batch_size
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        params_before, engine2.state["params"])
+
+
+import jax  # noqa: E402  (used in helpers above)

@@ -1,0 +1,101 @@
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from paddlefleetx_tpu.optims import (
+    build_lr_scheduler, build_optimizer, cosine_annealing_with_warmup_decay,
+    vit_lr_scheduler,
+)
+from paddlefleetx_tpu.utils.config import AttrDict
+
+
+def _reference_cosine(step, max_lr, min_lr, warmup_rate, decay_steps):
+    """Direct transcription of the reference formula for oracle checks
+    (reference lr_scheduler.py:40-50)."""
+    warmup_step = warmup_rate * decay_steps
+    if warmup_step > 0 and step <= warmup_step:
+        return max_lr * step / warmup_step
+    if step > decay_steps:
+        return min_lr
+    ratio = (step - warmup_step) / (decay_steps - warmup_step)
+    coeff = 0.5 * (math.cos(math.pi * ratio) + 1.0)
+    return min_lr + coeff * (max_lr - min_lr)
+
+
+def test_cosine_warmup_matches_reference_formula():
+    sched = cosine_annealing_with_warmup_decay(
+        max_lr=5e-5, min_lr=1e-5, warmup_rate=0.01, decay_steps=1000)
+    for step in [0, 1, 5, 10, 11, 500, 999, 1000, 1001, 5000]:
+        expect = _reference_cosine(step, 5e-5, 1e-5, 0.01, 1000)
+        np.testing.assert_allclose(float(sched(step)), expect, rtol=1e-6,
+                                   err_msg=f"step={step}")
+
+
+def test_vit_scheduler_cosine_and_linear():
+    for decay_type in ("cosine", "linear"):
+        sched = vit_lr_scheduler(learning_rate=3e-3, step_each_epoch=100,
+                                 epochs=3, decay_type=decay_type,
+                                 warmup_steps=20)
+        lr0, lr20, lr299 = (float(sched(s)) for s in (0, 20, 299))
+        assert lr0 == 0.0
+        assert lr20 == pytest.approx(3e-3, rel=1e-5)
+        assert lr299 < 3e-4
+
+
+def test_build_from_yaml_section():
+    opt_cfg = AttrDict({
+        "name": "FusedAdamW", "weight_decay": 0.01, "beta1": 0.9,
+        "beta2": 0.999, "epsilon": 1e-8, "tensor_fusion": False,
+        "lr": {"name": "CosineAnnealingWithWarmupDecay",
+               "decay_steps": 100, "warmup_rate": 0.1,
+               "max_lr": 1e-3, "min_lr": 1e-5},
+        "grad_clip": {"name": "ClipGradByGlobalNorm", "clip_norm": 1.0},
+    })
+    sched = build_lr_scheduler(opt_cfg.lr)
+    tx = build_optimizer(opt_cfg, sched)
+    params = {"dense": {"kernel": jnp.ones((4, 4)), "bias": jnp.ones((4,))},
+              "norm1": {"scale": jnp.ones((4,))}}
+    state = tx.init(params)
+    grads = jax.tree.map(jnp.ones_like, params)
+    updates, _ = tx.update(grads, state, params)
+    assert jax.tree_util.tree_structure(updates) == \
+        jax.tree_util.tree_structure(params)
+
+
+def test_weight_decay_skips_bias_and_norm():
+    opt_cfg = AttrDict({"name": "FusedAdamW", "weight_decay": 0.5,
+                        "beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8})
+    tx = build_optimizer(opt_cfg, lambda s: 0.1)
+    params = {"dense": {"kernel": jnp.full((2, 2), 2.0),
+                        "bias": jnp.full((2,), 2.0)},
+              "norm1": {"scale": jnp.full((2,), 2.0)}}
+    state = tx.init(params)
+    zero_grads = jax.tree.map(jnp.zeros_like, params)
+    updates, _ = tx.update(zero_grads, state, params)
+    # with zero grads, only decayed params receive a nonzero update
+    assert float(jnp.abs(updates["dense"]["kernel"]).sum()) > 0
+    assert float(jnp.abs(updates["dense"]["bias"]).sum()) == 0
+    assert float(jnp.abs(updates["norm1"]["scale"]).sum()) == 0
+
+
+def test_grad_clip_global_norm():
+    opt_cfg = AttrDict({"name": "FusedAdamW", "weight_decay": 0.0,
+                        "grad_clip": {"clip_norm": 1.0}})
+    tx = build_optimizer(opt_cfg, lambda s: 1.0)
+    params = {"w": jnp.zeros((4,))}
+    state = tx.init(params)
+    big = {"w": jnp.full((4,), 100.0)}
+    updates, _ = tx.update(big, state, params)
+    # clipped grad -> bounded first Adam step (|update| <= lr)
+    assert float(jnp.abs(updates["w"]).max()) <= 1.0 + 1e-6
+
+
+def test_unknown_names_raise():
+    with pytest.raises(ValueError):
+        build_optimizer(AttrDict({"name": "Nope"}), lambda s: 1.0)
+    with pytest.raises(ValueError):
+        build_lr_scheduler(AttrDict({"name": "Nope"}))

@@ -1,0 +1,57 @@
+"""Pretraining entry point.
+
+Parity: reference ``tools/train.py:37-67`` — parse config, init the
+distributed env, build module/dataloaders/engine, fit. Run as:
+
+  python tools/train.py -c configs/nlp/gpt/pretrain_gpt_345M_single_card.yaml \
+      -o Engine.max_steps=100
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+from paddlefleetx_tpu.core import Engine  # noqa: E402
+from paddlefleetx_tpu.data import build_dataloader  # noqa: E402
+from paddlefleetx_tpu.models import build_module  # noqa: E402
+from paddlefleetx_tpu.utils import env  # noqa: E402
+from paddlefleetx_tpu.utils.config import get_config, parse_args  # noqa: E402
+from paddlefleetx_tpu.utils.log import logger  # noqa: E402
+
+
+def main():
+    args = parse_args()
+    env.init_dist_env()
+    cfg = get_config(args.config, overrides=args.override, show=True)
+
+    module = build_module(cfg)
+    engine = Engine(cfg, module, mode="train")
+
+    from paddlefleetx_tpu.parallel.mesh import (
+        process_data_loader_count, process_data_rank,
+    )
+    data_world = process_data_loader_count(engine.mesh)
+    rank = process_data_rank(engine.mesh)
+    train_loader = build_dataloader(cfg.Data, "Train",
+                                    num_replicas=data_world, rank=rank)
+    valid_loader = build_dataloader(cfg.Data, "Eval",
+                                    num_replicas=data_world, rank=rank)
+    if train_loader is not None:
+        # per-process slice of the global batch
+        train_loader.batch_sampler.batch_size = \
+            cfg.Global.global_batch_size // data_world
+    if valid_loader is not None:
+        valid_loader.batch_sampler.batch_size = \
+            cfg.Global.global_batch_size // data_world
+
+    engine.fit(epoch=cfg.Engine.get("num_train_epochs", 1),
+               train_data_loader=train_loader,
+               valid_data_loader=valid_loader)
+    logger.info("training finished")
+
+
+if __name__ == "__main__":
+    main()
