@@ -224,10 +224,21 @@ class Engine(BasicEngine):
         """Collated numpy tuple -> global device arrays sharded over the
         dataflow axis (multi-host: each process contributes its slice).
         """
+        from ..parallel.mesh import data_world_size, \
+            process_data_loader_count
+        data_size = data_world_size(self.mesh)
+        n_loaders = process_data_loader_count(self.mesh)
+
         def put(x):
             x = np.asarray(x)
-            sharding = NamedSharding(
-                self.mesh, P(DATA_AXES, *([None] * (x.ndim - 1))))
+            # batches indivisible by the dataflow axis (small offline
+            # eval sets) are replicated instead of sharded; the check
+            # uses the GLOBAL batch dim (local rows x distinct loader
+            # ranks), not the process-local one
+            global_rows = x.shape[0] * n_loaders
+            spec = P(DATA_AXES, *([None] * (x.ndim - 1))) \
+                if global_rows % data_size == 0 else P()
+            sharding = NamedSharding(self.mesh, spec)
             if jax.process_count() == 1:
                 return jax.device_put(x, sharding)
             return jax.make_array_from_process_local_data(sharding, x)
@@ -280,17 +291,21 @@ class Engine(BasicEngine):
                     step_start = time.time()
                 if step % self.eval_freq == 0 and \
                         valid_data_loader is not None:
-                    self._evaluate_impl(epoch, valid_data_loader)
+                    self._evaluate_impl(epoch, valid_data_loader,
+                                        max_iters=self.eval_iters)
                     step_start = time.time()
                 if step % self.save_steps == 0:
                     self.save(epoch)
                     step_start = time.time()
 
-    def _evaluate_impl(self, epoch: int, valid_data_loader):
+    def _evaluate_impl(self, epoch: int, valid_data_loader,
+                       max_iters: Optional[int] = None):
+        """Mid-train eval caps at ``eval_iters``; offline ``evaluate``
+        walks the whole loader (reference ``_evaluate_one_epoch``)."""
         losses = []
         t0 = time.time()
         for i, batch in enumerate(valid_data_loader):
-            if i >= self.eval_iters:
+            if max_iters is not None and i >= max_iters:
                 break
             batch = self.module.pretreating_batch(batch)
             out = self._eval_step(self.state, self._put_batch(batch))
@@ -298,7 +313,11 @@ class Engine(BasicEngine):
             self.module.validation_step_end({
                 "epoch": epoch, "batch": i, "loss": losses[-1],
                 "eval_cost": (time.time() - t0) / (i + 1)})
-        return float(np.mean(losses)) if losses else float("nan")
+        mean = float(np.mean(losses)) if losses else float("nan")
+        self.module.validation_epoch_end(
+            {"epoch": epoch, "loss": mean,
+             "eval_cost": time.time() - t0})
+        return mean
 
     def evaluate(self, epoch: int = 1, valid_data_loader=None):
         with self.mesh, nn.logical_axis_rules(self.rules):

@@ -1,0 +1,157 @@
+"""Jit-compiled autoregressive generation with a fixed-capacity cache.
+
+Parity: reference ``GPTForGeneration(Hybrid).forward/sample``
+(``hybrid_model.py:1208-1433``): left-padded prompts, temperature /
+top-k / top-p sampling, min-length + repetition-penalty processors,
+KV-cached decode. The reference fights dygraph-to-static conversion
+with a growing cache and a Python while-loop (:1322-1347); here the
+whole generate is ONE compiled program: prefill + ``lax.scan`` over a
+static number of decode steps, cache preallocated at
+``max_position_embeddings`` slots, finished rows emit ``pad`` tokens.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .config import GPTConfig
+from .processors import (
+    min_length_processor, repetition_penalty_processor, top_k_filter,
+    top_p_filter, NEG_INF,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationConfig:
+    """Knobs named as in the reference YAML ``Generation`` section."""
+    max_dec_len: int = 20
+    min_dec_len: int = 0
+    decode_strategy: str = "sampling"   # sampling | greedy_search
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    repetition_penalty: float = 1.0
+    eos_token_id: int = 50256
+    pad_token_id: int = 50256
+
+    @classmethod
+    def from_config(cls, section) -> "GenerationConfig":
+        import dataclasses as dc
+        fields = {f.name for f in dc.fields(cls)}
+        kwargs = {k: v for k, v in dict(section or {}).items()
+                  if k in fields and v is not None}
+        return cls(**kwargs)
+
+
+def _decode_bias(valid_keys: jax.Array, dtype=jnp.float32) -> jax.Array:
+    """[b, kv] validity -> additive [b, 1, 1, kv] bias."""
+    return jnp.where(valid_keys, 0.0, NEG_INF)[:, None, None, :].astype(
+        dtype)
+
+
+@partial(jax.jit, static_argnames=("model", "gen_cfg"))
+def generate(model, params, input_ids: jax.Array,
+             attention_mask: Optional[jax.Array], rng: jax.Array,
+             gen_cfg: GenerationConfig) -> jax.Array:
+    """Returns generated token ids ``[b, max_dec_len]``.
+
+    ``input_ids`` is left-padded ``[b, prompt_len]``;
+    ``attention_mask`` marks real tokens (1) vs pads (0), or None for
+    unpadded prompts.
+    """
+    cfg: GPTConfig = model.config
+    b, prompt_len = input_ids.shape
+    capacity = cfg.max_position_embeddings
+    if prompt_len + gen_cfg.max_dec_len > capacity:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_dec_len "
+            f"({gen_cfg.max_dec_len}) exceeds the cache capacity "
+            f"{capacity} (= max_position_embeddings)")
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, prompt_len), jnp.int32)
+    attention_mask = attention_mask.astype(jnp.int32)
+    lengths = attention_mask.sum(axis=-1)                      # [b]
+    position_ids = jnp.clip(
+        jnp.cumsum(attention_mask, axis=-1) - 1, 0)
+
+    # key-slot validity over the cache: prompt slots follow the pad
+    # mask, decode slots become valid as they are written
+    pad_cols = jnp.zeros((b, capacity - prompt_len), jnp.int32)
+    base_valid = jnp.concatenate([attention_mask, pad_cols], axis=-1)
+
+    # -- prefill -------------------------------------------------------
+    # keys span the full preallocated cache during cached prefill, so
+    # the pad bias covers all capacity slots (causality masks the rest)
+    logits, mutated = model.apply(
+        {"params": params}, input_ids, position_ids=position_ids,
+        attn_bias=_decode_bias(base_valid.astype(bool)),
+        use_cache=True, deterministic=True, mutable=["cache"])
+    cache = mutated["cache"]
+    last_logits = logits[:, -1, :].astype(jnp.float32)
+
+    appeared0 = jnp.zeros((b, cfg.vocab_size), bool)
+    appeared0 = appeared0.at[
+        jnp.arange(b)[:, None], input_ids].set(attention_mask > 0)
+
+    def sample_token(logits, appeared, step_idx, step_rng):
+        logits = repetition_penalty_processor(
+            logits, appeared, gen_cfg.repetition_penalty)
+        # step_idx == tokens generated before this sample: EOS stays
+        # banned until min_dec_len tokens exist (reference
+        # MinLengthLogitsProcessor counts the same way)
+        logits = min_length_processor(
+            logits, step_idx, gen_cfg.min_dec_len,
+            gen_cfg.eos_token_id)
+        if gen_cfg.decode_strategy == "greedy_search":
+            return jnp.argmax(logits, axis=-1)
+        logits = logits / jnp.maximum(gen_cfg.temperature, 1e-6)
+        logits = top_k_filter(logits, gen_cfg.top_k)
+        logits = top_p_filter(logits, gen_cfg.top_p)
+        return jax.random.categorical(step_rng, logits, axis=-1)
+
+    def body(carry, step_idx):
+        cache, logits, appeared, finished, valid = carry
+        step_rng = jax.random.fold_in(rng, step_idx)
+        token = sample_token(logits, appeared, step_idx, step_rng)
+        token = jnp.where(finished, gen_cfg.pad_token_id, token)
+        finished = finished | (token == gen_cfg.eos_token_id)
+        appeared = appeared.at[jnp.arange(b), token].set(True)
+
+        # the new key lands at slot prompt_len + step_idx
+        slot = prompt_len + step_idx
+        valid = valid.at[:, slot].set(1)
+        step_pos = (lengths + step_idx)[:, None]               # [b, 1]
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, token[:, None],
+            position_ids=step_pos,
+            attn_bias=_decode_bias(valid.astype(bool)),
+            use_cache=True, deterministic=True, mutable=["cache"])
+        cache = mutated["cache"]
+        next_logits = logits[:, -1, :].astype(jnp.float32)
+        return (cache, next_logits, appeared, finished, valid), token
+
+    finished0 = jnp.zeros((b,), bool)
+    (_, _, _, _, _), tokens = jax.lax.scan(
+        body, (cache, last_logits, appeared0, finished0, base_valid),
+        jnp.arange(gen_cfg.max_dec_len))
+    return tokens.T  # [b, max_dec_len]
+
+
+def left_pad_batch(sequences, pad_id: int):
+    """Left-pad a list of id lists to the max length
+    (reference ``language_module.py:221-243`` left_padding)."""
+    import numpy as np
+    max_len = max(len(s) for s in sequences)
+    ids = np.full((len(sequences), max_len), pad_id, np.int32)
+    mask = np.zeros((len(sequences), max_len), np.int32)
+    for i, s in enumerate(sequences):
+        if len(s) == 0:
+            raise ValueError("empty prompt")
+        ids[i, max_len - len(s):] = s
+        mask[i, max_len - len(s):] = 1
+    return ids, mask

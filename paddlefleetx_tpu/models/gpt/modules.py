@@ -56,3 +56,144 @@ class GPTModule(LanguageModule):
 class GPTModuleAuto(GPTModule):
     """The reference's auto-parallel module is the same model here —
     GSPMD is the auto engine (SURVEY.md §7 design stance)."""
+
+
+@register_module("GPTGenerationModule")
+class GPTGenerationModule(GPTModule):
+    """Text in -> sampled text out (reference
+    ``language_module.py:179-275``: tokenize, left-pad, sample,
+    decode)."""
+
+    def __init__(self, configs):
+        super().__init__(configs)
+        from ...data.tokenizers.gpt_tokenizer import GPTTokenizer
+        from .generation import GenerationConfig
+        self.tokenizer = GPTTokenizer.from_pretrained(
+            configs.get("Generation", {}).get("vocab_dir", "gpt2"))
+        gen_section = dict(configs.get("Generation", {}))
+        gen_section.setdefault("eos_token_id", self.tokenizer.eos_token_id)
+        gen_section.setdefault("pad_token_id", self.tokenizer.pad_token_id)
+        self.generation_cfg = GenerationConfig.from_config(gen_section)
+
+    def generate(self, params, texts, rng=None):
+        import jax
+        import numpy as np
+        from .generation import generate, left_pad_batch
+        if isinstance(texts, str):
+            texts = [texts]
+        encoded = [self.tokenizer.encode(t) for t in texts]
+        ids, mask = left_pad_batch(encoded, self.tokenizer.pad_token_id)
+        rng = rng if rng is not None else jax.random.key(
+            self.configs.Global.get("seed", 1024))
+        out = np.asarray(generate(self.model, params, ids, mask, rng,
+                                  self.generation_cfg))
+        results = []
+        for row in out:
+            row = row.tolist()
+            if self.generation_cfg.eos_token_id in row:
+                row = row[: row.index(self.generation_cfg.eos_token_id)]
+            results.append(self.tokenizer.decode(row))
+        return results
+
+
+@register_module("GPTEvalModule")
+class GPTEvalModule(GPTModule):
+    """Offline WikiText-PPL / LAMBADA-accuracy evaluation (reference
+    ``language_module.py:277-389``)."""
+
+    def __init__(self, configs):
+        self.eval_cfgs = configs.Offline_Eval
+        self.cloze_eval = bool(self.eval_cfgs.get("cloze_eval", False))
+        self._post_process_configs(configs)
+        super().__init__(configs)
+        self.total_score = 0.0
+        self.first_step = True
+        self.num_original_tokens = None
+        self.num_tokenized_tokens = None
+        self.num_examples = None
+
+    def _post_process_configs(self, configs):
+        data_eval = configs.Data.Eval
+        data_eval.dataset["input_dir"] = self.eval_cfgs.eval_path
+        data_eval.dataset["max_seq_len"] = self.eval_cfgs.get(
+            "max_seq_len", data_eval.dataset.get("max_seq_len", 1024))
+        if self.cloze_eval:
+            data_eval.dataset["name"] = "Lambada_Eval_Dataset"
+        else:
+            data_eval.dataset["name"] = "LM_Eval_Dataset"
+            data_eval.dataset["overlapping_eval"] = self.eval_cfgs.get(
+                "overlapping_eval", 32)
+        data_eval["loader"] = data_eval.get("loader") or {}
+        data_eval.loader["collate_fn"] = "gpt_eval_collate_fn"
+        data_eval["sampler"] = {
+            "name": "GPTBatchSampler",
+            "batch_size": self.eval_cfgs.get("batch_size", 8),
+            "shuffle": False, "drop_last": False}
+
+    def loss_fn(self, params, batch, rng, train: bool = False):
+        """Eval score for one batch: summed NLL (LM) or number of
+        exactly-correct cloze completions (LAMBADA)."""
+        import jax.numpy as jnp
+        from .model import cross_entropy_loss  # noqa: F401
+        import jax
+        tokens, loss_mask, _attn, position_ids, labels, _info = batch
+        logits = self.model.apply(
+            {"params": params}, tokens, position_ids=position_ids,
+            deterministic=True)
+        logits = logits.astype(jnp.float32)
+        if not self.cloze_eval:
+            logz = jax.scipy.special.logsumexp(logits, axis=-1)
+            label_logits = jnp.take_along_axis(
+                logits, labels[..., None], axis=-1)[..., 0]
+            return jnp.sum((logz - label_logits) * loss_mask)
+        preds = jnp.argmax(logits, axis=-1)
+        correct = jnp.where(loss_mask > 0, preds == labels, True)
+        return jnp.sum(jnp.prod(correct.astype(jnp.float32), axis=-1))
+
+    def pretreating_batch(self, batch):
+        if self.first_step:
+            info = batch[-1]
+            if self.cloze_eval:
+                self.num_examples = int(info[0][0])
+            else:
+                self.num_original_tokens = int(info[0][0])
+                self.num_tokenized_tokens = int(info[0][1])
+            self.first_step = False
+        return batch
+
+    def validation_step_end(self, log_dict):
+        from ...utils.log import logger
+        if not self.cloze_eval:
+            self.total_score += log_dict["loss"] / (
+                self.num_tokenized_tokens - 1)
+            name = "loss"
+        else:
+            self.total_score += log_dict["loss"]
+            name = "number correct"
+        logger.eval("[eval] epoch: %d, batch: %d, %s: %.9f",
+                    log_dict["epoch"], log_dict["batch"], name,
+                    self.total_score)
+
+    def validation_epoch_end(self, log_dict):
+        import math
+        from ...utils.log import logger
+        if not self.cloze_eval:
+            total_loss = float(self.total_score)
+            ppl = math.exp(min(20, total_loss))
+            token_ratio = (self.num_tokenized_tokens - 1) / (
+                self.num_original_tokens - 1)
+            adjusted_ppl = math.exp(min(20, total_loss * token_ratio))
+            logger.info(
+                "validation results | avg loss: %.4E | ppl: %.4E | "
+                "adjusted ppl: %.4E | token ratio: %s", total_loss, ppl,
+                adjusted_ppl, token_ratio)
+            self.metrics = {"loss": total_loss, "ppl": ppl,
+                            "adjusted_ppl": adjusted_ppl}
+        else:
+            correct = float(self.total_score)
+            acc = correct / self.num_examples
+            logger.info(
+                "validation results | number correct: %.4E | total "
+                "examples: %.4E | avg accuracy: %.4E", correct,
+                self.num_examples, acc)
+            self.metrics = {"acc": acc, "correct": correct}

@@ -1,0 +1,71 @@
+"""Logits processors for generation, as pure jnp transforms.
+
+Parity: reference ``gpt/dygraph/processor.py:22-192`` (HF-style
+min-length, repetition penalty, forced BOS/EOS; Hamming diversity is
+beam-search-only and beams are out of scope for the sampling path).
+Each processor maps ``(logits [b, V], state) -> logits`` and composes
+inside the jitted decode loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9
+
+
+def min_length_processor(logits: jax.Array, cur_len, min_length: int,
+                         eos_token_id: int) -> jax.Array:
+    """Suppress EOS while the generated length is below ``min_length``
+    (reference ``MinLengthLogitsProcessor``)."""
+    suppress = cur_len < min_length
+    eos_mask = jnp.arange(logits.shape[-1]) == eos_token_id
+    return jnp.where(suppress & eos_mask[None, :], NEG_INF, logits)
+
+
+def repetition_penalty_processor(logits: jax.Array, appeared: jax.Array,
+                                 penalty: float) -> jax.Array:
+    """Penalize already-generated tokens (reference
+    ``RepetitionPenaltyLogitsProcessor``): positive scores divided by
+    the penalty, negative scores multiplied."""
+    if penalty == 1.0:
+        return logits
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(appeared, penalized, logits)
+
+
+def forced_token_processor(logits: jax.Array, force: jax.Array,
+                           token_id: int) -> jax.Array:
+    """Force ``token_id`` where ``force`` is set (reference
+    ``ForcedBOS/EOSTokenLogitsProcessor``)."""
+    vocab = jnp.arange(logits.shape[-1]) == token_id
+    forced = jnp.where(vocab[None, :], 0.0, NEG_INF)
+    return jnp.where(force[:, None], forced, logits)
+
+
+def top_k_filter(logits: jax.Array, top_k: int) -> jax.Array:
+    """Keep the k highest-scoring tokens (reference ``TopKProcess``,
+    ``hybrid_model.py:1150-1160``)."""
+    if top_k <= 0:
+        return logits
+    top_k = min(top_k, logits.shape[-1])
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def top_p_filter(logits: jax.Array, top_p: float) -> jax.Array:
+    """Nucleus filtering (reference ``TopPProcess``,
+    ``hybrid_model.py:1163-1187``): keep the smallest set of tokens
+    whose cumulative probability exceeds ``top_p``."""
+    if top_p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # mask tokens once the cumulative mass *before* them exceeds top_p
+    keep_sorted = (cum - probs) < top_p
+    threshold = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
+        keepdims=True)
+    return jnp.where(logits < threshold, NEG_INF, logits)

@@ -1,0 +1,40 @@
+"""Interactive / scripted text generation from a trained checkpoint.
+
+Parity: reference ``tasks/gpt/generation.py:33-62`` (config -> module
+-> load checkpoint -> ``module.generate``).
+
+  python tasks/gpt/generation.py -c configs/nlp/gpt/generation_gpt_345M_single_card.yaml \
+      -o Engine.save_load.ckpt_dir=./output --text "Historia est vitae"
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from paddlefleetx_tpu.core import Engine  # noqa: E402
+from paddlefleetx_tpu.models import build_module  # noqa: E402
+from paddlefleetx_tpu.utils.config import get_config  # noqa: E402
+from paddlefleetx_tpu.utils.log import logger  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-c", "--config", required=True)
+    parser.add_argument("-o", "--override", action="append", default=[])
+    parser.add_argument("--text", default="Where is the capital of France?")
+    args = parser.parse_args()
+
+    cfg = get_config(args.config, overrides=args.override)
+    cfg.Model.module = "GPTGenerationModule"
+    module = build_module(cfg)
+    engine = Engine(cfg, module, mode="eval")
+    outputs = module.generate(engine.state["params"], args.text)
+    for text in outputs:
+        logger.info("generated: %s", text)
+    return outputs
+
+
+if __name__ == "__main__":
+    main()

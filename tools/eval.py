@@ -1,0 +1,32 @@
+"""Offline evaluation entry point (WikiText PPL / LAMBADA accuracy).
+
+Parity: reference ``tools/eval.py:33-53``. Run as:
+
+  python tools/eval.py -c configs/nlp/gpt/eval_gpt_345M_single_card.yaml \
+      -o Offline_Eval.eval_path=./wikitext-103/wiki.valid.tokens
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from paddlefleetx_tpu.core import Engine  # noqa: E402
+from paddlefleetx_tpu.data import build_dataloader  # noqa: E402
+from paddlefleetx_tpu.models import build_module  # noqa: E402
+from paddlefleetx_tpu.utils.config import get_config, parse_args  # noqa: E402
+
+
+def main():
+    args = parse_args()
+    cfg = get_config(args.config, overrides=args.override, show=True)
+    cfg.Model.module = "GPTEvalModule"
+    module = build_module(cfg)
+    engine = Engine(cfg, module, mode="eval")
+    loader = build_dataloader(cfg.Data, "Eval")
+    engine.evaluate(epoch=0, valid_data_loader=loader)
+    return module.metrics
+
+
+if __name__ == "__main__":
+    main()
