@@ -97,6 +97,7 @@ class Engine(BasicEngine):
 
         self._load_recovery = {"epoch": 0, "step": 0,
                                "consumed_samples": 0}
+        self._host_step = 0
         self._init_state()
         self._build_steps()
         if self.ckpt_dir:
@@ -188,14 +189,22 @@ class Engine(BasicEngine):
                 zero = jax.tree.map(
                     lambda p: jnp.zeros(p.shape, jnp.float32), params)
 
-                def body(carry, mb):
+                def body(carry, mb_with_idx):
+                    mb_idx, mb = mb_with_idx
                     loss_sum, grad_sum = carry
-                    loss, grads = jax.value_and_grad(loss_for)(params, mb)
+                    # fresh dropout stream per microbatch (the single
+                    # step-level rng would repeat masks across the
+                    # accumulation scan)
+                    mb_rng = jax.random.fold_in(rng, mb_idx)
+                    loss, grads = jax.value_and_grad(
+                        lambda p, m: module.loss_fn(p, m, mb_rng,
+                                                    train=True))(params, mb)
                     grad_sum = jax.tree.map(jnp.add, grad_sum, grads)
                     return (loss_sum + loss, grad_sum), None
 
                 (loss, grads), _ = jax.lax.scan(
-                    body, (jnp.zeros((), jnp.float32), zero), micro)
+                    body, (jnp.zeros((), jnp.float32), zero),
+                    (jnp.arange(acc), micro))
                 loss = loss / acc
                 grads = jax.tree.map(lambda g: g / acc, grads)
 
@@ -212,13 +221,14 @@ class Engine(BasicEngine):
                                   train=False)
             return {"loss": loss}
 
-        batch_sharding = NamedSharding(self.mesh, P(DATA_AXES))
         if self.mode == "train":
             self._train_step = jax.jit(
                 train_step, donate_argnums=(0,),
                 out_shardings=(self.state_shardings, None))
         self._eval_step = jax.jit(eval_step)
-        self._batch_sharding = batch_sharding
+        model = self.module.model
+        self._apply_fn = jax.jit(lambda p, ids: model.apply(
+            {"params": p}, ids, deterministic=True))
 
     def _put_batch(self, batch):
         """Collated numpy tuple -> global device arrays sharded over the
@@ -269,15 +279,18 @@ class Engine(BasicEngine):
     def _train_one_epoch(self, epoch: int, train_data_loader,
                          valid_data_loader=None):
         step_start = time.time()
+        # host-side mirror of state["step"]: reading the device scalar
+        # every iteration would sync and kill async dispatch
+        step = self._host_step
         with self.mesh, nn.logical_axis_rules(self.rules):
             for batch in train_data_loader:
-                step = int(self.state["step"])
                 if step >= self.max_steps:
                     return
                 batch = self.module.pretreating_batch(batch)
                 self.state, metrics = self._train_step(
                     self.state, self._put_batch(batch))
                 step += 1
+                self._host_step = step
                 if step % self.logging_freq == 0:
                     metrics = jax.device_get(metrics)
                     cost = (time.time() - step_start) / self.logging_freq
@@ -325,9 +338,6 @@ class Engine(BasicEngine):
 
     def predict(self, epoch: int = 1, test_data_loader=None):
         outs = []
-        model = self.module.model
-        apply = jax.jit(lambda p, ids: model.apply(
-            {"params": p}, ids, deterministic=True))
         with self.mesh, nn.logical_axis_rules(self.rules):
             for i, batch in enumerate(test_data_loader):
                 if i >= self.test_iters:
@@ -335,16 +345,15 @@ class Engine(BasicEngine):
                 batch = self.module.pretreating_batch(batch)
                 tokens = self._put_batch(batch)[0]
                 outs.append(jax.device_get(
-                    apply(self.state["params"], tokens)))
+                    self._apply_fn(self.state["params"], tokens)))
         return outs
 
     # -- checkpoint -----------------------------------------------------
 
     def save(self, epoch: int = 0):
-        if jax.process_index() != 0 and jax.process_count() > 1:
-            # orbax coordinates multi-host saves internally; every
-            # process participates in the same call
-            pass
+        # every process participates: orbax coordinates multi-host
+        # saves internally (unlike the reference's dp_rank-0-only
+        # writes, eager_engine.py:590-592)
         step = int(self.state["step"])
         meta = {
             "epoch": epoch, "step": step,
@@ -370,6 +379,7 @@ class Engine(BasicEngine):
             "step": meta.get("step", 0),
             "consumed_samples": meta.get("consumed_samples", 0),
         }
+        self._host_step = self._load_recovery["step"]
         logger.info("resumed at epoch %s step %s",
                     self._load_recovery["epoch"],
                     self._load_recovery["step"])

@@ -41,8 +41,11 @@ def _populate():
             Lambada_Eval_Dataset, LM_Eval_Dataset)
         DATASETS.setdefault("LM_Eval_Dataset", LM_Eval_Dataset)
         DATASETS.setdefault("Lambada_Eval_Dataset", Lambada_Eval_Dataset)
-    except ImportError:
-        pass
+    except ModuleNotFoundError as e:
+        # tolerate only this optional module being absent; broken
+        # imports inside it must propagate
+        if e.name != f"{__package__}.dataset.gpt_dataset_eval":
+            raise
 
 
 def build_dataset(config, mode: str):

@@ -23,17 +23,30 @@ class DataLoader:
         self.collate_fn = collate_fn or (lambda b: b)
         self.prefetch_depth = max(1, prefetch_depth if num_workers else 1)
 
+    def _put(self, q: "queue.Queue", stop: threading.Event, item) -> bool:
+        """Put with stop-polling so an abandoned consumer (early break
+        from the iterator) never leaves the producer parked forever on
+        a full queue."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _produce(self, q: "queue.Queue", stop: threading.Event) -> None:
         try:
             for indices in self.batch_sampler:
                 if stop.is_set():
-                    break
+                    return
                 batch = [self.dataset[i] for i in indices]
-                q.put(("batch", self.collate_fn(batch)))
+                if not self._put(q, stop, ("batch", self.collate_fn(batch))):
+                    return
         except BaseException as e:  # surface worker errors to consumer
-            q.put(("error", e))
+            self._put(q, stop, ("error", e))
         finally:
-            q.put(("done", None))
+            self._put(q, stop, ("done", None))
 
     def __iter__(self) -> Iterator:
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_depth)

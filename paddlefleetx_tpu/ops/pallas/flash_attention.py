@@ -1,0 +1,294 @@
+"""Causal flash attention for TPU, written in Pallas.
+
+Replaces the reference's fused CUDA causal softmax
+(``incubate.softmax_mask_fuse_upper_triangle``, reference
+``single_model.py:198`` / ``hybrid_model.py:277``) — and goes further:
+the reference still materializes the full ``[b, h, s, s]`` score
+matrix (SURVEY.md §5.7); this kernel never does. FlashAttention-2
+style: online softmax over KV blocks with running max / sum / output
+accumulator held in VMEM scratch, fp32 accumulation, bf16 block
+matmuls on the MXU. Forward saves the per-row logsumexp; backward is
+two more Pallas kernels (dKV over the KV-block grid, dQ over the
+Q-block grid) wired through ``jax.custom_vjp``.
+
+Layout: ``[b, s, h, d]`` at the API, ``[b*h, s, d]`` internally; the
+TPU grid is ``(bh, outer_block, inner_block)`` — the innermost axis
+runs sequentially on-core, so VMEM scratch persists across the inner
+loop.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    """Interpreter mode lets CPU tests validate kernel semantics
+    (``PFX_PALLAS_INTERPRET=1``)."""
+    return os.environ.get("PFX_PALLAS_INTERPRET") == "1"
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_KV = 512
+
+
+def _causal_mask(qi, ki, block_q, block_kv, offset):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 0) + offset
+    k_pos = ki * block_kv + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 1)
+    return k_pos <= q_pos
+
+
+def _dot(a, b, trans_a=False, trans_b=False):
+    dims = ((0,) if trans_a else (1,), (1,) if trans_b else (0,))
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+# -- forward -----------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                acc_scr, *, sm_scale, causal, block_q, block_kv, num_kv,
+                query_offset):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    live = (qi * block_q + block_q - 1 + query_offset
+            >= ki * block_kv) if causal else ki >= 0
+
+    @pl.when(live)
+    def _block():
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        s = _dot(q, k, trans_b=True) * sm_scale        # [bq, bkv] f32
+        if causal:
+            s = jnp.where(
+                _causal_mask(qi, ki, block_q, block_kv, query_offset),
+                s, NEG_INF)
+        m_prev = m_scr[:]                              # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + _dot(p.astype(v.dtype), v)
+        m_scr[:] = m_new
+
+    @pl.when(ki == num_kv - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:] + jnp.log(l))
+
+
+def _flash_forward(q, k, v, sm_scale, causal, query_offset, block_q,
+                   block_kv):
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    num_q, num_kv = sq // block_q, skv // block_kv
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_kv=block_kv, num_kv=num_kv, query_offset=query_offset)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+
+
+# -- backward ----------------------------------------------------------
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
+                    block_q, block_kv, num_q, query_offset):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    live = (qi * block_q + block_q - 1 + query_offset
+            >= ki * block_kv) if causal else qi >= 0
+
+    @pl.when(live)
+    def _block():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse = lse_ref[0]                                # [bq, 1]
+        delta = delta_ref[0]                            # [bq, 1]
+        s = _dot(q, k, trans_b=True) * sm_scale         # [bq, bkv]
+        if causal:
+            s = jnp.where(
+                _causal_mask(qi, ki, block_q, block_kv, query_offset),
+                s, NEG_INF)
+        p = jnp.exp(s - lse)                            # [bq, bkv]
+        dv_scr[:] += _dot(p.astype(do.dtype), do, trans_a=True)
+        dp = _dot(do, v, trans_b=True)                  # [bq, bkv]
+        ds = p * (dp - delta) * sm_scale
+        dk_scr[:] += _dot(ds.astype(q.dtype), q, trans_a=True)
+
+    @pl.when(qi == num_q - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *, sm_scale, causal, block_q,
+                   block_kv, num_kv, query_offset):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = (qi * block_q + block_q - 1 + query_offset
+            >= ki * block_kv) if causal else ki >= 0
+
+    @pl.when(live)
+    def _block():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse, delta = lse_ref[0], delta_ref[0]
+        s = _dot(q, k, trans_b=True) * sm_scale
+        if causal:
+            s = jnp.where(
+                _causal_mask(qi, ki, block_q, block_kv, query_offset),
+                s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = _dot(do, v, trans_b=True)
+        ds = p * (dp - delta) * sm_scale
+        dq_scr[:] += _dot(ds.astype(k.dtype), k)
+
+    @pl.when(ki == num_kv - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
+                    block_kv):
+    q, k, v, out, lse = res
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    num_q, num_kv = sq // block_q, skv // block_kv
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)             # [bh, sq, 1]
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
+    r_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
+    kv_spec = pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_kv=block_kv, num_q=num_q,
+            query_offset=query_offset),
+        grid=(bh, num_kv, num_q),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, r_spec, r_spec],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[jax.ShapeDtypeStruct((bh, skv, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, skv, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32),
+                        pltpu.VMEM((block_kv, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, g, lse, delta)
+
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    r_spec2 = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    kv_spec2 = pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_kv=block_kv, num_kv=num_kv,
+            query_offset=query_offset),
+        grid=(bh, num_q, num_kv),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, r_spec2,
+                  r_spec2],
+        out_specs=q_spec2,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+# -- public API --------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, sm_scale, causal, block_q, block_kv):
+    out, _ = _flash_forward(q, k, v, sm_scale, causal, 0, block_q,
+                            block_kv)
+    return out
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_kv):
+    out, lse = _flash_forward(q, k, v, sm_scale, causal, 0, block_q,
+                              block_kv)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_kv, res, g):
+    return _flash_backward(res, g, sm_scale, causal, 0, block_q,
+                           block_kv)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True, query_offset=0,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_kv: int = DEFAULT_BLOCK_KV):
+    """``[b, s, h, d]`` causal attention; raises NotImplementedError
+    when the shape/backend can't take the kernel (caller falls back to
+    the XLA path in ``ops.attention``)."""
+    if jax.default_backend() != "tpu" and not _interpret():
+        raise NotImplementedError("flash kernel targets TPU")
+    if not isinstance(query_offset, int) or query_offset != 0:
+        raise NotImplementedError("cached decode uses the XLA path")
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    block_q = min(block_q, sq)
+    block_kv = min(block_kv, skv)
+    if sq % block_q or skv % block_kv:
+        raise NotImplementedError(
+            f"sequence ({sq}, {skv}) not divisible by blocks "
+            f"({block_q}, {block_kv})")
+    if d % 128 and d not in (64,):
+        raise NotImplementedError(f"head_dim {d} unsupported")
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), d ** -0.5, causal,
+                 block_q, block_kv)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
