@@ -168,7 +168,10 @@ class Engine(BasicEngine):
 
     def _build_steps(self):
         module = self.module
-        acc = self.accumulate_steps
+        # with pipeline parallelism the module's loss_fn microbatches
+        # internally (the pipeline IS the accumulation loop, as in the
+        # reference's train_batch, eager_engine.py:406-415)
+        acc = 1 if self.topo.pp_degree > 1 else self.accumulate_steps
         tx, schedule = self.tx, self.lr_schedule
         root_rng = self.root_rng
 

@@ -305,10 +305,98 @@ class GPTForPretraining(nn.Module):
             "word_embeddings"]
         if isinstance(word_emb, nn.Partitioned):
             word_emb = word_emb.value
-        logits = jnp.einsum("bsh,vh->bsv", x,
-                            word_emb.astype(x.dtype))
-        return with_logical_constraint(logits,
-                                       ("batch", "seq", "act_vocab"))
+        return tied_logits(x, word_emb)
+
+
+def tied_logits(x: jax.Array, word_emb: jax.Array) -> jax.Array:
+    """LM head against the (vocab-sharded) embedding table; GSPMD
+    keeps the logits vocab-sharded (reference ``parallel_matmul``,
+    ``hybrid_model.py:45-66``)."""
+    logits = jnp.einsum("bsh,vh->bsv", x, word_emb.astype(x.dtype))
+    return with_logical_constraint(logits, ("batch", "seq", "act_vocab"))
+
+
+def masked_nll_sums(logits: jax.Array, labels: jax.Array,
+                    loss_mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """fp32 masked token NLL: ``(sum of nll over unmasked, mask sum)``.
+
+    The shared core of the pretraining criterion and the offline-eval
+    scorer; with vocab-sharded logits GSPMD turns the log-sum-exp and
+    gather into the psum-based sharded softmax the reference's
+    ``ParallelCrossEntropy`` (``hybrid_model.py:799``) hand-writes.
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(
+        logits, labels[..., None], axis=-1)[..., 0]
+    mask = loss_mask.astype(jnp.float32).reshape(logz.shape)
+    return jnp.sum((logz - label_logits) * mask), jnp.sum(mask)
+
+
+def pipelined_lm_loss(cfg: GPTConfig, params, input_ids, labels,
+                      loss_mask, *, pp: int, num_microbatches: int,
+                      rng=None, position_ids=None,
+                      deterministic: bool = True) -> jax.Array:
+    """Masked-CE pretraining loss with the decoder stack pipelined
+    over the ``pp`` mesh axis.
+
+    The pipe twin of ``GPTForPretraining`` — but unlike the
+    reference's ``GPTForPretrainingPipe`` (a different module class
+    with per-rank ``LayerDesc`` params, ``hybrid_model.py:862-962``)
+    this consumes the *same* parameter tree as the non-pipe model:
+    embeddings and final norm run replicated over ``pp``, the stacked
+    ``[L, ...]`` decoder params are pipelined, and the LM head + loss
+    run per-microbatch on the last stage's output (the reference
+    computes per-microbatch loss inside ``train_batch`` the same way).
+    The tied-embedding logits need no ``SharedLayerDesc``: the single
+    embedding table serves both ends.
+    """
+    from ...parallel.pipeline import pipeline_forward
+
+    if not cfg.scan_layers:
+        raise ValueError("pipeline parallelism requires scan_layers=True "
+                         "(stacked decoder params)")
+    if position_ids is None:
+        position_ids = jnp.broadcast_to(
+            jnp.arange(input_ids.shape[-1], dtype=jnp.int32)[None, :],
+            input_ids.shape)
+    rng = rng if rng is not None else jax.random.key(0)
+    emb_rng, pipe_rng = jax.random.split(rng)
+
+    emb_params = params["gpt"]["embeddings"]
+    x = GPTEmbeddings(cfg).apply(
+        {"params": emb_params}, input_ids, position_ids, deterministic,
+        rngs=None if deterministic else {"dropout": emb_rng})
+
+    def layer_apply(lp, h, key):
+        return TransformerDecoderLayer(cfg, scanned=False).apply(
+            {"params": lp}, h, None, False, deterministic,
+            rngs=None if deterministic else {"dropout": key})
+    if cfg.use_recompute:
+        layer_apply = jax.checkpoint(
+            layer_apply, policy=_remat_policy(cfg.recompute_granularity))
+
+    ln = nn.LayerNorm(epsilon=1e-5, dtype=jnp.dtype(cfg.dtype),
+                      param_dtype=jnp.dtype(cfg.param_dtype))
+    fn_params = params["gpt"]["final_norm"]
+    word_emb = emb_params["word_embeddings"]
+    if isinstance(word_emb, nn.Partitioned):
+        word_emb = word_emb.value
+
+    def head_and_loss(acc, y, ex):
+        labels_mb, mask_mb = ex
+        h = ln.apply({"params": fn_params}, y)
+        nll, msum = masked_nll_sums(tied_logits(h, word_emb),
+                                    labels_mb, mask_mb)
+        return (acc[0] + nll, acc[1] + msum)
+
+    nll_sum, mask_sum = pipeline_forward(
+        layer_apply, params["gpt"]["decoder"], x,
+        pp=pp, num_microbatches=num_microbatches,
+        out_fn=head_and_loss,
+        out_init=(jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        extras=(labels, loss_mask), rng=pipe_rng)
+    return nll_sum / jnp.maximum(mask_sum, 1.0)
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
@@ -316,15 +404,7 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
     """Masked LM criterion (reference ``GPTPretrainingCriterion``,
     ``single_model.py:619-653``): mean NLL over unmasked positions.
 
-    Computed in fp32 regardless of compute dtype; with vocab-sharded
-    logits GSPMD turns the log-sum-exp and gather into the same
-    psum-based sharded softmax the reference's ``ParallelCrossEntropy``
-    (``hybrid_model.py:799``) implements by hand.
+    Computed in fp32 regardless of compute dtype (``masked_nll_sums``).
     """
-    logits = logits.astype(jnp.float32)
-    logz = jax.scipy.special.logsumexp(logits, axis=-1)
-    label_logits = jnp.take_along_axis(
-        logits, labels[..., None], axis=-1)[..., 0]
-    nll = logz - label_logits
-    loss_mask = loss_mask.astype(jnp.float32).reshape(nll.shape)
-    return jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
+    nll_sum, mask_sum = masked_nll_sums(logits, labels, loss_mask)
+    return nll_sum / jnp.maximum(mask_sum, 1.0)

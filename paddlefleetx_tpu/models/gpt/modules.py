@@ -35,6 +35,19 @@ class GPTModule(LanguageModule):
         deterministic = not train or (
             self.model_config.hidden_dropout_prob == 0.0
             and self.model_config.attention_probs_dropout_prob == 0.0)
+        pp = (self.configs.get("Distributed") or {}).get("pp_degree", 1) \
+            or 1
+        if pp > 1:
+            from .model import pipelined_lm_loss
+            # microbatch count = accumulate_steps (reference
+            # ``utils/config.py:117``); eval batches that don't divide
+            # fall back to a single microbatch
+            acc = self.configs.Engine.get("accumulate_steps", 1) or 1
+            m = acc if tokens.shape[0] % acc == 0 else 1
+            return pipelined_lm_loss(
+                self.model_config, params, tokens, labels, loss_mask,
+                pp=pp, num_microbatches=m, rng=rng,
+                position_ids=position_ids, deterministic=deterministic)
         rngs = None if deterministic else {"dropout": rng}
         logits = self.model.apply(
             {"params": params}, tokens, position_ids=position_ids,
@@ -134,18 +147,14 @@ class GPTEvalModule(GPTModule):
         """Eval score for one batch: summed NLL (LM) or number of
         exactly-correct cloze completions (LAMBADA)."""
         import jax.numpy as jnp
-        from .model import cross_entropy_loss  # noqa: F401
-        import jax
+        from .model import masked_nll_sums
         tokens, loss_mask, _attn, position_ids, labels, _info = batch
         logits = self.model.apply(
             {"params": params}, tokens, position_ids=position_ids,
             deterministic=True)
-        logits = logits.astype(jnp.float32)
         if not self.cloze_eval:
-            logz = jax.scipy.special.logsumexp(logits, axis=-1)
-            label_logits = jnp.take_along_axis(
-                logits, labels[..., None], axis=-1)[..., 0]
-            return jnp.sum((logz - label_logits) * loss_mask)
+            return masked_nll_sums(logits, labels, loss_mask)[0]
+        logits = logits.astype(jnp.float32)
         preds = jnp.argmax(logits, axis=-1)
         correct = jnp.where(loss_mask > 0, preds == labels, True)
         return jnp.sum(jnp.prod(correct.astype(jnp.float32), axis=-1))

@@ -20,6 +20,15 @@ def process_model_configs(config) -> None:
             model["recompute_granularity"] = "full"
     vpp = model.get("virtual_pp_degree") or 1
     pp = config.Distributed.pp_degree
+    if pp > 1:
+        if model["num_layers"] % pp != 0:
+            raise ValueError(
+                f"num_layers {model['num_layers']} must be divisible by "
+                f"pp_degree {pp}")
+        if model.get("scan_layers") is False:
+            raise ValueError(
+                "pipeline parallelism requires scan_layers (stacked "
+                "decoder params sharded over the pp axis)")
     if vpp > 1:
         local_batch_size = config.Global.local_batch_size
         micro_batch_size = config.Global.micro_batch_size

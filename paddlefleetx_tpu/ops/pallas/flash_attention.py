@@ -283,6 +283,13 @@ def flash_attention(q, k, v, causal: bool = True, query_offset=0,
         raise NotImplementedError(
             f"sequence ({sq}, {skv}) not divisible by blocks "
             f"({block_q}, {block_kv})")
+    if block_q % 8 or block_kv % 128:
+        # clamped blocks (short sequences) must still be TPU
+        # tile-aligned — sublane 8 for q rows, lane 128 for kv columns;
+        # Mosaic would reject unaligned blocks with a compile error
+        # that the NotImplementedError fallback can't catch
+        raise NotImplementedError(
+            f"blocks ({block_q}, {block_kv}) not tile-aligned")
     if d % 128 and d not in (64,):
         raise NotImplementedError(f"head_dim {d} unsupported")
 

@@ -1,0 +1,184 @@
+"""Pipeline parallelism: an SPMD microbatch pipeline over the ``pp`` axis.
+
+The reference's PP stack is bespoke machinery inside Paddle —
+``PipelineLayer`` flattens the model into ``LayerDesc`` lists
+(reference ``hybrid_model.py:895-961``), a 1F1B scheduler drives
+``train_batch`` with NCCL P2P send/recv between stage ranks
+(``eager_engine.py:406-415``), and shared embeddings are tied across
+first/last stages via ``SharedLayerDesc``.
+
+TPU-native design: none of that machinery is rank-local here. The
+whole pipeline is ONE jitted SPMD program:
+
+  - layer parameters stay in the same stacked ``[L, ...]`` layout the
+    scan-over-layers model already uses, sharded over ``pp`` on the
+    leading axis (stage s owns layers ``[s*L/S, (s+1)*L/S)``), so
+    checkpoints are topology-portable — unlike the reference's
+    per-rank ``pdparams`` dirs;
+  - a ``[S, microbatch, ...]`` stage buffer is sharded over ``pp``;
+    each pipeline tick runs every stage's local layers in parallel
+    (a ``vmap`` over stages of a ``lax.scan`` over the stage's
+    layers) and advances the buffer with ``jnp.roll``, which GSPMD
+    lowers to a collective-permute between ICI neighbors — the NCCL
+    P2P of the reference;
+  - the GPipe fill/drain schedule is a ``lax.scan`` over
+    ``M + S - 1`` ticks; microbatch gradient accumulation falls out
+    of ``jax.grad`` through that scan (the backward pass pipelines in
+    reverse automatically, where the reference needed a hand-written
+    1F1B backward);
+  - embeddings and the LM head are compute-replicated over ``pp``
+    (their FLOPs are negligible next to the decoder stack), which
+    makes the reference's ``SharedLayerDesc`` embedding tying
+    (``hybrid_model.py:934-945``) trivial: there is only one
+    embedding table, visible to both ends of the pipeline.
+
+Schedule note: this is GPipe (bubble fraction ``(S-1)/(M+S-1)``).
+The reference's default is 1F1B, which has the same bubble but lower
+peak activation memory; under XLA the remat policy covers most of
+that difference. Interleaved/virtual stages (``virtual_pp_degree``)
+map to a circular schedule and are validated but not yet scheduled
+differently.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXES, PP_AXIS, get_mesh
+
+
+def _constrain(x, spec: P):
+    """Sharding constraint against the active mesh (no-op without one)."""
+    mesh = get_mesh()
+    if mesh is None or mesh.size == 1:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def pipeline_forward(
+    layer_apply: Callable[[Any, jax.Array, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    *,
+    pp: int,
+    num_microbatches: int,
+    out_fn: Optional[Callable[[Any, jax.Array, Any], Any]] = None,
+    out_init: Any = None,
+    extras: Any = None,
+    rng: Optional[jax.Array] = None,
+) -> Any:
+    """Run ``x`` through ``L`` stacked layers with a ``pp``-stage
+    microbatch pipeline.
+
+    Args:
+      layer_apply: ``(layer_params, h, rng_key) -> h`` — one decoder
+        layer as a pure function (wrap with ``jax.checkpoint`` for
+        recompute before passing).
+      stacked_params: pytree whose leaves have leading dim ``L``
+        (``nn.scan`` layout), ``L % pp == 0``.
+      x: ``[B, ...]`` input activations, ``B % num_microbatches == 0``.
+      pp: number of pipeline stages (== mesh ``pp`` axis size).
+      num_microbatches: M; the reference's ``accumulate_steps``
+        (``utils/config.py:117``).
+      out_fn: optional per-microbatch reducer ``(acc, y_mb, extras_mb)
+        -> acc`` applied to the last stage's output (e.g. LM head +
+        loss). When given, the full ``[B, ...]`` output is never
+        materialized — the pipelined analogue of the reference
+        computing loss per microbatch inside ``train_batch``.
+      out_init: initial reducer carry (required with ``out_fn``).
+      extras: pytree of ``[B, ...]`` arrays sliced per-microbatch and
+        fed to ``out_fn`` (labels, loss masks).
+      rng: base dropout key; folded per (tick, stage, layer).
+
+    Returns the reducer carry, or the ``[B, ...]`` outputs when
+    ``out_fn`` is None.
+    """
+    S, M = pp, num_microbatches
+    leaves = jax.tree.leaves(stacked_params)
+    if not leaves:
+        raise ValueError("stacked_params has no leaves")
+    L = leaves[0].shape[0]
+    if L % S != 0:
+        raise ValueError(f"num_layers {L} not divisible by pp {S}")
+    Ls = L // S
+    B = x.shape[0]
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+
+    x_mb = x.reshape(M, B // M, *x.shape[1:])
+    x_mb = _constrain(x_mb, P(None, DATA_AXES))
+    stage_params = jax.tree.map(
+        lambda p: _constrain(p.reshape(S, Ls, *p.shape[1:]),
+                             P(PP_AXIS)), stacked_params)
+    extras_mb = None
+    if extras is not None:
+        extras_mb = jax.tree.map(
+            lambda e: e.reshape(M, B // M, *e.shape[1:]), extras)
+
+    state0 = _constrain(jnp.zeros((S,) + x_mb.shape[1:], x.dtype),
+                        P(PP_AXIS, DATA_AXES))
+    collect = out_fn is None
+    acc0 = jnp.zeros_like(x_mb) if collect else out_init
+    base_rng = rng if rng is not None else jax.random.key(0)
+
+    def tick(carry, t):
+        state, acc = carry
+        # stage 0 ingests microbatch t (clamped past the fill phase —
+        # the drain ticks feed it a stale microbatch whose output is
+        # never collected)
+        inp = jax.lax.dynamic_index_in_dim(
+            x_mb, jnp.minimum(t, M - 1), 0, keepdims=False)
+        state = _constrain(state.at[0].set(inp), P(PP_AXIS, DATA_AXES))
+
+        tick_rng = jax.random.fold_in(base_rng, t)
+        stage_rngs = jax.vmap(
+            lambda i: jax.random.fold_in(tick_rng, i))(jnp.arange(S))
+
+        def stage_fn(sp, h, key):
+            def body(h, xs):
+                lp, k = xs
+                return layer_apply(lp, h, k), None
+            h, _ = jax.lax.scan(body, h, (sp, jax.random.split(key, Ls)))
+            return h
+
+        processed = jax.vmap(stage_fn)(stage_params, state, stage_rngs)
+        processed = _constrain(processed, P(PP_AXIS, DATA_AXES))
+
+        # collect the last stage's output for microbatch t-(S-1); ticks
+        # before the pipeline is full carry warmup garbage — the cond
+        # skips the collection (and the reducer's head/loss FLOPs)
+        # entirely on those ticks
+        y = processed[-1]
+        idx = jnp.clip(t - (S - 1), 0, M - 1)
+        valid = t >= S - 1
+        if collect:
+            acc = jax.lax.cond(
+                valid,
+                lambda a: jax.lax.dynamic_update_index_in_dim(
+                    a, y, idx, 0),
+                lambda a: a, acc)
+        else:
+            def reduce(a):
+                ex = None
+                if extras_mb is not None:
+                    ex = jax.tree.map(
+                        lambda e: jax.lax.dynamic_index_in_dim(
+                            e, idx, 0, keepdims=False), extras_mb)
+                return out_fn(a, y, ex)
+            acc = jax.lax.cond(valid, reduce, lambda a: a, acc)
+
+        # advance the pipeline: stage s+1's next input is stage s's
+        # output — GSPMD lowers this roll over the pp-sharded axis to
+        # a collective-permute (the reference's NCCL P2P send/recv)
+        state = jnp.roll(processed, 1, axis=0)
+        return (state, acc), None
+
+    (_, acc), _ = jax.lax.scan(tick, (state0, acc0),
+                               jnp.arange(M + S - 1))
+    if collect:
+        return acc.reshape(B, *x.shape[1:])
+    return acc

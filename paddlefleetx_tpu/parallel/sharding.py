@@ -28,7 +28,9 @@ import flax.linen as nn
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import DATA_AXES, DP_AXIS, FSDP_AXIS, MP_AXIS, TopologyConfig
+from .mesh import (
+    DATA_AXES, DP_AXIS, FSDP_AXIS, MP_AXIS, PP_AXIS, TopologyConfig,
+)
 
 Rules = Tuple[Tuple[str, Any], ...]
 
@@ -48,6 +50,10 @@ def make_sharding_rules(topo: TopologyConfig) -> Rules:
     embed_axis = FSDP_AXIS if topo.sharding_stage == 3 else None
     seq_axis = MP_AXIS if (topo.sequence_parallel and topo.mp_degree > 1) \
         else None
+    # PP: stage s owns the contiguous layer block [s*L/pp, (s+1)*L/pp)
+    # of the scan-stacked params — the LayerDesc segmentation of
+    # reference hybrid_model.py:955, expressed as a sharding
+    layers_axis = PP_AXIS if topo.pp_degree > 1 else None
     return (
         ("vocab", MP_AXIS),
         ("heads", MP_AXIS),
@@ -56,7 +62,7 @@ def make_sharding_rules(topo: TopologyConfig) -> Rules:
         ("embed", embed_axis),
         ("pos", None),
         ("norm", None),
-        ("layers", None),
+        ("layers", layers_axis),
         ("batch", DATA_AXES),
         ("seq", seq_axis),
         ("act_embed", None),

@@ -1,0 +1,154 @@
+"""Pipeline parallelism: pipelined == sequential, on the CPU mesh.
+
+The reference can only validate PP by running 1F1B on a GPU pod
+(SURVEY.md §4); here the SPMD pipeline (``parallel/pipeline.py``) is
+checked for exact agreement with the unpipelined model, including
+composites with TP and DP, and gradient equality.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddlefleetx_tpu.models.gpt import (
+    GPTConfig, GPTForPretraining, cross_entropy_loss,
+)
+from paddlefleetx_tpu.models.gpt.model import pipelined_lm_loss
+from paddlefleetx_tpu.parallel import (
+    TopologyConfig, build_mesh, make_sharding_rules,
+)
+from paddlefleetx_tpu.parallel.mesh import set_mesh
+from paddlefleetx_tpu.parallel.pipeline import pipeline_forward
+
+CFG = GPTConfig(vocab_size=64, hidden_size=16, num_layers=4,
+                num_attention_heads=4, max_position_embeddings=32,
+                hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _clear_mesh():
+    yield
+    set_mesh(None)
+
+
+def test_pipeline_forward_plain_math():
+    """No mesh, no flax: pipeline over scalar-scale 'layers' equals
+    sequential application, microbatch-exact."""
+    L, B = 4, 6
+    w = jnp.arange(1.0, L + 1)[:, None]          # stacked [L, 1]
+    x = jnp.arange(B, dtype=jnp.float32)[:, None] + 1.0
+
+    def layer_apply(lp, h, key):
+        return h * lp[0] + 1.0
+
+    out = pipeline_forward(layer_apply, w, x, pp=2, num_microbatches=3)
+    ref = x
+    for i in range(L):
+        ref = ref * w[i, 0] + 1.0
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6)
+
+
+def test_pipeline_forward_reducer():
+    """out_fn accumulates per-microbatch results with extras."""
+    L, B = 2, 4
+    w = jnp.ones((L, 1))
+    x = jnp.arange(B, dtype=jnp.float32)[:, None]
+    extras = 10.0 * jnp.ones((B, 1))
+
+    def layer_apply(lp, h, key):
+        return h + lp[0]
+
+    def out_fn(acc, y, ex):
+        return acc + jnp.sum(y) + jnp.sum(ex)
+
+    out = pipeline_forward(layer_apply, w, x, pp=2, num_microbatches=2,
+                           out_fn=out_fn, out_init=jnp.zeros(()),
+                           extras=extras)
+    # sequential: each row gains +2; sum(x)+2*B + sum(extras)
+    np.testing.assert_allclose(float(out),
+                               float(jnp.sum(x) + 2 * B + 40.0))
+
+
+def _data(batch=8, seq=16):
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 64, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, 64, (batch, seq)), jnp.int32)
+    mask = jnp.ones((batch, seq), jnp.float32)
+    return ids, labels, mask
+
+
+@pytest.fixture(scope="module")
+def golden():
+    variables = GPTForPretraining(CFG).init(
+        {"params": jax.random.key(0)}, jnp.zeros((1, 8), jnp.int32))
+    ids, labels, mask = _data()
+    model = GPTForPretraining(CFG)
+
+    def f(params):
+        logits = model.apply({"params": params}, ids)
+        return cross_entropy_loss(logits, labels, mask)
+
+    params = nn.meta.unbox(variables)["params"]
+    loss, grads = jax.value_and_grad(f)(params)
+    return params, ids, labels, mask, loss, grads
+
+
+@pytest.mark.parametrize("topo_kw, microbatches", [
+    ({"pp_degree": 2}, 4),
+    ({"pp_degree": 4, "dp_degree": 2}, 2),
+    ({"pp_degree": 2, "mp_degree": 2, "dp_degree": 2}, 4),
+    ({"pp_degree": 2}, 1),
+], ids=["pp2", "pp4xdp2", "pp2xmp2xdp2", "pp2-m1"])
+def test_pipelined_matches_single_device(golden, topo_kw, microbatches):
+    params, ids, labels, mask, ref_loss, ref_grads = golden
+    topo = TopologyConfig(**topo_kw)
+    devices = jax.devices()[:topo.world_size]
+    mesh = build_mesh(topo, devices=devices)
+    set_mesh(mesh)
+    rules = make_sharding_rules(topo)
+
+    model = GPTForPretraining(CFG)
+    logical_specs = nn.get_partition_spec(
+        jax.eval_shape(model.init, {"params": jax.random.key(0)},
+                       jnp.zeros((1, 8), jnp.int32)))
+    shardings = nn.logical_to_mesh_sharding(logical_specs, mesh,
+                                            list(rules))
+    params_s = jax.device_put({"params": params},
+                              nn.meta.unbox(shardings))["params"]
+    data_sharding = NamedSharding(mesh, P(("dp", "fsdp"), None))
+    ids_s, labels_s, mask_s = (jax.device_put(x, data_sharding)
+                               for x in (ids, labels, mask))
+
+    def f(p, i, l, m):
+        return pipelined_lm_loss(
+            CFG, p, i, l, m, pp=topo.pp_degree,
+            num_microbatches=microbatches, deterministic=True)
+
+    with mesh, nn.logical_axis_rules(list(rules)):
+        loss, grads = jax.jit(jax.value_and_grad(f))(
+            params_s, ids_s, labels_s, mask_s)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, rtol=1e-3),
+        ref_grads, grads)
+
+
+def test_decoder_params_sharded_over_pp():
+    topo = TopologyConfig(pp_degree=2, mp_degree=2, dp_degree=2)
+    mesh = build_mesh(topo)
+    rules = make_sharding_rules(topo)
+    logical_specs = nn.get_partition_spec(
+        jax.eval_shape(GPTForPretraining(CFG).init,
+                       {"params": jax.random.key(0)},
+                       jnp.zeros((1, 8), jnp.int32)))
+    shardings = nn.logical_to_mesh_sharding(logical_specs, mesh,
+                                            list(rules))
+    qkv = shardings["params"]["gpt"]["decoder"]["self_attn"][
+        "qkv_proj"]["kernel"]
+    assert qkv.spec == P("pp", None, None, "mp", None)
