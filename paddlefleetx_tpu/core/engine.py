@@ -171,6 +171,12 @@ class Engine(BasicEngine):
         # with pipeline parallelism the module's loss_fn microbatches
         # internally (the pipeline IS the accumulation loop, as in the
         # reference's train_batch, eager_engine.py:406-415)
+        if self.topo.pp_degree > 1 and \
+                not getattr(module, "supports_pipeline", False):
+            raise ValueError(
+                f"{type(module).__name__} does not implement internal "
+                f"pipeline microbatching (supports_pipeline); pp_degree "
+                f"must be 1 for this module")
         acc = 1 if self.topo.pp_degree > 1 else self.accumulate_steps
         tx, schedule = self.tx, self.lr_schedule
         root_rng = self.root_rng

@@ -1,0 +1,1 @@
+"""Data tooling: native index builders + preprocessing pipelines."""

@@ -1,0 +1,1 @@
+"""Native index-map helpers (C++ via ctypes)."""

@@ -1,0 +1,248 @@
+// Fast index-map builders for the Megatron-style datasets.
+//
+// Native equivalent of the reference's pybind11 extension
+// (reference ppfleetx/data/data_tools/cpp/fast_index_map_helpers.cpp:
+// build_sample_idx :92, build_mapping :421, build_blocks_mapping :661,
+// build_blending_indices :32). Re-implemented against the documented
+// semantics with a plain C ABI so it loads through ctypes (no pybind11
+// in this toolchain). Data-dependent result sizes use a two-phase
+// protocol: call with a null output buffer to count, then with a
+// caller-(numpy-)allocated buffer to fill.
+//
+// Python semantic oracles: paddlefleetx_tpu/data/data_tools/
+// index_helpers.py (and gpt_dataset._build_sample_idx_py).
+
+#include <algorithm>
+#include <cstdint>
+#include <random>
+#include <vector>
+
+namespace {
+
+// Sentences longer than this mark the whole document as unusable for
+// sentence-pair packing (same cutoff as the reference).
+constexpr int32_t kLongSentenceLen = 512;
+
+// Short-sequence draw: with probability ~short_seq_prob pick a target
+// in [2, max_length], else max_length. Probability is applied as a
+// 1/round(1/p) ratio on raw 32-bit draws.
+inline int32_t target_len(int32_t short_seq_ratio, int32_t max_length,
+                          std::mt19937 &gen) {
+  if (short_seq_ratio == 0) return max_length;
+  const uint32_t r = gen();
+  if (r % short_seq_ratio == 0) return 2 + r % (max_length - 1);
+  return max_length;
+}
+
+// Shared greedy sentence-packing sweep for build_mapping /
+// build_blocks_mapping. Walks documents for num_epochs, packs
+// consecutive sentences until the per-document target length is
+// reached, and invokes `emit` for every completed sample. Stops (at
+// epoch granularity) once max_num_samples is reached. Returns the
+// number of samples emitted.
+template <typename TargetFn, typename EmitFn, typename KeepFn>
+uint64_t pack_sentences(const int64_t *docs, int64_t n_docs,
+                        const int32_t *sizes, int32_t num_epochs,
+                        uint64_t max_num_samples, int32_t min_num_sent,
+                        bool stop_mid_doc_rule, TargetFn next_target,
+                        EmitFn emit, KeepFn keep_doc) {
+  uint64_t n = 0;
+  for (int32_t epoch = 0; epoch < num_epochs; ++epoch) {
+    if (n >= max_num_samples) break;
+    int32_t block_id = 0;
+    for (int64_t doc = 0; doc < n_docs; ++doc) {
+      const int64_t first = docs[doc], last = docs[doc + 1];
+      int64_t remain = last - first;
+      if (remain < min_num_sent || !keep_doc(first, last)) continue;
+
+      int64_t start = first;
+      int32_t seq_len = 0, num_sent = 0;
+      int32_t target = next_target(doc);
+      for (int64_t s = first; s < last; ++s) {
+        seq_len += sizes[s];
+        ++num_sent;
+        --remain;
+        // emit when the target is met (with enough sentences taken and
+        // enough left over) or the document is exhausted
+        const bool enough_left = stop_mid_doc_rule
+                                     ? remain > 1
+                                     : remain >= min_num_sent;
+        if ((seq_len >= target && enough_left &&
+             num_sent >= min_num_sent) || remain == 0) {
+          emit(n, start, s + 1, doc, block_id, target);
+          ++n;
+          ++block_id;
+          start = s + 1;
+          seq_len = 0;
+          num_sent = 0;
+          target = next_target(doc);
+        }
+      }
+    }
+  }
+  return n;
+}
+
+inline bool no_long_sentence(const int32_t *sizes, int64_t first,
+                             int64_t last) {
+  for (int64_t s = first; s < last; ++s)
+    if (sizes[s] > kLongSentenceLen) return false;
+  return true;
+}
+
+// Fisher-Yates over rows of `width` int64 columns, 64-bit generator
+// (sample counts can exceed 2^32).
+void shuffle_rows(int64_t *data, int64_t n, int32_t width,
+                  uint64_t seed) {
+  std::mt19937_64 gen(seed);
+  for (int64_t i = n - 1; i > 0; --i) {
+    const int64_t j = static_cast<int64_t>(gen() % (i + 1));
+    for (int32_t c = 0; c < width; ++c)
+      std::swap(data[i * width + c], data[j * width + c]);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// GPT sample index: row i = (doc_idx position, in-document offset) of
+// sample i's first token; rows are monotone over the flattened token
+// stream. Output shape [(num_samples+1) x 2], int32. The sample count
+// is closed-form, so there is no counting phase.
+int64_t pfx_build_sample_idx(const int32_t *sizes, const int32_t *doc_idx,
+                             int32_t seq_length, int32_t num_epochs,
+                             int64_t tokens_per_epoch, int32_t *out) {
+  const int64_t num_samples =
+      (static_cast<int64_t>(num_epochs) * tokens_per_epoch - 1) /
+      seq_length;
+  if (out == nullptr) return num_samples;
+  int64_t di = 0;
+  int32_t offset = 0;
+  out[0] = 0;
+  out[1] = 0;
+  for (int64_t i = 1; i <= num_samples; ++i) {
+    // advance one sample: seq_length tokens plus one label-overlap
+    // token, minus the one-token overlap carried to the next sample
+    int32_t remaining = seq_length + 1;
+    while (remaining != 0) {
+      const int32_t doc_len = sizes[doc_idx[di]] - offset;
+      if (doc_len > remaining) {
+        offset += remaining - 1;
+        remaining = 0;
+      } else {
+        remaining -= doc_len;
+        if (remaining == 0) {
+          offset += doc_len - 1;
+        } else {
+          ++di;
+          offset = 0;
+        }
+      }
+    }
+    out[2 * i] = static_cast<int32_t>(di);
+    out[2 * i + 1] = offset;
+  }
+  return num_samples;
+}
+
+// Blending: interleave datasets so running per-dataset counts track
+// `weights` as closely as possible (largest-remainder greedy).
+void pfx_build_blending_indices(uint8_t *dataset_index,
+                                int64_t *dataset_sample_index,
+                                const double *weights,
+                                int32_t num_datasets, int64_t size) {
+  std::vector<int64_t> taken(num_datasets, 0);
+  for (int64_t i = 0; i < size; ++i) {
+    const double scale = std::max(static_cast<double>(i), 1.0);
+    int32_t best = 0;
+    double best_err = weights[0] * scale - static_cast<double>(taken[0]);
+    for (int32_t d = 1; d < num_datasets; ++d) {
+      const double err =
+          weights[d] * scale - static_cast<double>(taken[d]);
+      if (err > best_err) {
+        best_err = err;
+        best = d;
+      }
+    }
+    dataset_index[i] = static_cast<uint8_t>(best);
+    dataset_sample_index[i] = taken[best];
+    ++taken[best];
+  }
+}
+
+// Sentence-pair mapping (BERT/ERNIE-style): rows
+// (start_sentence, end_sentence, target_seq_len), shuffled. Pass
+// out == nullptr to count; identical RNG seeding makes the fill pass
+// reproduce the counted walk exactly.
+int64_t pfx_build_mapping(const int64_t *docs, int64_t n_docs,
+                          const int32_t *sizes, int32_t num_epochs,
+                          uint64_t max_num_samples,
+                          int32_t max_seq_length, double short_seq_prob,
+                          int32_t seed, int32_t min_num_sent,
+                          int64_t *out) {
+  const int32_t ratio =
+      short_seq_prob > 0
+          ? static_cast<int32_t>(0.5 + 1.0 / short_seq_prob)
+          : 0;
+  std::mt19937 gen(seed);
+  auto next_target = [&](int64_t) {
+    return target_len(ratio, max_seq_length, gen);
+  };
+  auto keep = [&](int64_t first, int64_t last) {
+    return no_long_sentence(sizes, first, last);
+  };
+  auto emit = [&](uint64_t i, int64_t start, int64_t end, int64_t,
+                  int32_t, int32_t target) {
+    if (out != nullptr) {
+      out[3 * i] = start;
+      out[3 * i + 1] = end;
+      out[3 * i + 2] = target;
+    }
+  };
+  const uint64_t n =
+      pack_sentences(docs, n_docs, sizes, num_epochs, max_num_samples,
+                     min_num_sent, /*stop_mid_doc_rule=*/true,
+                     next_target, emit, keep);
+  if (out != nullptr) shuffle_rows(out, static_cast<int64_t>(n), 3,
+                                   static_cast<uint64_t>(seed) + 1);
+  return static_cast<int64_t>(n);
+}
+
+// Block mapping (ICT/retrieval-style): rows
+// (start_sentence, end_sentence, document, block_id), shuffled; the
+// per-document title length is budgeted out of the target.
+int64_t pfx_build_blocks_mapping(const int64_t *docs, int64_t n_docs,
+                                 const int32_t *sizes,
+                                 const int32_t *titles_sizes,
+                                 int32_t num_epochs,
+                                 uint64_t max_num_samples,
+                                 int32_t max_seq_length, int32_t seed,
+                                 int32_t use_one_sent_blocks,
+                                 int64_t *out) {
+  const int32_t min_num_sent = use_one_sent_blocks ? 1 : 2;
+  auto next_target = [&](int64_t doc) {
+    return max_seq_length - titles_sizes[doc];
+  };
+  auto keep = [&](int64_t first, int64_t last) {
+    return no_long_sentence(sizes, first, last);
+  };
+  auto emit = [&](uint64_t i, int64_t start, int64_t end, int64_t doc,
+                  int32_t block_id, int32_t) {
+    if (out != nullptr) {
+      out[4 * i] = start;
+      out[4 * i + 1] = end;
+      out[4 * i + 2] = doc;
+      out[4 * i + 3] = block_id;
+    }
+  };
+  const uint64_t n =
+      pack_sentences(docs, n_docs, sizes, num_epochs, max_num_samples,
+                     min_num_sent, /*stop_mid_doc_rule=*/false,
+                     next_target, emit, keep);
+  if (out != nullptr) shuffle_rows(out, static_cast<int64_t>(n), 4,
+                                   static_cast<uint64_t>(seed) + 1);
+  return static_cast<int64_t>(n);
+}
+
+}  // extern "C"

@@ -1,0 +1,128 @@
+"""ctypes loader for the C++ index-map builders.
+
+Importing this module compiles ``fast_index_map.cpp`` on first use
+(one process builds under an exclusive file lock while concurrent
+ranks wait on it — the reference's rank-0-compiles-others-spin-wait
+protocol, ``gpt_dataset.py:47-69``) and exposes numpy-typed wrappers.
+Import failure (no compiler, build error) is the signal for callers
+to fall back to the Python builders.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import fcntl
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libfast_index_map.so")
+_SRC = os.path.join(_DIR, "fast_index_map.cpp")
+
+
+def _ensure_built() -> str:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= \
+            os.path.getmtime(_SRC):
+        return _SO
+    lock_path = os.path.join(_DIR, ".build.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)  # one builder; others wait here
+        try:
+            if not (os.path.exists(_SO) and os.path.getmtime(_SO) >=
+                    os.path.getmtime(_SRC)):
+                subprocess.run(["make", "-C", _DIR], check=True,
+                               capture_output=True)
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+    return _SO
+
+
+try:
+    _lib = ctypes.CDLL(_ensure_built())
+except (OSError, subprocess.CalledProcessError) as e:  # pragma: no cover
+    raise ImportError(f"fast_index_map build failed: {e}") from e
+
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+_f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+
+_lib.pfx_build_sample_idx.restype = ctypes.c_int64
+_lib.pfx_build_sample_idx.argtypes = [
+    _i32p, _i32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+    ctypes.c_void_p]
+_lib.pfx_build_blending_indices.restype = None
+_lib.pfx_build_blending_indices.argtypes = [
+    _u8p, _i64p, _f64p, ctypes.c_int32, ctypes.c_int64]
+_lib.pfx_build_mapping.restype = ctypes.c_int64
+_lib.pfx_build_mapping.argtypes = [
+    _i64p, ctypes.c_int64, _i32p, ctypes.c_int32, ctypes.c_uint64,
+    ctypes.c_int32, ctypes.c_double, ctypes.c_int32, ctypes.c_int32,
+    ctypes.c_void_p]
+_lib.pfx_build_blocks_mapping.restype = ctypes.c_int64
+_lib.pfx_build_blocks_mapping.argtypes = [
+    _i64p, ctypes.c_int64, _i32p, _i32p, ctypes.c_int32,
+    ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+    ctypes.c_void_p]
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def build_sample_idx(sizes, doc_idx, seq_length, num_epochs,
+                     tokens_per_epoch) -> np.ndarray:
+    sizes = np.ascontiguousarray(sizes, np.int32)
+    doc_idx = np.ascontiguousarray(doc_idx, np.int32)
+    n = _lib.pfx_build_sample_idx(sizes, doc_idx, seq_length,
+                                  num_epochs, tokens_per_epoch, None)
+    out = np.empty((n + 1, 2), np.int32)
+    _lib.pfx_build_sample_idx(sizes, doc_idx, seq_length, num_epochs,
+                              tokens_per_epoch, _ptr(out))
+    return out
+
+
+def build_blending_indices(num_datasets: int, weights,
+                           size: int) -> tuple:
+    weights = np.ascontiguousarray(weights, np.float64)
+    dataset_index = np.empty(size, np.uint8)
+    dataset_sample_index = np.empty(size, np.int64)
+    _lib.pfx_build_blending_indices(
+        dataset_index, dataset_sample_index, weights, num_datasets,
+        size)
+    return dataset_index, dataset_sample_index
+
+
+def build_mapping(docs, sizes, num_epochs, max_num_samples,
+                  max_seq_length, short_seq_prob, seed,
+                  min_num_sent: int = 2) -> np.ndarray:
+    docs = np.ascontiguousarray(docs, np.int64)
+    sizes = np.ascontiguousarray(sizes, np.int32)
+    n_docs = len(docs) - 1
+    n = _lib.pfx_build_mapping(
+        docs, n_docs, sizes, num_epochs, max_num_samples,
+        max_seq_length, short_seq_prob, seed, min_num_sent, None)
+    out = np.empty((n, 3), np.int64)
+    _lib.pfx_build_mapping(
+        docs, n_docs, sizes, num_epochs, max_num_samples,
+        max_seq_length, short_seq_prob, seed, min_num_sent, _ptr(out))
+    return out
+
+
+def build_blocks_mapping(docs, sizes, titles_sizes, num_epochs,
+                         max_num_samples, max_seq_length, seed,
+                         use_one_sent_blocks: bool = False) -> np.ndarray:
+    docs = np.ascontiguousarray(docs, np.int64)
+    sizes = np.ascontiguousarray(sizes, np.int32)
+    titles_sizes = np.ascontiguousarray(titles_sizes, np.int32)
+    n_docs = len(docs) - 1
+    n = _lib.pfx_build_blocks_mapping(
+        docs, n_docs, sizes, titles_sizes, num_epochs, max_num_samples,
+        max_seq_length, seed, int(use_one_sent_blocks), None)
+    out = np.empty((n, 4), np.int64)
+    _lib.pfx_build_blocks_mapping(
+        docs, n_docs, sizes, titles_sizes, num_epochs, max_num_samples,
+        max_seq_length, seed, int(use_one_sent_blocks), _ptr(out))
+    return out

@@ -1,0 +1,181 @@
+"""Index-map builders: C++ fast path with Python semantic oracles.
+
+The four entry points mirror the reference's native helper module
+(reference ``fast_index_map_helpers.cpp:32,92,421,661``). Each
+function dispatches to the ctypes-loaded C++ library when it builds,
+else to the pure-Python implementation below — which also serves as
+the testable definition of the semantics (C++ vs Python equality is
+asserted in ``tests/test_index_helpers.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+try:
+    from .cpp import fast_index_map as _fast
+except ImportError:  # no compiler / build failure
+    _fast = None
+
+LONG_SENTENCE_LEN = 512
+
+
+def have_native() -> bool:
+    return _fast is not None
+
+
+# -- sample idx (GPT token-stream samples) ------------------------------
+
+def build_sample_idx(sizes, doc_idx, seq_length, num_epochs,
+                     tokens_per_epoch, *, force_python=False):
+    if _fast is not None and not force_python:
+        return _fast.build_sample_idx(sizes, doc_idx, seq_length,
+                                      num_epochs, tokens_per_epoch)
+    from ..dataset.gpt_dataset import _build_sample_idx_py
+    return _build_sample_idx_py(np.asarray(sizes, np.int32),
+                                np.asarray(doc_idx, np.int32),
+                                seq_length, num_epochs, tokens_per_epoch)
+
+
+# -- blending (multi-dataset weighted interleave) -----------------------
+
+def build_blending_indices(num_datasets: int, weights, size: int, *,
+                           force_python=False
+                           ) -> Tuple[np.ndarray, np.ndarray]:
+    """Greedy largest-error interleave of ``num_datasets`` streams so
+    running counts track ``weights``; returns (dataset_index u8,
+    within-dataset sample index i64)."""
+    if _fast is not None and not force_python:
+        return _fast.build_blending_indices(num_datasets, weights, size)
+    weights = np.asarray(weights, np.float64)
+    dataset_index = np.empty(size, np.uint8)
+    dataset_sample_index = np.empty(size, np.int64)
+    taken = np.zeros(num_datasets, np.int64)
+    for i in range(size):
+        errors = weights * max(i, 1) - taken
+        best = int(np.argmax(errors))
+        dataset_index[i] = best
+        dataset_sample_index[i] = taken[best]
+        taken[best] += 1
+    return dataset_index, dataset_sample_index
+
+
+# -- sentence packing (BERT/ERNIE-style mappings) -----------------------
+
+def _pack_sentences(docs, sizes, num_epochs, max_num_samples,
+                    min_num_sent, stop_mid_doc_rule, next_target, emit):
+    n = 0
+    n_docs = len(docs) - 1
+    for _epoch in range(num_epochs):
+        if n >= max_num_samples:
+            break
+        block_id = 0
+        for doc in range(n_docs):
+            first, last = int(docs[doc]), int(docs[doc + 1])
+            remain = last - first
+            if remain < min_num_sent or \
+                    np.any(sizes[first:last] > LONG_SENTENCE_LEN):
+                continue
+            start, seq_len, num_sent = first, 0, 0
+            target = next_target(doc)
+            for s in range(first, last):
+                seq_len += int(sizes[s])
+                num_sent += 1
+                remain -= 1
+                enough_left = remain > 1 if stop_mid_doc_rule \
+                    else remain >= min_num_sent
+                if (seq_len >= target and enough_left and
+                        num_sent >= min_num_sent) or remain == 0:
+                    emit(n, start, s + 1, doc, block_id, target)
+                    n += 1
+                    block_id += 1
+                    start = s + 1
+                    seq_len, num_sent = 0, 0
+                    target = next_target(doc)
+    return n
+
+
+class _MT19937:
+    """Raw-draw front ends over numpy's MT19937 core, matching the C++
+    std::mt19937 / std::mt19937_64 streams used by the fast path."""
+
+    def __init__(self, seed: int, width: int = 32):
+        self._g = np.random.Generator(np.random.MT19937(seed))
+        self._width = width
+
+    def draw(self) -> int:
+        if self._width == 32:
+            return int(self._g.integers(0, 1 << 32, dtype=np.uint32))
+        return int(self._g.integers(0, 1 << 64, dtype=np.uint64))
+
+
+def _shuffle_rows(out: np.ndarray, seed: int) -> None:
+    """Fisher-Yates with explicit 64-bit draws. Note: equivalent in
+    distribution to the C++ path but not draw-for-draw identical
+    (std::mt19937_64 tempers differently than numpy's 32-bit core);
+    tests compare sorted rows."""
+    gen = _MT19937(seed, width=64)
+    for i in range(len(out) - 1, 0, -1):
+        j = gen.draw() % (i + 1)
+        out[[i, j]] = out[[j, i]]
+
+
+def build_mapping(docs, sizes, num_epochs, max_num_samples,
+                  max_seq_length, short_seq_prob, seed,
+                  min_num_sent: int = 2, *, force_python=False
+                  ) -> np.ndarray:
+    """Pack consecutive sentences into ~max_seq_length samples; rows
+    (start_sentence, end_sentence, target_len), shuffled."""
+    if _fast is not None and not force_python:
+        return _fast.build_mapping(docs, sizes, num_epochs,
+                                   max_num_samples, max_seq_length,
+                                   short_seq_prob, seed, min_num_sent)
+    docs = np.asarray(docs, np.int64)
+    sizes = np.asarray(sizes, np.int32)
+    ratio = int(round(1.0 / short_seq_prob)) if short_seq_prob > 0 else 0
+    rows = []
+
+    def run(emit):
+        gen = _MT19937(seed)
+
+        def next_target(_doc):
+            if ratio == 0:
+                return max_seq_length
+            r = gen.draw()
+            if r % ratio == 0:
+                return 2 + r % (max_seq_length - 1)
+            return max_seq_length
+
+        return _pack_sentences(docs, sizes, num_epochs, max_num_samples,
+                               min_num_sent, True, next_target, emit)
+
+    run(lambda i, s, e, d, b, t: rows.append((s, e, t)))
+    out = np.asarray(rows, np.int64).reshape(-1, 3)
+    _shuffle_rows(out, seed + 1)
+    return out
+
+
+def build_blocks_mapping(docs, sizes, titles_sizes, num_epochs,
+                         max_num_samples, max_seq_length, seed,
+                         use_one_sent_blocks: bool = False, *,
+                         force_python=False) -> np.ndarray:
+    """Pack sentences into blocks budgeting out the document title;
+    rows (start_sentence, end_sentence, doc, block_id), shuffled."""
+    if _fast is not None and not force_python:
+        return _fast.build_blocks_mapping(
+            docs, sizes, titles_sizes, num_epochs, max_num_samples,
+            max_seq_length, seed, use_one_sent_blocks)
+    docs = np.asarray(docs, np.int64)
+    sizes = np.asarray(sizes, np.int32)
+    titles_sizes = np.asarray(titles_sizes, np.int32)
+    min_num_sent = 1 if use_one_sent_blocks else 2
+    rows = []
+    _pack_sentences(docs, sizes, num_epochs, max_num_samples,
+                    min_num_sent, False,
+                    lambda doc: max_seq_length - int(titles_sizes[doc]),
+                    lambda i, s, e, d, b, t: rows.append((s, e, d, b)))
+    out = np.asarray(rows, np.int64).reshape(-1, 4)
+    _shuffle_rows(out, seed + 1)
+    return out

@@ -273,14 +273,19 @@ class GPTModel(nn.Module):
                 x = block(cfg, name=f"decoder_{i}")(
                     x, attn_bias, use_cache, deterministic)
 
-        x = nn.LayerNorm(
-            epsilon=1e-5, dtype=jnp.dtype(cfg.dtype),
-            param_dtype=jnp.dtype(cfg.param_dtype), name="final_norm",
-            scale_init=nn.with_logical_partitioning(
-                nn.initializers.ones_init(), ("norm",)),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros_init(), ("norm",)))(x)
-        return x
+        return _final_norm(cfg, name="final_norm")(x)
+
+
+def _final_norm(cfg: GPTConfig, name: Optional[str] = None) -> nn.LayerNorm:
+    """The decoder-output LayerNorm — single definition shared by the
+    plain and pipelined forward paths."""
+    return nn.LayerNorm(
+        epsilon=1e-5, dtype=jnp.dtype(cfg.dtype),
+        param_dtype=jnp.dtype(cfg.param_dtype), name=name,
+        scale_init=nn.with_logical_partitioning(
+            nn.initializers.ones_init(), ("norm",)),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), ("norm",)))
 
 
 class GPTForPretraining(nn.Module):
@@ -376,27 +381,29 @@ def pipelined_lm_loss(cfg: GPTConfig, params, input_ids, labels,
         layer_apply = jax.checkpoint(
             layer_apply, policy=_remat_policy(cfg.recompute_granularity))
 
-    ln = nn.LayerNorm(epsilon=1e-5, dtype=jnp.dtype(cfg.dtype),
-                      param_dtype=jnp.dtype(cfg.param_dtype))
+    ln = _final_norm(cfg)
     fn_params = params["gpt"]["final_norm"]
     word_emb = emb_params["word_embeddings"]
     if isinstance(word_emb, nn.Partitioned):
         word_emb = word_emb.value
 
     def head_and_loss(acc, y, ex):
+        # per-microbatch masked mean, averaged over microbatches below —
+        # the same weighting as the engine's accumulation scan and the
+        # reference's 1F1B micro-loss averaging (masks that vary across
+        # microbatches weight identically with and without pp)
         labels_mb, mask_mb = ex
         h = ln.apply({"params": fn_params}, y)
         nll, msum = masked_nll_sums(tied_logits(h, word_emb),
                                     labels_mb, mask_mb)
-        return (acc[0] + nll, acc[1] + msum)
+        return acc + nll / jnp.maximum(msum, 1.0)
 
-    nll_sum, mask_sum = pipeline_forward(
+    loss_sum = pipeline_forward(
         layer_apply, params["gpt"]["decoder"], x,
         pp=pp, num_microbatches=num_microbatches,
-        out_fn=head_and_loss,
-        out_init=(jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        out_fn=head_and_loss, out_init=jnp.zeros((), jnp.float32),
         extras=(labels, loss_mask), rng=pipe_rng)
-    return nll_sum / jnp.maximum(mask_sum, 1.0)
+    return loss_sum / num_microbatches
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array,

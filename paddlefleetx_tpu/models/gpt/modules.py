@@ -21,6 +21,10 @@ from .model import GPTForPretraining, cross_entropy_loss
 
 @register_module("GPTModule")
 class GPTModule(LanguageModule):
+    #: loss_fn microbatches internally when pp>1 (engine then skips its
+    #: own accumulation scan)
+    supports_pipeline = True
+
     def __init__(self, configs):
         from ..language_utils import process_configs
         process_configs(configs)

@@ -1,0 +1,132 @@
+"""C++ index builders vs the Python semantic oracles.
+
+The reference ships its helpers only as C++ (semantics documented by
+the Python fallback at reference ``gpt_dataset.py:410-460``); here
+both implementations exist and are cross-checked. The C++ and Python
+shuffles draw from different MT19937 front ends, so order-dependent
+outputs are compared as sorted row sets.
+"""
+
+import numpy as np
+import pytest
+
+from paddlefleetx_tpu.data.data_tools import index_helpers as ih
+
+
+def _sentences(seed=0, n_docs=30, max_sent=12, max_len=60):
+    """Random corpus: docs -> sentence boundaries + sizes + titles."""
+    rng = np.random.default_rng(seed)
+    counts = rng.integers(0, max_sent, n_docs)
+    docs = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    sizes = rng.integers(1, max_len, int(counts.sum())).astype(np.int32)
+    titles = rng.integers(1, 10, n_docs).astype(np.int32)
+    return docs, sizes, titles
+
+
+def test_native_built():
+    """g++ is in the image: the fast path must actually build."""
+    assert ih.have_native()
+
+
+@pytest.mark.parametrize("seed,seq_len,epochs", [
+    (0, 16, 1), (1, 32, 3), (2, 7, 2)])
+def test_build_sample_idx_matches_python(seed, seq_len, epochs):
+    rng = np.random.default_rng(seed)
+    sizes = rng.integers(2, 80, 50).astype(np.int32)
+    doc_idx = np.tile(np.arange(50, dtype=np.int32), epochs)
+    tokens_per_epoch = int(sizes.sum())
+    fast = ih.build_sample_idx(sizes, doc_idx, seq_len, epochs,
+                               tokens_per_epoch)
+    slow = ih.build_sample_idx(sizes, doc_idx, seq_len, epochs,
+                               tokens_per_epoch, force_python=True)
+    np.testing.assert_array_equal(fast, slow)
+
+
+def test_build_blending_indices_matches_python():
+    weights = np.array([0.5, 0.3, 0.2])
+    fast_idx, fast_sample = ih.build_blending_indices(3, weights, 1000)
+    slow_idx, slow_sample = ih.build_blending_indices(
+        3, weights, 1000, force_python=True)
+    np.testing.assert_array_equal(fast_idx, slow_idx)
+    np.testing.assert_array_equal(fast_sample, slow_sample)
+    # achieved ratios track the weights
+    achieved = np.bincount(fast_idx, minlength=3) / 1000
+    np.testing.assert_allclose(achieved, weights, atol=0.01)
+
+
+def _sorted_rows(a):
+    return a[np.lexsort(a.T[::-1])]
+
+
+def test_build_mapping_matches_python_no_short_seq():
+    docs, sizes, _ = _sentences()
+    fast = ih.build_mapping(docs, sizes, 2, 10**9, 128, 0.0, 7)
+    slow = ih.build_mapping(docs, sizes, 2, 10**9, 128, 0.0, 7,
+                            force_python=True)
+    assert fast.shape == slow.shape
+    np.testing.assert_array_equal(_sorted_rows(fast),
+                                  _sorted_rows(slow))
+    # every sample: valid sentence range, >=2 sentences, target echoed
+    assert np.all(fast[:, 0] < fast[:, 1])
+    assert np.all(fast[:, 1] <= docs[-1])
+    assert np.all(fast[:, 1] - fast[:, 0] >= 2)
+    assert np.all(fast[:, 2] == 128)
+
+
+def test_build_mapping_short_seq_structure():
+    """short_seq_prob>0 draws differ between generators; check
+    structure on the fast path only."""
+    docs, sizes, _ = _sentences(seed=3)
+    out = ih.build_mapping(docs, sizes, 1, 10**9, 128, 0.3, 11)
+    assert len(out) > 0
+    assert np.all(out[:, 2] >= 2)
+    assert np.all(out[:, 2] <= 128)
+    # some short targets actually drawn
+    assert np.any(out[:, 2] < 128)
+
+
+def test_build_blocks_mapping_matches_python():
+    docs, sizes, titles = _sentences(seed=5)
+    fast = ih.build_blocks_mapping(docs, sizes, titles, 2, 10**9, 96, 13)
+    slow = ih.build_blocks_mapping(docs, sizes, titles, 2, 10**9, 96, 13,
+                                   force_python=True)
+    assert fast.shape == slow.shape
+    np.testing.assert_array_equal(_sorted_rows(fast),
+                                  _sorted_rows(slow))
+    # doc column indexes a real document; sentence range inside it
+    assert np.all((fast[:, 2] >= 0) & (fast[:, 2] < len(docs) - 1))
+    starts = docs[fast[:, 2]]
+    ends = docs[fast[:, 2] + 1]
+    assert np.all(fast[:, 0] >= starts) and np.all(fast[:, 1] <= ends)
+
+
+def test_blocks_mapping_one_sent_blocks():
+    docs, sizes, titles = _sentences(seed=8)
+    one = ih.build_blocks_mapping(docs, sizes, titles, 1, 10**9, 96, 13,
+                                  use_one_sent_blocks=True)
+    two = ih.build_blocks_mapping(docs, sizes, titles, 1, 10**9, 96, 13,
+                                  use_one_sent_blocks=False)
+    assert len(one) >= len(two)
+
+
+def test_max_num_samples_caps_at_epoch_granularity():
+    docs, sizes, _ = _sentences(seed=9)
+    unbounded = ih.build_mapping(docs, sizes, 4, 10**9, 128, 0.0, 7)
+    per_epoch = len(unbounded) // 4
+    capped = ih.build_mapping(docs, sizes, 4, per_epoch + 1, 128, 0.0, 7)
+    # stops after the epoch in which the cap is crossed
+    assert per_epoch + 1 <= len(capped) <= 2 * per_epoch
+
+
+def test_gpt_dataset_uses_fast_path(tmp_path):
+    """The GPTDataset sample index goes through the C++ builder and
+    equals the Python oracle."""
+    from paddlefleetx_tpu.data.dataset.gpt_dataset import (
+        _build_sample_idx, _build_sample_idx_py,
+    )
+    rng = np.random.default_rng(0)
+    sizes = rng.integers(2, 40, 30).astype(np.int32)
+    doc_idx = np.arange(30, dtype=np.int32)
+    got = _build_sample_idx(sizes, doc_idx, 16, 1, int(sizes.sum()))
+    want = _build_sample_idx_py(sizes, doc_idx, 16, 1, int(sizes.sum()))
+    np.testing.assert_array_equal(got, want)

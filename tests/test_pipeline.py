@@ -139,6 +139,33 @@ def test_pipelined_matches_single_device(golden, topo_kw, microbatches):
         ref_grads, grads)
 
 
+def test_pipelined_loss_weighting_matches_accumulation(golden):
+    """With masks that vary across microbatches, the pp loss equals the
+    engine accumulation semantics: mean over microbatches of the
+    per-microbatch masked mean (reference 1F1B micro-loss averaging)."""
+    params, ids, labels, _, _, _ = golden
+    rng = np.random.default_rng(3)
+    mask = jnp.asarray((rng.random(ids.shape) > 0.4), jnp.float32)
+    M = 4
+    model = GPTForPretraining(CFG)
+    per_mb = []
+    for i in range(M):
+        sl = slice(i * ids.shape[0] // M, (i + 1) * ids.shape[0] // M)
+        logits = model.apply({"params": params}, ids[sl])
+        per_mb.append(cross_entropy_loss(logits, labels[sl], mask[sl]))
+    want = float(np.mean([float(x) for x in per_mb]))
+
+    topo = TopologyConfig(pp_degree=2)
+    mesh = build_mesh(topo, devices=jax.devices()[:2])
+    set_mesh(mesh)
+    rules = make_sharding_rules(topo)
+    with mesh, nn.logical_axis_rules(list(rules)):
+        got = jax.jit(lambda p: pipelined_lm_loss(
+            CFG, p, ids, labels, mask, pp=2, num_microbatches=M,
+            deterministic=True))(params)
+    np.testing.assert_allclose(float(got), want, rtol=2e-5)
+
+
 def test_decoder_params_sharded_over_pp():
     topo = TopologyConfig(pp_degree=2, mp_degree=2, dp_degree=2)
     mesh = build_mesh(topo)
