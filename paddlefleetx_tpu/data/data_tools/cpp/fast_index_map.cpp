@@ -25,12 +25,15 @@ constexpr int32_t kLongSentenceLen = 512;
 
 // Short-sequence draw: with probability ~short_seq_prob pick a target
 // in [2, max_length], else max_length. Probability is applied as a
-// 1/round(1/p) ratio on raw 32-bit draws.
+// 1/round(1/p) ratio on raw 32-bit draws. The Bernoulli test and the
+// target value use independent draws — reusing one draw would make
+// the value conditional on r % ratio == 0 and biased whenever ratio
+// shares factors with max_length-1.
 inline int32_t target_len(int32_t short_seq_ratio, int32_t max_length,
                           std::mt19937 &gen) {
   if (short_seq_ratio == 0) return max_length;
   const uint32_t r = gen();
-  if (r % short_seq_ratio == 0) return 2 + r % (max_length - 1);
+  if (r % short_seq_ratio == 0) return 2 + gen() % (max_length - 1);
   return max_length;
 }
 

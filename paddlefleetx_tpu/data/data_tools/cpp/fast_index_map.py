@@ -23,17 +23,21 @@ _SRC = os.path.join(_DIR, "fast_index_map.cpp")
 
 
 def _ensure_built() -> str:
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= \
-            os.path.getmtime(_SRC):
-        return _SO
+    # The freshness check must happen under the lock: an unlocked
+    # fast path could dlopen a half-written .so while another rank's
+    # compiler is still streaming it out.
     lock_path = os.path.join(_DIR, ".build.lock")
     with open(lock_path, "w") as lock:
         fcntl.flock(lock, fcntl.LOCK_EX)  # one builder; others wait here
         try:
             if not (os.path.exists(_SO) and os.path.getmtime(_SO) >=
                     os.path.getmtime(_SRC)):
-                subprocess.run(["make", "-C", _DIR], check=True,
-                               capture_output=True)
+                proc = subprocess.run(["make", "-C", _DIR],
+                                      capture_output=True, text=True)
+                if proc.returncode != 0:
+                    raise ImportError(
+                        "fast_index_map compile failed "
+                        f"(exit {proc.returncode}):\n{proc.stderr}")
         finally:
             fcntl.flock(lock, fcntl.LOCK_UN)
     return _SO
@@ -41,8 +45,8 @@ def _ensure_built() -> str:
 
 try:
     _lib = ctypes.CDLL(_ensure_built())
-except (OSError, subprocess.CalledProcessError) as e:  # pragma: no cover
-    raise ImportError(f"fast_index_map build failed: {e}") from e
+except OSError as e:  # pragma: no cover
+    raise ImportError(f"fast_index_map load failed: {e}") from e
 
 _i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
 _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
@@ -86,6 +90,9 @@ def build_sample_idx(sizes, doc_idx, seq_length, num_epochs,
 
 def build_blending_indices(num_datasets: int, weights,
                            size: int) -> tuple:
+    if num_datasets > 255:
+        raise ValueError(
+            f"num_datasets {num_datasets} > 255 (uint8 dataset index)")
     weights = np.ascontiguousarray(weights, np.float64)
     dataset_index = np.empty(size, np.uint8)
     dataset_sample_index = np.empty(size, np.int64)

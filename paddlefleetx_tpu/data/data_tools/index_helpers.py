@@ -16,7 +16,12 @@ import numpy as np
 
 try:
     from .cpp import fast_index_map as _fast
-except ImportError:  # no compiler / build failure
+except ImportError as _e:  # no compiler / build failure
+    import warnings
+
+    warnings.warn(
+        "fast_index_map C++ builders unavailable, using the slower "
+        f"Python fallback (RNG streams differ between the two): {_e}")
     _fast = None
 
 LONG_SENTENCE_LEN = 512
@@ -47,6 +52,9 @@ def build_blending_indices(num_datasets: int, weights, size: int, *,
     """Greedy largest-error interleave of ``num_datasets`` streams so
     running counts track ``weights``; returns (dataset_index u8,
     within-dataset sample index i64)."""
+    if num_datasets > 255:
+        raise ValueError(
+            f"num_datasets {num_datasets} > 255 (uint8 dataset index)")
     if _fast is not None and not force_python:
         return _fast.build_blending_indices(num_datasets, weights, size)
     weights = np.asarray(weights, np.float64)
@@ -98,8 +106,11 @@ def _pack_sentences(docs, sizes, num_epochs, max_num_samples,
 
 
 class _MT19937:
-    """Raw-draw front ends over numpy's MT19937 core, matching the C++
-    std::mt19937 / std::mt19937_64 streams used by the fast path."""
+    """Raw-draw front ends over numpy's MT19937 core. NOT draw-for-draw
+    identical to the C++ std::mt19937 streams (numpy seeds through
+    SeedSequence, std:: uses Knuth init): the fast and fallback paths
+    agree in distribution, not bit-exactly — tests compare invariants,
+    never raw sample sets."""
 
     def __init__(self, seed: int, width: int = 32):
         self._g = np.random.Generator(np.random.MT19937(seed))
@@ -134,7 +145,9 @@ def build_mapping(docs, sizes, num_epochs, max_num_samples,
                                    short_seq_prob, seed, min_num_sent)
     docs = np.asarray(docs, np.int64)
     sizes = np.asarray(sizes, np.int32)
-    ratio = int(round(1.0 / short_seq_prob)) if short_seq_prob > 0 else 0
+    # floor(0.5 + 1/p), matching the C++ path exactly (round() would
+    # use banker's rounding and diverge on half-integers)
+    ratio = int(1.0 / short_seq_prob + 0.5) if short_seq_prob > 0 else 0
     rows = []
 
     def run(emit):
@@ -143,9 +156,8 @@ def build_mapping(docs, sizes, num_epochs, max_num_samples,
         def next_target(_doc):
             if ratio == 0:
                 return max_seq_length
-            r = gen.draw()
-            if r % ratio == 0:
-                return 2 + r % (max_seq_length - 1)
+            if gen.draw() % ratio == 0:
+                return 2 + gen.draw() % (max_seq_length - 1)
             return max_seq_length
 
         return _pack_sentences(docs, sizes, num_epochs, max_num_samples,

@@ -64,6 +64,10 @@ def get_train_valid_test_split_(splits: Sequence[float],
 
 def _num_epochs(tokens_per_epoch: int, seq_length: int,
                 num_samples: int) -> int:
+    if tokens_per_epoch <= 0:
+        raise ValueError(
+            "document split is empty (0 tokens) — check Data.*.dataset"
+            ".split; small corpora can round a split share to zero docs")
     epochs = 0
     total_tokens = 0
     while True:
@@ -114,14 +118,10 @@ def _build_sample_idx_py(sizes: np.ndarray, doc_idx: np.ndarray,
 
 def _build_sample_idx(sizes, doc_idx, seq_length, num_epochs,
                       tokens_per_epoch) -> np.ndarray:
-    try:
-        from ...data.data_tools.cpp import fast_index_map
-        return fast_index_map.build_sample_idx(
-            np.asarray(sizes, np.int32), np.asarray(doc_idx, np.int32),
-            seq_length, num_epochs, tokens_per_epoch)
-    except ImportError:
-        return _build_sample_idx_py(sizes, doc_idx, seq_length, num_epochs,
-                                    tokens_per_epoch)
+    # single fast/slow dispatcher lives in data_tools.index_helpers
+    from ..data_tools import index_helpers
+    return index_helpers.build_sample_idx(sizes, doc_idx, seq_length,
+                                          num_epochs, tokens_per_epoch)
 
 
 def _build_shuffle_idx(num_samples: int, total_size: int,

@@ -12,5 +12,14 @@ from paddlefleetx_tpu.parallel.mesh import cpu_mesh_env
 cpu_mesh_env(8)
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 assert jax.device_count() == 8, jax.devices()
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_mesh():
+    """The process-wide mesh default must not leak between tests."""
+    from paddlefleetx_tpu.parallel.mesh import set_mesh
+    yield
+    set_mesh(None)

@@ -28,12 +28,6 @@ CFG = GPTConfig(vocab_size=64, hidden_size=16, num_layers=4,
                 hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
 
 
-@pytest.fixture(autouse=True)
-def _clear_mesh():
-    yield
-    set_mesh(None)
-
-
 def test_pipeline_forward_plain_math():
     """No mesh, no flax: pipeline over scalar-scale 'layers' equals
     sequential application, microbatch-exact."""
