@@ -90,9 +90,9 @@ def build_sample_idx(sizes, doc_idx, seq_length, num_epochs,
 
 def build_blending_indices(num_datasets: int, weights,
                            size: int) -> tuple:
-    if num_datasets > 255:
+    if num_datasets > 256:
         raise ValueError(
-            f"num_datasets {num_datasets} > 255 (uint8 dataset index)")
+            f"num_datasets {num_datasets} > 256 (uint8 dataset index)")
     weights = np.ascontiguousarray(weights, np.float64)
     dataset_index = np.empty(size, np.uint8)
     dataset_sample_index = np.empty(size, np.int64)

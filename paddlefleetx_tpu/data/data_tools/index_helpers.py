@@ -52,9 +52,9 @@ def build_blending_indices(num_datasets: int, weights, size: int, *,
     """Greedy largest-error interleave of ``num_datasets`` streams so
     running counts track ``weights``; returns (dataset_index u8,
     within-dataset sample index i64)."""
-    if num_datasets > 255:
+    if num_datasets > 256:
         raise ValueError(
-            f"num_datasets {num_datasets} > 255 (uint8 dataset index)")
+            f"num_datasets {num_datasets} > 256 (uint8 dataset index)")
     if _fast is not None and not force_python:
         return _fast.build_blending_indices(num_datasets, weights, size)
     weights = np.asarray(weights, np.float64)

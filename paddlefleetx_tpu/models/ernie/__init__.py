@@ -1,0 +1,25 @@
+"""ERNIE model family (encoder LM with MLM/NSP pretraining heads)."""
+
+from .config import ErnieConfig
+from .model import (
+    ErnieEmbeddings,
+    ErnieEncoderLayer,
+    ErnieForMaskedLM,
+    ErnieForMultipleChoice,
+    ErnieForPretraining,
+    ErnieModel,
+    ErniePretrainingHeads,
+    ernie_pretraining_loss,
+)
+
+__all__ = [
+    "ErnieConfig",
+    "ErnieEmbeddings",
+    "ErnieEncoderLayer",
+    "ErnieForMaskedLM",
+    "ErnieForMultipleChoice",
+    "ErnieForPretraining",
+    "ErnieModel",
+    "ErniePretrainingHeads",
+    "ernie_pretraining_loss",
+]

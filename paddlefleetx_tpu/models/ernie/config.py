@@ -1,0 +1,75 @@
+"""ERNIE hyper-parameter container.
+
+Field names and defaults follow the reference's ``ErnieModel``
+constructor (reference ``ernie/single_model.py:193-238``): 12 post-LN
+encoder layers, hidden 768, intermediate 3072, gelu, learned
+word/position/token-type embeddings with pad_token_id 0, optional
+task-type embeddings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ErnieConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: Optional[int] = None
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+    pad_token_id: int = 0
+    task_type_vocab_size: int = 3
+    task_id: int = 0
+    use_task_id: bool = False
+    use_recompute: bool = False
+    # MLM objective knobs (the module's dynamic masking; reference
+    # BERT/ERNIE semantics — see modules.ErnieModule)
+    masked_lm_prob: float = 0.15
+    mask_token_id: Optional[int] = None    # default: vocab_size - 1
+    with_nsp_loss: bool = False            # reference ErnieModule uses False
+    # TPU-specific knobs (absent in reference):
+    scan_layers: bool = True
+    use_flash_attention: bool = False
+    dtype: str = "float32"
+    param_dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            object.__setattr__(self, "intermediate_size",
+                               4 * self.hidden_size)
+        if self.mask_token_id is None:
+            object.__setattr__(self, "mask_token_id", self.vocab_size - 1)
+        if self.hidden_size % self.num_attention_heads != 0:
+            raise ValueError(
+                f"num_attention_heads ({self.num_attention_heads}) must "
+                f"divide hidden_size ({self.hidden_size})")
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def from_config(cls, config) -> "ErnieConfig":
+        """Build from a parsed YAML tree (Model + Engine sections)."""
+        model = dict(config.get("Model", {}))
+        # YAML may use the GPT-style spelling
+        if "num_layers" in model and "num_hidden_layers" not in model:
+            model["num_hidden_layers"] = model.pop("num_layers")
+        if "ffn_hidden_size" in model and "intermediate_size" not in model:
+            model["intermediate_size"] = model.pop("ffn_hidden_size")
+        mix = config.get("Engine", {}).get("mix_precision", {})
+        fields = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in model.items()
+                  if k in fields and v is not None}
+        if mix.get("use_pure_fp16") or mix.get("dtype") == "bfloat16":
+            kwargs.setdefault("dtype", "bfloat16")
+        return cls(**kwargs)

@@ -1,0 +1,86 @@
+"""ERNIE task module implementing the BasicModule contract.
+
+Parity: reference ``ernie/ernie_module.py`` — ``ErnieModule`` trains
+``ErnieForPretraining`` on GPTDataset token streams with the MLM-only
+criterion (``ErniePretrainingCriterion(with_nsp_loss=False)``,
+:56-94). The snapshot's ``training_step`` is a placeholder that feeds
+*random* labels (:85-88); this module implements the objective that
+criterion is written for: BERT-style dynamic masking — select
+``masked_lm_prob`` of positions each step, replace 80% with [MASK],
+10% with a random token, keep 10%, and predict the original ids at the
+selected positions (ignore_index -1 elsewhere).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from .. import register_module
+from ...core.module import LanguageModule
+from .config import ErnieConfig
+from .model import ErnieForPretraining, ernie_pretraining_loss
+
+
+def apply_mlm_masking(rng: jax.Array, tokens: jax.Array,
+                      cfg: ErnieConfig):
+    """Dynamic MLM corruption: returns ``(masked_tokens, labels)`` with
+    labels == -1 at unmasked positions (the criterion's ignore_index).
+    Pad positions are never selected."""
+    select_rng, kind_rng, rand_rng = jax.random.split(rng, 3)
+    selectable = tokens != cfg.pad_token_id
+    selected = (jax.random.uniform(select_rng, tokens.shape) <
+                cfg.masked_lm_prob) & selectable
+    kind = jax.random.uniform(kind_rng, tokens.shape)
+    random_tokens = jax.random.randint(rand_rng, tokens.shape, 0,
+                                       cfg.vocab_size, tokens.dtype)
+    corrupted = jnp.where(kind < 0.8, cfg.mask_token_id,
+                          jnp.where(kind < 0.9, random_tokens, tokens))
+    masked_tokens = jnp.where(selected, corrupted, tokens)
+    labels = jnp.where(selected, tokens, -1)
+    return masked_tokens, labels
+
+
+@register_module("ErnieModule")
+class ErnieModule(LanguageModule):
+    def __init__(self, configs):
+        from ..language_utils import process_data_configs
+        process_data_configs(configs)
+        super().__init__(configs)
+
+    def get_model(self):
+        self.model_config = ErnieConfig.from_config(self.configs)
+        return ErnieForPretraining(self.model_config)
+
+    def loss_fn(self, params, batch, rng, train: bool = True):
+        tokens, _position_ids, _labels, _loss_mask = batch
+        cfg = self.model_config
+        mask_rng, dropout_rng = jax.random.split(rng)
+        masked_tokens, mlm_labels = apply_mlm_masking(mask_rng, tokens,
+                                                      cfg)
+        deterministic = not train or (
+            cfg.hidden_dropout_prob == 0.0
+            and cfg.attention_probs_dropout_prob == 0.0)
+        rngs = None if deterministic else {"dropout": dropout_rng}
+        scores, seq_rel = self.model.apply(
+            {"params": params}, masked_tokens,
+            deterministic=deterministic, rngs=rngs)
+        if cfg.with_nsp_loss:
+            # GPTDataset streams carry no sentence-pair labels; NSP
+            # training requires a pairing dataset (reference uses
+            # with_nsp_loss=False on this data for the same reason)
+            raise ValueError("with_nsp_loss requires sentence-pair data")
+        return ernie_pretraining_loss(scores, mlm_labels,
+                                      with_nsp_loss=False)
+
+    def input_spec(self):
+        seq = self.configs.Data.Train.dataset.max_seq_len
+        micro = self.configs.Global.micro_batch_size
+        return [((micro, seq), "int32")]
+
+    def training_step_end(self, log_dict: Dict[str, Any]) -> None:
+        log_dict.setdefault(
+            "max_seq_len", self.configs.Data.Train.dataset.max_seq_len)
+        super().training_step_end(log_dict)

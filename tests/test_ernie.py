@@ -1,0 +1,205 @@
+"""ERNIE family: shapes, masking semantics, criterion, sharded
+equivalence, and a short training run through the engine."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlefleetx_tpu.models.ernie import (
+    ErnieConfig, ErnieForMaskedLM, ErnieForMultipleChoice,
+    ErnieForPretraining, ernie_pretraining_loss,
+)
+from paddlefleetx_tpu.models.ernie.modules import apply_mlm_masking
+from paddlefleetx_tpu.parallel import (
+    TopologyConfig, build_mesh, make_sharding_rules,
+)
+
+CFG = ErnieConfig(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                  num_attention_heads=4, max_position_embeddings=32,
+                  hidden_dropout_prob=0.0,
+                  attention_probs_dropout_prob=0.0)
+
+
+def _init_params(model, ids):
+    variables = model.init({"params": jax.random.key(0)}, ids)
+    return nn.meta.unbox(variables)["params"]
+
+
+def test_pretraining_forward_shapes():
+    ids = jnp.ones((2, 16), jnp.int32)
+    model = ErnieForPretraining(CFG)
+    params = _init_params(model, ids)
+    scores, seq_rel = model.apply({"params": params}, ids)
+    assert scores.shape == (2, 16, 64)
+    assert seq_rel.shape == (2, 2)
+
+
+def test_attention_is_bidirectional():
+    """Changing a late token must change an early token's scores
+    (a causal model would not allow that)."""
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(1, 64, (1, 16)), jnp.int32)
+    model = ErnieForMaskedLM(CFG)
+    params = _init_params(model, ids)
+    base = model.apply({"params": params}, ids)
+    ids2 = ids.at[0, 15].set((int(ids[0, 15]) + 1) % 63 + 1)
+    changed = model.apply({"params": params}, ids2)
+    assert not np.allclose(np.asarray(base[0, 0]),
+                           np.asarray(changed[0, 0]))
+
+
+def test_pad_tokens_are_masked_out():
+    """Pad positions must not influence non-pad positions."""
+    rng = np.random.default_rng(1)
+    core = rng.integers(1, 64, (1, 8))
+    ids_a = jnp.asarray(np.concatenate(
+        [core, np.zeros((1, 8), np.int64)], 1), jnp.int32)
+    ids_b = jnp.asarray(np.concatenate(
+        [core, np.zeros((1, 8), np.int64)], 1), jnp.int32)
+    model = ErnieForMaskedLM(CFG)
+    params = _init_params(model, ids_a)
+    # perturb what's *under* the pad mask: scores at non-pad positions
+    # must be identical because attention ignores pad keys
+    mask = jnp.asarray([[1] * 8 + [0] * 8], jnp.int32)
+    a = model.apply({"params": params}, ids_a, attention_mask=mask)
+    ids_b = ids_b.at[0, 12].set(33)
+    b = model.apply({"params": params}, ids_b, attention_mask=mask)
+    np.testing.assert_allclose(np.asarray(a[:, :8]), np.asarray(b[:, :8]),
+                               atol=1e-6)
+
+
+def test_mlm_masking_semantics():
+    cfg = ErnieConfig(vocab_size=64, masked_lm_prob=0.5, pad_token_id=0)
+    rng = np.random.default_rng(2)
+    tokens = jnp.asarray(rng.integers(1, 64, (4, 64)), jnp.int32)
+    tokens = tokens.at[:, -8:].set(0)  # pad tail
+    masked, labels = apply_mlm_masking(jax.random.key(0), tokens, cfg)
+    sel = np.asarray(labels) >= 0
+    assert 0.2 < sel[:, :-8].mean() < 0.8       # ~masked_lm_prob
+    assert not sel[:, -8:].any()                 # pads never selected
+    # labels hold the original ids at selected positions
+    np.testing.assert_array_equal(np.asarray(labels)[sel],
+                                  np.asarray(tokens)[sel])
+    # unselected positions pass through unchanged
+    np.testing.assert_array_equal(np.asarray(masked)[~sel],
+                                  np.asarray(tokens)[~sel])
+
+
+def test_criterion_ignore_index():
+    """Positions with label -1 must not contribute to the loss."""
+    scores = jnp.asarray(np.random.default_rng(3).normal(size=(2, 4, 8)),
+                         jnp.float32)
+    labels_a = jnp.asarray([[1, -1, 2, -1], [3, -1, -1, 4]])
+    loss_a = ernie_pretraining_loss(scores, labels_a, with_nsp_loss=False)
+    # flipping an ignored position's score must not change the loss
+    scores_b = scores.at[0, 1].add(100.0)
+    loss_b = ernie_pretraining_loss(scores_b, labels_a,
+                                    with_nsp_loss=False)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
+
+
+def test_nsp_loss_returns_pair():
+    scores = jnp.zeros((2, 4, 8), jnp.float32)
+    seq_rel = jnp.asarray([[2.0, 0.0], [0.0, 2.0]], jnp.float32)
+    labels = jnp.asarray([[1, -1, 2, -1], [3, -1, -1, 4]])
+    nsp_labels = jnp.asarray([0, 1])
+    mlm, nsp = ernie_pretraining_loss(scores, labels, seq_rel, nsp_labels,
+                                      with_nsp_loss=True)
+    assert float(nsp) < float(jnp.log(2.0))  # better than chance
+    assert float(mlm) > 0
+
+
+def test_multiple_choice_shape():
+    ids = jnp.ones((2, 3, 8), jnp.int32)
+    model = ErnieForMultipleChoice(CFG, num_choices=3)
+    params = _init_params(model, ids)
+    logits = model.apply({"params": params}, ids)
+    assert logits.shape == (2, 3)
+
+
+def test_recompute_with_dropout_trains():
+    """use_recompute + dropout must grad cleanly (the deterministic
+    flag has to be static under nn.remat)."""
+    cfg = ErnieConfig(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                      num_attention_heads=4, max_position_embeddings=32,
+                      hidden_dropout_prob=0.1, use_recompute=True)
+    ids = jnp.ones((2, 16), jnp.int32)
+    model = ErnieForPretraining(cfg)
+    params = _init_params(model, ids)
+    labels = jnp.zeros((2, 16), jnp.int32)
+
+    def loss(p, rng):
+        scores, _ = model.apply(
+            {"params": p}, ids, deterministic=False,
+            rngs={"dropout": rng})
+        return ernie_pretraining_loss(scores, labels, with_nsp_loss=False)
+
+    g = jax.jit(jax.grad(loss))(params, jax.random.key(1))
+    assert np.isfinite(float(jax.tree.leaves(g)[0].sum()))
+
+
+def test_sharded_matches_single_device():
+    """dp2 x mp2 x fsdp2 forward == single-device forward."""
+    rng = np.random.default_rng(4)
+    ids = jnp.asarray(rng.integers(1, 64, (4, 16)), jnp.int32)
+    model = ErnieForPretraining(CFG)
+    params = _init_params(model, ids)
+    ref_scores, ref_rel = model.apply({"params": params}, ids)
+
+    topo = TopologyConfig(dp_degree=2, mp_degree=2,
+                          sharding_degree=2, sharding_stage=1)
+    mesh = build_mesh(topo)
+    rules = make_sharding_rules(topo)
+    logical = nn.get_partition_spec(
+        jax.eval_shape(model.init, {"params": jax.random.key(0)}, ids))
+    shardings = nn.logical_to_mesh_sharding(logical, mesh, list(rules))
+    params_s = jax.device_put({"params": params},
+                              nn.meta.unbox(shardings))["params"]
+    with mesh, nn.logical_axis_rules(list(rules)):
+        scores, rel = jax.jit(
+            lambda p, i: model.apply({"params": p}, i))(params_s, ids)
+    np.testing.assert_allclose(np.asarray(ref_scores), np.asarray(scores),
+                               atol=2e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ref_rel), np.asarray(rel),
+                               atol=2e-5, rtol=1e-5)
+
+
+def test_ernie_trains_through_engine(tmp_path):
+    """Loss decreases over a short run on the CPU mesh, through the
+    same unified engine the GPT module uses."""
+    from paddlefleetx_tpu.core import Engine
+    from paddlefleetx_tpu.data import build_dataloader
+    from paddlefleetx_tpu.models import build_module
+    from test_data import make_corpus
+    from test_engine import tiny_config
+
+    make_corpus(tmp_path, n_docs=40, doc_len_range=(20, 60), vocab=128,
+                eos=127)
+    cfg = tiny_config(tmp_path, **{"Engine.max_steps": 12,
+                                   "Engine.logging_freq": 3})
+    cfg.Model = type(cfg.Model)({
+        "module": "ErnieModule", "name": "Ernie",
+        "vocab_size": 128, "hidden_size": 32, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "max_position_embeddings": 64,
+        "hidden_dropout_prob": 0.0, "attention_probs_dropout_prob": 0.0,
+        "masked_lm_prob": 0.3, "mask_token_id": 127,
+    })
+    module = build_module(cfg)
+    engine = Engine(cfg, module, mode="train")
+    loader = build_dataloader(cfg.Data, "Train", num_replicas=1, rank=0)
+    loader.batch_sampler.batch_size = cfg.Global.global_batch_size
+
+    losses = []
+    orig = engine.module.training_step_end
+
+    def capture(log):
+        losses.append(log["loss"])
+        orig(log)
+
+    engine.module.training_step_end = capture
+    engine.fit(epoch=1, train_data_loader=loader)
+    assert len(losses) == 4
+    assert np.isfinite(losses[-1])
+    assert losses[-1] < losses[0], losses
