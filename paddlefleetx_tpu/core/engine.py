@@ -72,7 +72,10 @@ class Engine(BasicEngine):
         self.mode = mode
 
         eng = configs.Engine
-        self.max_steps = eng.get("max_steps", sys.maxsize)
+        # max_steps <= 0 means unlimited (epoch-mode configs set -1)
+        raw_max_steps = eng.get("max_steps", None)
+        self.max_steps = raw_max_steps \
+            if raw_max_steps and raw_max_steps > 0 else sys.maxsize
         self.logging_freq = eng.get("logging_freq", 1)
         self.eval_freq = eng.get("eval_freq", sys.maxsize)
         self.eval_iters = eng.get("eval_iters", 10)
@@ -107,9 +110,19 @@ class Engine(BasicEngine):
 
     def _abstract_state(self):
         model = self.module.model
+        spec = self.module.input_spec()
+        if spec:
+            shape, dtype = spec[0]
+            shape = tuple(1 if d is None else int(d) for d in shape)
+            # a full-size dummy is wasteful for abstract init; shrink
+            # the batch dim (weights don't depend on it)
+            shape = (1,) + shape[1:]
+            sample_shape, sample_dtype = shape, jnp.dtype(dtype)
+        else:
+            sample_shape, sample_dtype = (1, 8), jnp.int32
 
         def init_fn(rng):
-            sample = jnp.zeros((1, 8), jnp.int32)
+            sample = jnp.zeros(sample_shape, sample_dtype)
             variables = model.init({"params": rng}, sample)
             params = variables["params"]
             state = {"params": params, "step": jnp.zeros((), jnp.int32)}
@@ -140,6 +153,18 @@ class Engine(BasicEngine):
     def _init_state(self):
         if self.mode == "train":
             opt_cfg = self.configs.Optimizer
+            self._vit_lr_pending = False
+            if "lr" in opt_cfg and \
+                    opt_cfg.lr.get("name") == "ViTLRScheduler" and \
+                    "step_each_epoch" not in opt_cfg.lr:
+                # the reference injects step_each_epoch from the
+                # dataloader length, known only at fit() time; build
+                # a placeholder now and rebuild in fit()
+                self._vit_lr_pending = True
+                opt_cfg.lr.setdefault(
+                    "epochs", self.configs.Engine.get(
+                        "num_train_epochs", 1))
+                opt_cfg.lr["step_each_epoch"] = 1
             self.lr_schedule = build_lr_scheduler(opt_cfg.lr) \
                 if "lr" in opt_cfg else (
                     lambda step: opt_cfg.get("learning_rate", 1e-4))
@@ -226,9 +251,15 @@ class Engine(BasicEngine):
             return new_state, metrics
 
         def eval_step(state, batch):
-            loss = module.loss_fn(state["params"], batch, root_rng,
-                                  train=False)
-            return {"loss": loss}
+            # modules may expose a combined jitted eval fn returning
+            # {"loss": ..., metric-name: ...} from ONE forward (the
+            # classification module's loss + TopkAcc); default is
+            # loss_fn alone
+            outputs_fn = getattr(module, "eval_outputs_fn", None)
+            if outputs_fn is not None:
+                return outputs_fn(state["params"], batch)
+            return {"loss": module.loss_fn(state["params"], batch,
+                                           root_rng, train=False)}
 
         if self.mode == "train":
             self._train_step = jax.jit(
@@ -266,8 +297,29 @@ class Engine(BasicEngine):
 
     # -- loops ----------------------------------------------------------
 
+    def _finalize_vit_schedule(self, train_data_loader) -> None:
+        """Rebuild the ViT LR schedule with the true steps-per-epoch
+        (reference computes it from the dataloader at build time).
+        Safe before the first step: the optimizer state layout does
+        not depend on the schedule."""
+        if not getattr(self, "_vit_lr_pending", False):
+            return
+        self._vit_lr_pending = False
+        try:
+            steps = len(train_data_loader)
+        except TypeError:
+            return
+        if not steps:
+            return
+        opt_cfg = self.configs.Optimizer
+        opt_cfg.lr["step_each_epoch"] = steps
+        self.lr_schedule = build_lr_scheduler(opt_cfg.lr)
+        self.tx = build_optimizer(opt_cfg, self.lr_schedule)
+        self._build_steps()
+
     def fit(self, epoch: int = 1, train_data_loader=None,
             valid_data_loader=None):
+        self._finalize_vit_schedule(train_data_loader)
         start_epoch = self._load_recovery["epoch"]
         consumed = self._load_recovery["consumed_samples"]
         for ep in range(start_epoch, epoch):
@@ -283,6 +335,11 @@ class Engine(BasicEngine):
                     int(self.state["step"]) % self.save_steps != 0:
                 self.save(ep + 1)
             consumed = 0
+            if self._host_step >= self.max_steps:
+                # stop the epoch loop too — otherwise an
+                # epoch-mode run (num_train_epochs >> steps) spins
+                # through empty epochs re-saving checkpoints
+                break
         set_mesh(None)
 
     def _train_one_epoch(self, epoch: int, train_data_loader,
@@ -332,9 +389,10 @@ class Engine(BasicEngine):
             batch = self.module.pretreating_batch(batch)
             out = self._eval_step(self.state, self._put_batch(batch))
             losses.append(float(out["loss"]))
+            extra = {k: float(v) for k, v in out.items() if k != "loss"}
             self.module.validation_step_end({
                 "epoch": epoch, "batch": i, "loss": losses[-1],
-                "eval_cost": (time.time() - t0) / (i + 1)})
+                "eval_cost": (time.time() - t0) / (i + 1), **extra})
         mean = float(np.mean(losses)) if losses else float("nan")
         self.module.validation_epoch_end(
             {"epoch": epoch, "loss": mean,

@@ -67,6 +67,16 @@ class BasicModule:
         """Abstract input shapes/dtypes for export (AOT compile)."""
         return None
 
+    def _data_section(self):
+        """First present Data mode section (eval-only configs have no
+        Train; offline eval builds modules too)."""
+        data = self.configs.Data
+        section = data.get("Train") or data.get("Eval") or \
+            data.get("Test")
+        if section is None:
+            raise ValueError("config has no Data.Train/Eval/Test section")
+        return section
+
 
 class LanguageModule(BasicModule):
     """Adds the LM throughput logging contract

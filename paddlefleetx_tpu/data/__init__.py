@@ -36,16 +36,26 @@ def register_dataset(name):
 
 def _populate():
     DATASETS.setdefault("GPTDataset", GPTDataset)
-    try:
-        from .dataset.gpt_dataset_eval import (
-            Lambada_Eval_Dataset, LM_Eval_Dataset)
-        DATASETS.setdefault("LM_Eval_Dataset", LM_Eval_Dataset)
-        DATASETS.setdefault("Lambada_Eval_Dataset", Lambada_Eval_Dataset)
-    except ModuleNotFoundError as e:
-        # tolerate only this optional module being absent; broken
-        # imports inside it must propagate
-        if e.name != f"{__package__}.dataset.gpt_dataset_eval":
-            raise
+    optional = {
+        "dataset.gpt_dataset_eval": ("LM_Eval_Dataset",
+                                     "Lambada_Eval_Dataset"),
+        "dataset.vision_dataset": ("GeneralClsDataset", "ImageFolder",
+                                   "CIFAR"),
+    }
+    import importlib
+    for mod, names in optional.items():
+        try:
+            m = importlib.import_module(f".{mod}", __package__)
+        except ModuleNotFoundError as e:
+            # tolerate the optional module (or an optional third-party
+            # dependency of it, e.g. Pillow) being absent; broken
+            # imports inside the package must propagate
+            if e.name != f"{__package__}.{mod}" and \
+                    f"{__package__}." in (e.name or ""):
+                raise
+            continue
+        for name in names:
+            DATASETS.setdefault(name, getattr(m, name))
 
 
 def build_dataset(config, mode: str):
@@ -85,5 +95,6 @@ def build_dataloader(config, mode: str, num_replicas: int = 1,
     loader_cfg = copy.deepcopy(dict(config[mode].get("loader", {})))
     loader_cfg.pop("return_list", None)
     collate_name = loader_cfg.pop("collate_fn", None)
-    collate = COLLATE_FNS[collate_name] if collate_name else None
+    # unnamed -> field-stacking default (vision configs name none)
+    collate = COLLATE_FNS[collate_name or "default_collate_fn"]
     return DataLoader(dataset, sampler, collate, **loader_cfg)

@@ -1,0 +1,113 @@
+"""Vision classification datasets.
+
+Reference ``ppfleetx/data/dataset/vision_dataset.py``:
+``GeneralClsDataset`` (:26) reads an image root + a label list file
+("relpath<delim>label" per line); ``ImageFolder`` (:105) walks class
+subdirectories; ``CIFAR`` (:295) reads the python-pickle CIFAR batches.
+All three apply a ``transform_ops`` pipeline and return
+``(image, label)`` samples. No download here (the reference fetches
+CIFAR over the network): archives must already be on disk.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..transforms import build_transforms
+
+
+class GeneralClsDataset:
+    """image_root + "path label" list file (reference :26-103)."""
+
+    def __init__(self, image_root: str, cls_label_path: str,
+                 transform_ops=None, delimiter: Optional[str] = None,
+                 class_num: Optional[int] = None,
+                 multi_label: bool = False):
+        self.image_root = image_root
+        self.class_num = class_num
+        self.delimiter = delimiter if delimiter is not None else " "
+        self.transform = build_transforms(transform_ops) \
+            if transform_ops else None
+        self.images: List[str] = []
+        self.labels: List[int] = []
+        with open(cls_label_path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                path, label = line.rsplit(self.delimiter, 1)
+                self.images.append(os.path.join(image_root, path))
+                self.labels.append(int(label))
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+    def __getitem__(self, idx: int) -> Tuple[np.ndarray, np.int64]:
+        with open(self.images[idx], "rb") as f:
+            img = f.read()
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            from ..transforms.preprocess import DecodeImage
+            img = DecodeImage()(img)
+        return np.asarray(img), np.int64(self.labels[idx])
+
+
+class ImageFolder(GeneralClsDataset):
+    """Class-per-subdirectory layout (reference :105-»): labels are
+    the sorted subdirectory index."""
+
+    def __init__(self, root: str, transform_ops=None):
+        self.image_root = root
+        self.transform = build_transforms(transform_ops) \
+            if transform_ops else None
+        classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.class_num = len(classes)
+        self.images, self.labels = [], []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                self.images.append(os.path.join(cdir, fname))
+                self.labels.append(self.class_to_idx[c])
+
+
+class CIFAR:
+    """CIFAR-10/100 from the on-disk python-pickle batches
+    (reference :295-»; download is out of scope here — zero egress)."""
+
+    def __init__(self, data_file: str, mode: str = "train",
+                 transform_ops=None, dataset_type: str = "cifar10"):
+        self.transform = build_transforms(transform_ops) \
+            if transform_ops else None
+        if dataset_type == "cifar10":
+            files = [f"data_batch_{i}" for i in range(1, 6)] \
+                if mode == "train" else ["test_batch"]
+            label_key = b"labels"
+        else:
+            files = ["train"] if mode == "train" else ["test"]
+            label_key = b"fine_labels"
+        data, labels = [], []
+        for fname in files:
+            with open(os.path.join(data_file, fname), "rb") as f:
+                entry = pickle.load(f, encoding="bytes")
+            data.append(entry[b"data"])
+            labels.extend(entry[label_key])
+        self.data = np.concatenate(data).reshape(-1, 3, 32, 32) \
+            .transpose((0, 2, 3, 1))  # HWC
+        self.labels = np.asarray(labels, np.int64)
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    def __getitem__(self, idx: int):
+        img = self.data[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return np.asarray(img), self.labels[idx]

@@ -69,6 +69,17 @@ class Dict:
                 for key, fn in self._fns.items()}
 
 
+def default_collate_fn(batch):
+    """Stack each field of ``(a, b, ...)`` samples into arrays — the
+    loader's fallback when no collate is named (vision datasets)."""
+    import numpy as np
+    first = batch[0]
+    if isinstance(first, (tuple, list)):
+        return tuple(np.stack([np.asarray(s[i]) for s in batch])
+                     for i in range(len(first)))
+    return np.stack([np.asarray(s) for s in batch])
+
+
 def gpt_collate_fn(batch):
     """(tokens, position_ids, labels, loss_mask) stacked batch."""
     return Tuple(Stack(), Stack(), Stack(), Stack())(batch)
@@ -83,6 +94,7 @@ def gpt_eval_collate_fn(batch):
 
 
 COLLATE_FNS: dict[str, Callable] = {
+    "default_collate_fn": default_collate_fn,
     "gpt_collate_fn": gpt_collate_fn,
     "gpt_inference_collate_fn": gpt_inference_collate_fn,
     "gpt_eval_collate_fn": gpt_eval_collate_fn,

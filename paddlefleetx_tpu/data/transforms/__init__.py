@@ -1,0 +1,25 @@
+"""Vision preprocessing transforms (PIL/numpy)."""
+
+from .preprocess import (
+    TRANSFORMS,
+    CenterCropImage,
+    DecodeImage,
+    NormalizeImage,
+    RandCropImage,
+    RandFlipImage,
+    ResizeImage,
+    ToCHWImage,
+    build_transforms,
+)
+
+__all__ = [
+    "TRANSFORMS",
+    "CenterCropImage",
+    "DecodeImage",
+    "NormalizeImage",
+    "RandCropImage",
+    "RandFlipImage",
+    "ResizeImage",
+    "ToCHWImage",
+    "build_transforms",
+]

@@ -1,0 +1,221 @@
+"""Image preprocessing ops mirroring the reference's transform zoo.
+
+Reference ``ppfleetx/data/transforms/preprocess.py:37+`` implements
+cv2/PIL-backed ``DecodeImage/ResizeImage/RandCropImage/CenterCropImage/
+RandFlipImage/NormalizeImage/ToCHWImage`` configured from YAML
+``transform_ops`` lists. This is a PIL+numpy implementation of the
+same names/knobs (cv2 isn't a dependency here; ``backend`` is accepted
+and ignored beyond interpolation selection).
+"""
+
+from __future__ import annotations
+
+import io
+import random
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def _pil():
+    # lazy: Pillow stays an optional dependency of the text-only paths
+    from PIL import Image
+    return Image
+
+
+def _interp(name):
+    Image = _pil()
+    return {
+        "nearest": Image.NEAREST,
+        "bilinear": Image.BILINEAR,
+        "bicubic": Image.BICUBIC,
+        "lanczos": Image.LANCZOS,
+        None: Image.BILINEAR,
+    }.get(name, Image.BILINEAR)
+
+
+def _to_pil(img):
+    Image = _pil()
+    if isinstance(img, Image.Image):
+        return img
+    if isinstance(img, (bytes, bytearray)):
+        return Image.open(io.BytesIO(img))
+    return Image.fromarray(np.asarray(img, np.uint8))
+
+
+class DecodeImage:
+    """bytes/ndarray -> RGB (or raw) HWC uint8 array."""
+
+    def __init__(self, to_rgb: bool = True, channel_first: bool = False,
+                 backend: str = "pil"):
+        self.to_rgb = to_rgb
+        self.channel_first = channel_first
+
+    def __call__(self, img):
+        pil = _to_pil(img)
+        if self.to_rgb:
+            pil = pil.convert("RGB")
+        arr = np.asarray(pil)
+        if self.channel_first:
+            arr = arr.transpose((2, 0, 1))
+        return arr
+
+
+class ResizeImage:
+    """Resize to ``size`` (int or (w, h)) or scale the short side to
+    ``resize_short``."""
+
+    def __init__(self, size=None, resize_short=None,
+                 interpolation: Optional[str] = None,
+                 backend: str = "pil"):
+        if (size is None) == (resize_short is None):
+            raise ValueError("exactly one of size / resize_short required")
+        self.size = (size, size) if isinstance(size, int) else size
+        self.resize_short = resize_short
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        pil = _to_pil(img)
+        w, h = pil.size
+        if self.resize_short is not None:
+            scale = self.resize_short / min(w, h)
+            target = (max(1, int(round(w * scale))),
+                      max(1, int(round(h * scale))))
+        else:
+            target = tuple(self.size)
+        return np.asarray(pil.resize(target,
+                                       _interp(self.interpolation)))
+
+
+class CenterCropImage:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else size
+
+    def __call__(self, img):
+        arr = np.asarray(_to_pil(img))
+        h, w = arr.shape[:2]
+        cw, ch = self.size
+        top = max(0, (h - ch) // 2)
+        left = max(0, (w - cw) // 2)
+        return arr[top:top + ch, left:left + cw]
+
+
+class RandCropImage:
+    """Random resized crop (area ``scale``, aspect ``ratio``), the
+    Inception-style augmentation the reference uses for ViT training."""
+
+    def __init__(self, size, scale: Sequence[float] = (0.08, 1.0),
+                 ratio: Sequence[float] = (3 / 4, 4 / 3),
+                 interpolation: Optional[str] = None,
+                 backend: str = "pil"):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        pil = _to_pil(img)
+        w, h = pil.size
+        area = w * h
+        for _ in range(10):
+            target_area = area * random.uniform(*self.scale)
+            aspect = np.exp(random.uniform(np.log(self.ratio[0]),
+                                           np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                left = random.randint(0, w - cw)
+                top = random.randint(0, h - ch)
+                crop = pil.crop((left, top, left + cw, top + ch))
+                return np.asarray(crop.resize(
+                    tuple(self.size), _interp(self.interpolation)))
+        # fallback: center crop of the short side
+        short = min(w, h)
+        left, top = (w - short) // 2, (h - short) // 2
+        crop = pil.crop((left, top, left + short, top + short))
+        return np.asarray(crop.resize(tuple(self.size),
+                                      _interp(self.interpolation)))
+
+
+class RandFlipImage:
+    """flip_code 1 = horizontal (the reference's cv2 convention),
+    0 = vertical, -1 = both."""
+
+    def __init__(self, flip_code: int = 1):
+        self.flip_code = flip_code
+
+    def __call__(self, img):
+        arr = np.asarray(_to_pil(img))
+        if random.random() < 0.5:
+            if self.flip_code in (1, -1):
+                arr = arr[:, ::-1]
+            if self.flip_code in (0, -1):
+                arr = arr[::-1]
+        return np.ascontiguousarray(arr)
+
+
+class NormalizeImage:
+    """(x * scale - mean) / std in float32; ``scale`` accepts the
+    YAML string form '1.0/255.0'."""
+
+    def __init__(self, scale=None, mean=None, std=None, order: str = "",
+                 output_fp16: bool = False, channel_num: int = 3):
+        if isinstance(scale, str):
+            scale = eval(scale, {"__builtins__": {}})  # e.g. "1.0/255.0"
+        self.scale = np.float32(scale if scale is not None else 1.0 / 255.0)
+        shape = (3, 1, 1) if order == "chw" else (1, 1, 3)
+        self.mean = np.asarray(
+            mean if mean is not None else [0.485, 0.456, 0.406],
+            np.float32).reshape(shape)
+        self.std = np.asarray(
+            std if std is not None else [0.229, 0.224, 0.225],
+            np.float32).reshape(shape)
+        self.dtype = np.float16 if output_fp16 else np.float32
+
+    def __call__(self, img):
+        arr = np.asarray(img).astype(np.float32)
+        return ((arr * self.scale - self.mean) / self.std).astype(
+            self.dtype)
+
+
+class ToCHWImage:
+    def __call__(self, img):
+        return np.ascontiguousarray(np.asarray(img).transpose((2, 0, 1)))
+
+
+TRANSFORMS = {
+    "DecodeImage": DecodeImage,
+    "ResizeImage": ResizeImage,
+    "CenterCropImage": CenterCropImage,
+    "RandCropImage": RandCropImage,
+    "RandFlipImage": RandFlipImage,
+    "NormalizeImage": NormalizeImage,
+    "ToCHWImage": ToCHWImage,
+}
+
+
+def build_transforms(transform_ops):
+    """YAML ``transform_ops`` list -> composed callable.
+
+    Each entry is ``{Name: {kwargs}}`` or a bare ``Name`` (reference
+    ``data/__init__`` transform assembly).
+    """
+    ops = []
+    for entry in transform_ops or []:
+        if isinstance(entry, str):
+            name, kwargs = entry, {}
+        else:
+            name, kwargs = next(iter(entry.items()))
+            kwargs = dict(kwargs or {})
+        if name not in TRANSFORMS:
+            raise ValueError(
+                f"unknown transform {name!r}; available: "
+                f"{sorted(TRANSFORMS)}")
+        ops.append(TRANSFORMS[name](**kwargs))
+
+    def apply(img):
+        for op in ops:
+            img = op(img)
+        return img
+
+    return apply

@@ -76,7 +76,7 @@ class ErnieModule(LanguageModule):
                                       with_nsp_loss=False)
 
     def input_spec(self):
-        seq = self.configs.Data.Train.dataset.max_seq_len
+        seq = self._data_section().dataset.max_seq_len
         micro = self.configs.Global.micro_batch_size
         return [((micro, seq), "int32")]
 

@@ -59,7 +59,7 @@ class GPTModule(LanguageModule):
         return cross_entropy_loss(logits, labels, loss_mask)
 
     def input_spec(self):
-        seq = self.configs.Data.Train.dataset.max_seq_len
+        seq = self._data_section().dataset.max_seq_len
         micro = self.configs.Global.micro_batch_size
         return [((micro, seq), "int32"), ((micro, seq), "int32")]
 

@@ -1,0 +1,22 @@
+"""ViT model family (vision transformer classification)."""
+
+from .loss import CELoss, ViTCELoss
+from .metrics import TopkAcc
+from .vit import (
+    VISION_MODELS,
+    ViT,
+    ViTConfig,
+    build_vision_model,
+    interpolate_pos_embed,
+)
+
+__all__ = [
+    "CELoss",
+    "TopkAcc",
+    "VISION_MODELS",
+    "ViT",
+    "ViTCELoss",
+    "ViTConfig",
+    "build_vision_model",
+    "interpolate_pos_embed",
+]

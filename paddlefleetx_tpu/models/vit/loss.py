@@ -1,0 +1,82 @@
+"""Classification losses (reference ``vision_model/loss/cross_entropy.py``).
+
+``CELoss``: softmax CE with optional label smoothing; accepts hard int
+labels or soft ``[b, C]`` targets (:25-61). ``ViTCELoss``: sigmoid
+(binary) CE summed over classes with the ViT-style smoothing
+``label*(1-eps)+eps`` (:64-95). Both reduce by mean over the batch and
+compute in fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _one_hot_if_needed(labels: jax.Array, class_num: int) -> jax.Array:
+    if labels.ndim >= 2 and labels.shape[-1] == class_num:
+        return labels.astype(jnp.float32)
+    return jax.nn.one_hot(labels.reshape(-1), class_num,
+                          dtype=jnp.float32)
+
+
+class CELoss:
+    """Softmax cross entropy with optional label smoothing."""
+
+    def __init__(self, epsilon: Optional[float] = None):
+        if epsilon is not None and not 0 <= epsilon <= 1:
+            raise ValueError("epsilon must be in [0, 1]")
+        self.epsilon = epsilon
+
+    def __call__(self, logits: jax.Array, labels: jax.Array) -> jax.Array:
+        logits = logits.astype(jnp.float32)
+        class_num = logits.shape[-1]
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+        soft = labels.ndim >= 2 and labels.shape[-1] == class_num
+        if self.epsilon is not None:
+            target = _one_hot_if_needed(labels, class_num)
+            # paddle.nn.functional.label_smooth
+            target = target * (1 - self.epsilon) + self.epsilon / class_num
+            loss = -jnp.sum(target * log_probs, axis=-1)
+        elif soft:
+            loss = -jnp.sum(labels.astype(jnp.float32) * log_probs,
+                            axis=-1)
+        else:
+            loss = -jnp.take_along_axis(
+                log_probs, labels.reshape(-1, 1).astype(jnp.int32),
+                axis=-1)[..., 0]
+        return jnp.mean(loss)
+
+
+class ViTCELoss:
+    """Sigmoid CE summed over classes (ViT pretraining recipe)."""
+
+    def __init__(self, epsilon: Optional[float] = None):
+        if epsilon is not None and not 0 <= epsilon <= 1:
+            raise ValueError("epsilon must be in [0, 1]")
+        self.epsilon = epsilon
+
+    def __call__(self, logits: jax.Array, labels: jax.Array) -> jax.Array:
+        logits = logits.astype(jnp.float32)
+        class_num = logits.shape[-1]
+        target = _one_hot_if_needed(labels, class_num)
+        if self.epsilon is not None:
+            target = target * (1.0 - self.epsilon) + self.epsilon
+        # binary_cross_entropy_with_logits, reduction none -> sum classes
+        loss = jnp.maximum(logits, 0) - logits * target + \
+            jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return jnp.mean(jnp.sum(loss, axis=-1))
+
+
+LOSSES = {"CELoss": CELoss, "ViTCELoss": ViTCELoss}
+
+
+def build_loss(cfg):
+    cfg = dict(cfg)
+    name = cfg.pop("name")
+    if name not in LOSSES:
+        raise ValueError(
+            f"unknown loss {name!r}; available: {sorted(LOSSES)}")
+    return LOSSES[name](**cfg)

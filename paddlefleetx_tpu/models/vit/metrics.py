@@ -1,0 +1,44 @@
+"""Classification metrics (reference ``vision_model/metrics/accuracy.py``).
+
+``TopkAcc`` returns ``{"top1": ..., "top5": ..., "metric": top-first}``
+like the reference's dict contract (:19-43).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+
+class TopkAcc:
+    def __init__(self, topk: Union[int, Sequence[int]] = (1, 5)):
+        self.topk = [topk] if isinstance(topk, int) else list(topk)
+
+    def __call__(self, logits: jax.Array,
+                 labels: jax.Array) -> Dict[str, jax.Array]:
+        labels = labels.reshape(-1)
+        k_max = max(self.topk)
+        _, top_idx = jax.lax.top_k(logits, k_max)
+        hits = top_idx == labels[:, None]
+        out: Dict[str, jax.Array] = {}
+        for i, k in enumerate(self.topk):
+            acc = jnp.mean(jnp.any(hits[:, :k], axis=-1)
+                           .astype(jnp.float32))
+            out[f"top{k}"] = acc
+            if i == 0:
+                out["metric"] = acc
+        return out
+
+
+METRICS = {"TopkAcc": TopkAcc}
+
+
+def build_metric(cfg):
+    cfg = dict(cfg)
+    name = cfg.pop("name")
+    if name not in METRICS:
+        raise ValueError(
+            f"unknown metric {name!r}; available: {sorted(METRICS)}")
+    return METRICS[name](**cfg)

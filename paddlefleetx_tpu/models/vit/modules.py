@@ -1,0 +1,101 @@
+"""General classification module (reference
+``vision_model/general_classification_module.py:38-161``): builds
+model / train+eval losses / metrics from the ``Model`` YAML section,
+logs images/sec, and tracks the best eval metric.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import register_module
+from ...core.module import BasicModule
+from ...utils.log import logger
+from .loss import build_loss
+from .metrics import build_metric
+from .vit import build_vision_model
+
+
+@register_module("GeneralClsModule")
+class GeneralClsModule(BasicModule):
+    def __init__(self, configs):
+        model_cfg = configs.Model
+        if "train" not in model_cfg.get("loss", {}):
+            raise ValueError("Model.loss.train is required")
+        self.train_loss = build_loss(model_cfg.loss.train)
+        self.eval_loss = build_loss(model_cfg.loss.eval) \
+            if "eval" in model_cfg.get("loss", {}) else self.train_loss
+        metric_cfg = model_cfg.get("metric", {})
+        self.train_metric = build_metric(metric_cfg["train"]) \
+            if "train" in metric_cfg else None
+        self.eval_metric = build_metric(metric_cfg["eval"]) \
+            if "eval" in metric_cfg else None
+        super().__init__(configs)
+        self.best_metric = 0.0
+        self.acc_list = []
+
+    def get_model(self):
+        return build_vision_model(self.configs.Model.model)
+
+    def loss_fn(self, params, batch, rng, train: bool = True):
+        images, labels = batch
+        deterministic = not train
+        rngs = None if deterministic else {"dropout": rng}
+        logits = self.model.apply({"params": params}, images,
+                                  deterministic=deterministic, rngs=rngs)
+        loss = self.train_loss if train else self.eval_loss
+        return loss(logits, labels)
+
+    def eval_outputs_fn(self, params, batch):
+        """Loss + metrics from a single forward (the engine's combined
+        eval-step contract)."""
+        images, labels = batch
+        logits = self.model.apply({"params": params}, images,
+                                  deterministic=True)
+        out = {"loss": self.eval_loss(logits, labels)}
+        if self.eval_metric is not None:
+            out.update(self.eval_metric(logits, labels))
+        return out
+
+    def input_spec(self):
+        model = self.configs.Model.model
+        size = model.get("img_size", 224)
+        return [((None, 3, size, size), "float32")]
+
+    def training_step_end(self, log_dict: Dict[str, Any]) -> None:
+        bs = self.configs.Global.global_batch_size
+        ips = bs / log_dict["train_cost"]
+        logger.train(
+            "[train] epoch: %d, step: %d, learning rate: %.7f, loss: "
+            "%.9f, batch_cost: %.5f sec, ips: %.2f images/sec",
+            log_dict["epoch"], log_dict["batch"], log_dict["lr"],
+            log_dict["loss"], log_dict["train_cost"], ips)
+
+    def validation_step_end(self, log_dict: Dict[str, Any]) -> None:
+        if "metric" in log_dict:
+            self.acc_list.append(
+                {k: float(v) for k, v in log_dict.items()
+                 if k.startswith("top") or k == "metric"})
+        logger.eval(
+            "[eval] epoch: %d, step: %d, loss: %.9f, batch_cost: %.5f "
+            "sec", log_dict["epoch"], log_dict["batch"],
+            log_dict["loss"], log_dict["eval_cost"])
+
+    def validation_epoch_end(self, log_dict: Dict[str, Any]) -> None:
+        msg = ""
+        if self.acc_list:
+            keys = [k for k in self.acc_list[0] if k != "metric"]
+            means = {k: float(np.mean([a[k] for a in self.acc_list]))
+                     for k in keys}
+            metric = float(np.mean([a["metric"] for a in self.acc_list]))
+            self.acc_list = []
+            if metric > self.best_metric:
+                self.best_metric = metric
+            msg = ", ".join(f"{k}: {v:.5f}" for k, v in means.items())
+            msg += f", best_metric: {self.best_metric:.5f}, "
+            self.metrics = {**means, "best_metric": self.best_metric}
+        logger.info("[eval] epoch: %d, %stotal time: %.5f sec",
+                    log_dict["epoch"], msg, log_dict["eval_cost"])

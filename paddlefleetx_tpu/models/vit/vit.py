@@ -1,0 +1,336 @@
+"""TPU-native ViT with the reference's architecture and model zoo.
+
+Behavior parity (reference ``vision_model/vit/vit.py``):
+  - conv patch embedding, prepended [CLS] token, learned pos embed
+    (truncated-normal .02), embedding dropout (:127-139)
+  - pre-LN blocks: ``x + DropPath(attn(LN(x)))`` then
+    ``x + DropPath(mlp(LN(x)))`` (:93-96); stochastic-depth rates
+    linspaced 0..drop_path_rate over depth (:140)
+  - attention with optional qkv bias / qk scale, xavier-uniform
+    weights (:70-79 of ``layers/attention.py``)
+  - final LN, take [CLS], optional representation head (dense+tanh,
+    head bias init -10) else zero-init classifier head (:158-177)
+  - model zoo builders ``ViT_base_patch16_224`` ... ``ViT_6B_patch14``
+    (:261-434) and pos-embed interpolation for resolution transfer
+    (:207-259)
+
+TPU-first: NHWC layout (images arrive CHW from the reference's
+``ToCHWImage`` pipelines and are transposed once at the module
+boundary), logical sharding axes like the GPT/ERNIE models, python
+loop over blocks (per-layer drop-path rates; depth is small).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...parallel.sharding import with_logical_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    img_size: int = 224
+    patch_size: int = 16
+    in_chans: int = 3
+    class_num: int = 1000
+    embed_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_ratio: float = 4.0
+    qkv_bias: bool = False
+    qk_scale: Optional[float] = None
+    drop_rate: float = 0.0
+    attn_drop_rate: float = 0.0
+    drop_path_rate: float = 0.0
+    epsilon: float = 1e-5
+    representation_size: Optional[int] = None
+    dtype: str = "float32"
+    param_dtype: str = "float32"
+
+    @property
+    def num_patches(self) -> int:
+        return (self.img_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+
+def _xavier():
+    return nn.initializers.xavier_uniform()
+
+
+def drop_path(x: jax.Array, rate: float, deterministic: bool,
+              rng: Optional[jax.Array]) -> jax.Array:
+    """Stochastic depth: drop the whole residual branch per sample
+    (reference ``layers/droppath.py``)."""
+    if deterministic or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    mask = jax.random.bernoulli(rng, keep, shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+class ViTAttention(nn.Module):
+    """qkv (optional bias) -> scaled softmax -> proj (reference
+    ``layers/attention.py:21-60``)."""
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        nh, hd = cfg.num_heads, cfg.head_dim
+        dtype = jnp.dtype(cfg.dtype)
+        qkv = nn.DenseGeneral(
+            (3, nh, hd), axis=-1, name="qkv", use_bias=cfg.qkv_bias,
+            dtype=dtype, param_dtype=jnp.dtype(cfg.param_dtype),
+            kernel_init=nn.with_logical_partitioning(
+                _xavier(), ("embed", None, "heads", "kv")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), (None, "heads", "kv")))(x)
+        q, k, v = (qkv[..., i, :, :] for i in range(3))
+        scale = cfg.qk_scale or hd ** -0.5
+        attn = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        attn = jax.nn.softmax(attn.astype(jnp.float32), axis=-1)
+        attn = attn.astype(dtype)
+        attn = nn.Dropout(cfg.attn_drop_rate)(
+            attn, deterministic=deterministic)
+        out = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+        out = nn.DenseGeneral(
+            cfg.embed_dim, axis=(-2, -1), name="proj", dtype=dtype,
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            kernel_init=nn.with_logical_partitioning(
+                _xavier(), ("heads", "kv", "embed")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("embed",)))(out)
+        return nn.Dropout(cfg.drop_rate)(out,
+                                         deterministic=deterministic)
+
+
+class ViTMLP(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        hidden = int(cfg.embed_dim * cfg.mlp_ratio)
+        dtype = jnp.dtype(cfg.dtype)
+        x = nn.DenseGeneral(
+            hidden, name="fc1", dtype=dtype,
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            kernel_init=nn.with_logical_partitioning(
+                _xavier(), ("embed", "mlp")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("mlp",)))(x)
+        x = nn.gelu(x, approximate=False)
+        x = nn.Dropout(cfg.drop_rate)(x, deterministic=deterministic)
+        x = with_logical_constraint(x, ("batch", None, "act_mlp"))
+        x = nn.DenseGeneral(
+            cfg.embed_dim, name="fc2", dtype=dtype,
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            kernel_init=nn.with_logical_partitioning(
+                _xavier(), ("mlp", "embed")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("embed",)))(x)
+        return nn.Dropout(cfg.drop_rate)(x, deterministic=deterministic)
+
+
+class ViTBlock(nn.Module):
+    """Pre-LN block with stochastic depth (reference ``Block``)."""
+    config: ViTConfig
+    drop_path_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            epsilon=cfg.epsilon, dtype=jnp.dtype(cfg.dtype),
+            param_dtype=jnp.dtype(cfg.param_dtype), name=name,
+            scale_init=nn.with_logical_partitioning(
+                nn.initializers.ones_init(), ("norm",)),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("norm",)))
+        dp_rng = None
+        if not deterministic and self.drop_path_rate > 0.0:
+            dp_rng = self.make_rng("dropout")
+        y = ViTAttention(cfg, name="attn")(ln("norm1")(x), deterministic)
+        x = x + drop_path(y, self.drop_path_rate, deterministic, dp_rng)
+        if dp_rng is not None:
+            dp_rng = self.make_rng("dropout")
+        y = ViTMLP(cfg, name="mlp")(ln("norm2")(x), deterministic)
+        x = x + drop_path(y, self.drop_path_rate, deterministic, dp_rng)
+        return with_logical_constraint(x, ("batch", "seq", "act_embed"))
+
+
+class ViT(nn.Module):
+    """Vision Transformer classifier; input NHWC (a CHW batch from the
+    reference's ``ToCHWImage`` pipeline is accepted and transposed)."""
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, deterministic: bool = True):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        if images.ndim != 4:
+            raise ValueError(f"expected [b,h,w,c] images, got "
+                             f"{images.shape}")
+        if images.shape[1] == cfg.in_chans and \
+                images.shape[-1] != cfg.in_chans:
+            images = jnp.transpose(images, (0, 2, 3, 1))  # NCHW -> NHWC
+
+        x = nn.Conv(
+            cfg.embed_dim, (cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size), padding="VALID",
+            name="patch_embed", dtype=dtype,
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            kernel_init=nn.with_logical_partitioning(
+                _xavier(), (None, None, None, "embed")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("embed",)))(
+            images.astype(dtype))
+        b = x.shape[0]
+        x = x.reshape(b, -1, cfg.embed_dim)
+
+        cls_token = self.param(
+            "cls_token",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(),
+                                         (None, None, "embed")),
+            (1, 1, cfg.embed_dim), jnp.dtype(cfg.param_dtype))
+        pos_embed = self.param(
+            "pos_embed",
+            nn.with_logical_partitioning(
+                nn.initializers.truncated_normal(stddev=0.02),
+                (None, "pos", "embed")),
+            (1, cfg.num_patches + 1, cfg.embed_dim),
+            jnp.dtype(cfg.param_dtype))
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls_token.astype(dtype),
+                              (b, 1, cfg.embed_dim)), x], axis=1)
+        x = x + pos_embed.astype(dtype)
+        x = nn.Dropout(cfg.drop_rate)(x, deterministic=deterministic)
+        x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
+
+        rates = np.linspace(0.0, cfg.drop_path_rate, cfg.depth)
+        for i in range(cfg.depth):
+            x = ViTBlock(cfg, drop_path_rate=float(rates[i]),
+                         name=f"blocks_{i}")(x, deterministic)
+
+        x = nn.LayerNorm(
+            epsilon=cfg.epsilon, dtype=dtype,
+            param_dtype=jnp.dtype(cfg.param_dtype), name="norm",
+            scale_init=nn.with_logical_partitioning(
+                nn.initializers.ones_init(), ("norm",)),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("norm",)))(x)
+        x = x[:, 0]
+
+        if cfg.representation_size is not None:
+            x = jnp.tanh(nn.Dense(
+                cfg.representation_size, name="head0", dtype=dtype,
+                param_dtype=jnp.dtype(cfg.param_dtype),
+                kernel_init=nn.with_logical_partitioning(
+                    _xavier(), ("embed", None)),
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), (None,)))(x))
+            # reference inits this head's bias to -10 (minus_tens_)
+            head_bias_init = nn.initializers.constant(-10.0)
+            head_kernel_init = _xavier()
+        else:
+            head_bias_init = nn.initializers.zeros_init()
+            head_kernel_init = nn.initializers.zeros_init()
+        if cfg.class_num > 0:
+            # classifier head stays replicated: class_num rarely
+            # divides the mp axis and the FLOPs are negligible
+            x = nn.Dense(
+                cfg.class_num, name="head", dtype=dtype,
+                param_dtype=jnp.dtype(cfg.param_dtype),
+                kernel_init=nn.with_logical_partitioning(
+                    head_kernel_init, ("embed", None)),
+                bias_init=nn.with_logical_partitioning(
+                    head_bias_init, (None,)))(x)
+        return x
+
+
+def interpolate_pos_embed(pos_embed: np.ndarray,
+                          new_num_patches: int) -> np.ndarray:
+    """Bicubic-resize the grid part of a ``[1, 1+N, D]`` pos embed to a
+    new patch count (reference ``load_pretrained`` :221-259)."""
+    pos_embed = np.asarray(pos_embed)
+    n = pos_embed.shape[1] - 1
+    if n == new_num_patches:
+        return pos_embed
+    cls_tok, grid = pos_embed[:, :1], pos_embed[:, 1:]
+    old = int(round(np.sqrt(n)))
+    new = int(round(np.sqrt(new_num_patches)))
+    d = grid.shape[-1]
+    grid = grid.reshape(old, old, d)
+    grid_j = jax.image.resize(jnp.asarray(grid), (new, new, d),
+                              method="bicubic")
+    grid = np.asarray(grid_j).reshape(1, new * new, d)
+    return np.concatenate([cls_tok, grid], axis=1)
+
+
+def _zoo(**kw) -> Any:
+    def build(**overrides):
+        merged = {**kw, **overrides}
+        merged.pop("pretrained", None)  # checkpoint loading is explicit
+        return ViT(ViTConfig(**merged))
+    return build
+
+
+# reference zoo (vit.py:261-434), same names the YAMLs use
+VISION_MODELS = {
+    "ViT": lambda **kw: ViT(ViTConfig(**kw)),
+    "ViT_tiny_patch16_224": _zoo(patch_size=16, embed_dim=192, depth=12,
+                                 num_heads=3),
+    "ViT_small_patch16_224": _zoo(patch_size=16, embed_dim=384, depth=12,
+                                  num_heads=6),
+    "ViT_base_patch16_224": _zoo(patch_size=16, embed_dim=768, depth=12,
+                                 num_heads=12, qkv_bias=True),
+    "ViT_base_patch16_384": _zoo(img_size=384, patch_size=16,
+                                 embed_dim=768, depth=12, num_heads=12,
+                                 qkv_bias=True),
+    "ViT_base_patch32_224": _zoo(patch_size=32, embed_dim=768, depth=12,
+                                 num_heads=12, qkv_bias=True),
+    "ViT_base_patch32_384": _zoo(img_size=384, patch_size=32,
+                                 embed_dim=768, depth=12, num_heads=12,
+                                 qkv_bias=True),
+    "ViT_large_patch16_224": _zoo(patch_size=16, embed_dim=1024,
+                                  depth=24, num_heads=16, qkv_bias=True),
+    "ViT_large_patch16_384": _zoo(img_size=384, patch_size=16,
+                                  embed_dim=1024, depth=24, num_heads=16,
+                                  qkv_bias=True),
+    "ViT_large_patch32_224": _zoo(patch_size=32, embed_dim=1024,
+                                  depth=24, num_heads=16, qkv_bias=True),
+    "ViT_large_patch32_384": _zoo(img_size=384, patch_size=32,
+                                  embed_dim=1024, depth=24, num_heads=16,
+                                  qkv_bias=True),
+    "ViT_huge_patch14_224": _zoo(patch_size=14, embed_dim=1280,
+                                 depth=32, num_heads=16),
+    "ViT_huge_patch14_384": _zoo(img_size=384, patch_size=14,
+                                 embed_dim=1280, depth=32, num_heads=16),
+    "ViT_g_patch14_224": _zoo(patch_size=14, embed_dim=1408, depth=40,
+                              num_heads=16, mlp_ratio=4864 / 1408),
+    "ViT_G_patch14_224": _zoo(patch_size=14, embed_dim=1664, depth=48,
+                              num_heads=16, mlp_ratio=8192 / 1664),
+    "ViT_6B_patch14_224": _zoo(patch_size=14, embed_dim=2320, depth=80,
+                               num_heads=16),
+}
+
+
+def build_vision_model(cfg) -> nn.Module:
+    """``Model.model`` YAML section -> model instance."""
+    cfg = dict(cfg)
+    name = cfg.pop("name")
+    if name not in VISION_MODELS:
+        raise ValueError(
+            f"unknown vision model {name!r}; available: "
+            f"{sorted(VISION_MODELS)}")
+    return VISION_MODELS[name](**cfg)
