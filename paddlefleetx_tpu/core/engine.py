@@ -101,6 +101,20 @@ class Engine(BasicEngine):
         self._load_recovery = {"epoch": 0, "step": 0,
                                "consumed_samples": 0}
         self._host_step = 0
+
+        # config-gated profiler window (reference
+        # ``eager_engine.py:202-224``: paddle.profiler over a
+        # [start, stop] scheduler window, chrome-trace export; here
+        # jax.profiler -> TensorBoard/XProf trace in profiler_log)
+        prof = configs.get("Profiler", {}) or {}
+        self._prof_window = None
+        if prof.get("enable", False):
+            start, stop = (prof.get("scheduler") or [1, 5])[:2]
+            self._prof_window = (int(start), int(stop))
+            self._prof_dir = prof.get("profiler_log", "./profiler_log")
+            self._prof_active = False
+            logger.warning("Profiler is enabled, do not enable it in "
+                           "production.")
         self._init_state()
         self._build_steps()
         if self.ckpt_dir:
@@ -340,6 +354,10 @@ class Engine(BasicEngine):
                 # epoch-mode run (num_train_epochs >> steps) spins
                 # through empty epochs re-saving checkpoints
                 break
+        if self._prof_window is not None and self._prof_active:
+            jax.block_until_ready(self.state["step"])
+            jax.profiler.stop_trace()
+            self._prof_active = False
         set_mesh(None)
 
     def _train_one_epoch(self, epoch: int, train_data_loader,
@@ -352,6 +370,7 @@ class Engine(BasicEngine):
             for batch in train_data_loader:
                 if step >= self.max_steps:
                     return
+                self._profiler_step(step)
                 batch = self.module.pretreating_batch(batch)
                 self.state, metrics = self._train_step(
                     self.state, self._put_batch(batch))
@@ -376,6 +395,29 @@ class Engine(BasicEngine):
                 if step % self.save_steps == 0:
                     self.save(epoch)
                     step_start = time.time()
+
+    def _profiler_step(self, step: int) -> None:
+        """Start/stop the jax.profiler trace at the configured window
+        edges; the trace lands in ``profiler_log`` for TensorBoard /
+        XProf (the reference's chrome-trace export + VisualDL pointer,
+        ``eager_engine.py:684-743``)."""
+        if self._prof_window is None:
+            return
+        start, stop = self._prof_window
+        # range check, not equality: a resume landing past `start`
+        # still traces the remaining window
+        if start <= step < stop and not self._prof_active:
+            jax.profiler.start_trace(self._prof_dir)
+            self._prof_active = True
+        elif step >= stop and self._prof_active:
+            # block on the last dispatched step so its device activity
+            # is inside the trace
+            jax.block_until_ready(self.state["step"])
+            jax.profiler.stop_trace()
+            self._prof_active = False
+            logger.info(
+                "profiler trace written to %s (view with TensorBoard's "
+                "profile plugin / XProf)", self._prof_dir)
 
     def _evaluate_impl(self, epoch: int, valid_data_loader,
                        max_iters: Optional[int] = None):
@@ -450,3 +492,41 @@ class Engine(BasicEngine):
         logger.info("resumed at epoch %s step %s",
                     self._load_recovery["epoch"],
                     self._load_recovery["step"])
+
+    # -- export / inference --------------------------------------------
+
+    def export(self) -> str:
+        """AOT-export the module's inference function + params
+        (reference ``engine.export`` -> ``paddle.jit.to_static`` +
+        per-rank save, ``eager_engine.py:667-674``; here one portable
+        ``jax.export`` artifact, ``utils/export.py``)."""
+        from ..utils.export import export_inference_model
+        export_fn = getattr(self.module, "export_fn", None)
+        if export_fn is not None:
+            fn, spec, metadata = export_fn()
+        else:
+            model = self.module.model
+            fn = lambda p, *inputs: model.apply(  # noqa: E731
+                {"params": p}, *inputs, deterministic=True)
+            spec = self.module.input_spec()[:1]
+            metadata = {}
+        out_dir = os.path.join(self.output_dir, "export")
+        with self.mesh, nn.logical_axis_rules(self.rules):
+            return export_inference_model(
+                fn, self.state["params"], spec, out_dir,
+                metadata=metadata)
+
+    def inference(self, data):
+        """Run the exported artifact (reference
+        ``eager_engine.py:676-682`` builds an ``InferenceEngine`` from
+        the ``Inference`` config section)."""
+        if not hasattr(self, "_inference_engine"):
+            from .inference_engine import InferenceEngine
+            inf_cfg = dict(self.configs.get("Inference", {}))
+            model_dir = inf_cfg.get("model_dir", self.output_dir)
+            candidate = os.path.join(model_dir, "export")
+            if os.path.isdir(candidate):
+                model_dir = candidate
+            self._inference_engine = InferenceEngine(
+                model_dir, mp_degree=inf_cfg.get("mp_degree", 1))
+        return self._inference_engine.predict(data)

@@ -1,0 +1,1 @@
+"""GPT corpus preprocessing tools (raw text -> jsonl -> token arrays)."""

@@ -1,0 +1,185 @@
+"""jsonl corpus -> memory-mapped token arrays for GPTDataset.
+
+Parity: reference ``data_tools/gpt/preprocess_data.py`` — a
+multiprocessing pool tokenizes ``{json_key: text}`` lines (optionally
+splitting documents into sentences first), appends EOS per document,
+and writes:
+
+  ``{output_prefix}_ids.npy``  — all token ids, uint16 when the vocab
+  fits (else int32)
+  ``{output_prefix}_idx.npz``  — ``lens`` (tokens per sentence, i32)
+  and ``docs`` (cumulative sentence count per document, i64, leading 0)
+
+exactly the layout ``GPTDataset`` mmaps (``gpt_dataset.py:84-96``).
+Tokenizer: the built-in byte-level ``GPTTokenizer`` (``--model_name``
+may point at a vocab/merges directory); the reference's
+transformers-by-name loading and jieba-based Chinese whole-word
+masking are out of scope here (no model downloads under zero egress).
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import multiprocessing
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def get_args(argv=None):
+    parser = argparse.ArgumentParser()
+    group = parser.add_argument_group(title="data input/output")
+    group.add_argument("--input_path", type=str, required=True,
+                       help="jsonl file or folder of jsonl files")
+    group.add_argument("--output_prefix", type=str, required=True)
+    group.add_argument("--json_key", type=str, default="text")
+    group.add_argument("--split_sentences", action="store_true",
+                       help="split documents into sentences (newline "
+                            "splitter)")
+    group = parser.add_argument_group(title="tokenizer")
+    group.add_argument("--tokenizer_name", type=str,
+                       default="GPTTokenizer")
+    group.add_argument("--model_name", type=str, default="gpt2",
+                       help="vocab/merges directory for GPTTokenizer")
+    group.add_argument("--append_eos", action="store_true")
+    group = parser.add_argument_group(title="common config")
+    group.add_argument("--workers", type=int, default=1)
+    group.add_argument("--log_interval", type=int, default=100)
+    return parser.parse_args(argv)
+
+
+class IdentitySplitter:
+    def tokenize(self, text):
+        return [text]
+
+
+class NewlineSplitter:
+    def tokenize(self, text):
+        return text.split("\n")
+
+
+class Converter:
+    """Per-worker tokenizer state (initialized once per process, like
+    the reference's ``Converter.initializer``)."""
+
+    tokenizer = None
+    splitter = None
+    json_key = "text"
+    append_eos = False
+
+    def __init__(self, args):
+        self.args = args
+
+    def initializer(self):
+        from ...tokenizers.gpt_tokenizer import GPTTokenizer
+        Converter.tokenizer = GPTTokenizer.from_pretrained(
+            self.args.model_name)
+        Converter.splitter = NewlineSplitter() \
+            if self.args.split_sentences else IdentitySplitter()
+        Converter.json_key = self.args.json_key
+        Converter.append_eos = self.args.append_eos
+
+    @staticmethod
+    def encode(json_line):
+        text = json.loads(json_line)[Converter.json_key]
+        doc_ids = []
+        for sentence in Converter.splitter.tokenize(text):
+            ids = Converter.tokenizer.encode(sentence.strip())
+            if ids:
+                doc_ids.append(ids)
+        if doc_ids and Converter.append_eos:
+            doc_ids[-1].append(Converter.tokenizer.eos_token_id)
+        return doc_ids, len(text.encode("utf-8"))
+
+
+def main(argv=None):
+    args = get_args(argv)
+    file_paths = []
+    if os.path.isfile(args.input_path):
+        file_paths.append(args.input_path)
+    else:
+        for root, _, fs in os.walk(args.input_path):
+            file_paths.extend(os.path.join(root, f) for f in fs
+                              if f.endswith(".jsonl"))
+    file_paths.sort()
+    if not file_paths:
+        print("No input file found!")
+        sys.exit(-1)
+
+    convert = Converter(args)
+    from ...tokenizers.gpt_tokenizer import GPTTokenizer
+    sample_tokenizer = GPTTokenizer.from_pretrained(args.model_name)
+    save_dtype = np.uint16 if sample_tokenizer.vocab_size < 2 ** 16 - 1 \
+        else np.int32
+
+    token_ids_stream = io.BytesIO()
+    sentlens_stream = io.BytesIO()
+    doc_cumsum_stream = io.BytesIO()
+    doc_cumsum_stream.write(
+        (0).to_bytes(8, byteorder="little", signed=True))
+
+    sent_count = 0
+    step = 0
+    total_bytes = 0
+    t0 = time.time()
+
+    pool = None
+    if args.workers > 1:
+        pool = multiprocessing.Pool(args.workers,
+                                    initializer=convert.initializer)
+    else:
+        convert.initializer()
+
+    for file_path in file_paths:
+        print(f"Processing {file_path}")
+        with open(file_path, "r", encoding="utf-8") as text:
+            docs = pool.imap(Converter.encode, text, 256) if pool \
+                else map(Converter.encode, text)
+            for doc, nbytes in docs:
+                step += 1
+                total_bytes += nbytes
+                if not doc:
+                    continue
+                for sentence in doc:
+                    if not sentence:
+                        continue
+                    sentlens_stream.write(len(sentence).to_bytes(
+                        4, byteorder="little", signed=True))
+                    sent_count += 1
+                    token_ids_stream.write(np.array(
+                        sentence, dtype=save_dtype).tobytes(order="C"))
+                doc_cumsum_stream.write(sent_count.to_bytes(
+                    8, byteorder="little", signed=True))
+                if step % args.log_interval == 0:
+                    elapsed = time.time() - t0
+                    print(f"Processed {step} documents "
+                          f"({step / elapsed:.2f} docs/s, "
+                          f"{total_bytes / elapsed / 2**20:.4f} MB/s).",
+                          file=sys.stderr)
+    if pool is not None:
+        pool.close()
+
+    print("Saving tokens to files...")
+    all_ids = np.frombuffer(token_ids_stream.getbuffer(),
+                            dtype=save_dtype)
+    lens = np.frombuffer(sentlens_stream.getbuffer(), dtype=np.int32)
+    docs = np.frombuffer(doc_cumsum_stream.getbuffer(), dtype=np.int64)
+    np.save(args.output_prefix + "_ids.npy", all_ids)
+    np.savez(args.output_prefix + "_idx.npz", lens=lens, docs=docs)
+
+    print(f"Total sentences num: {len(lens)}")
+    print(f"Total documents num: {len(docs) - 1}")
+    print(f"Total tokens num: {len(all_ids)}")
+    if len(lens):
+        print(f"Average tokens per sentence: "
+              f"{len(all_ids) / len(lens):.2f}")
+        print(f"Average tokens per document: "
+              f"{len(all_ids) / (len(docs) - 1):.2f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,137 @@
+"""Raw corpus files -> one (merged, shuffled) jsonl.
+
+Parity: reference ``data_tools/gpt/raw_trans_to_json.py`` — walk
+``input_path``, split each file into documents on ``doc_spliter``
+lines, drop docs shorter than ``min_doc_length`` chars, emit
+``{json_key: doc}`` lines, then merge per-file outputs and shuffle.
+The shuffle here is in-process (deterministic with ``--seed``) instead
+of shelling out to ``shuf``.
+
+Usage::
+
+    python -m paddlefleetx_tpu.data.data_tools.gpt.raw_trans_to_json \
+        --input_path ./raw --output_path ./corpus
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing
+import os
+import random
+import shutil
+import time
+from functools import partial
+
+
+def get_args(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--input_path", type=str, required=True,
+                        help="raw files; folder or file path")
+    parser.add_argument("--output_path", type=str, required=True,
+                        help="where to save the output jsonl")
+    parser.add_argument("--json_key", type=str, default="text")
+    parser.add_argument("--doc_spliter", type=str, default="",
+                        help="document separator line (stripped); blank "
+                             "line by default")
+    parser.add_argument("--min_doc_length", type=int, default=10)
+    parser.add_argument("--workers", type=int, default=1)
+    parser.add_argument("--log_interval", type=int, default=1)
+    parser.add_argument("--no-merge", dest="no_merge",
+                        action="store_true")
+    parser.add_argument("--no-shuffle", dest="no_shuffle",
+                        action="store_true")
+    parser.add_argument("--seed", type=int, default=1234)
+    return parser.parse_args(argv)
+
+
+def raw_text_to_json(path, doc_spliter="", json_key="text",
+                     min_doc_length=10):
+    """One raw file -> ``<path>.jsonl``; returns (bytes_read, outpath)."""
+    path = os.path.abspath(path)
+    if not os.path.exists(path):
+        print(f"No found file {path}")
+        return 0, None
+    out_filepath = path + ".jsonl"
+    len_files = 0
+    with open(out_filepath, "w", encoding="utf-8") as fout, \
+            open(path, "r", encoding="utf-8") as f:
+        doc = ""
+        for line in f:
+            len_files += len(line)
+            if line.strip() == doc_spliter:
+                if len(doc) > min_doc_length:
+                    fout.write(json.dumps({json_key: doc},
+                                          ensure_ascii=False) + "\n")
+                doc = ""
+            else:
+                doc += line
+        if len(doc) > min_doc_length:
+            fout.write(json.dumps({json_key: doc},
+                                  ensure_ascii=False) + "\n")
+    return len_files, out_filepath
+
+
+def merge_file(file_paths, output_path):
+    if not output_path.endswith(".jsonl"):
+        output_path = output_path + ".jsonl"
+    print(f"Merging files into {output_path}")
+    with open(output_path, "wb") as wfd:
+        for f in file_paths:
+            if f is not None and os.path.exists(f):
+                with open(f, "rb") as fd:
+                    shutil.copyfileobj(fd, wfd)
+                os.remove(f)
+    print(f"File save in {output_path}")
+    return output_path
+
+
+def shuffle_file(output_path, seed=1234):
+    print("Shuffling the jsonl file...")
+    if not os.path.exists(output_path):
+        raise ValueError(f"File not found: {output_path}")
+    with open(output_path, "r", encoding="utf-8") as f:
+        lines = f.readlines()
+    random.Random(seed).shuffle(lines)
+    with open(output_path, "w", encoding="utf-8") as f:
+        f.writelines(lines)
+    print("File shuffled!!!")
+
+
+def main(argv=None):
+    args = get_args(argv)
+    start = time.time()
+
+    file_paths = []
+    if os.path.isfile(args.input_path):
+        file_paths.append(args.input_path)
+    else:
+        for root, _, fs in os.walk(args.input_path):
+            # skip leftovers of a previous run (--no-merge / crash):
+            # re-ingesting <f>.jsonl would double-encode the corpus
+            file_paths.extend(os.path.join(root, f) for f in fs
+                              if not f.endswith(".jsonl"))
+    file_paths.sort()
+
+    work = partial(raw_text_to_json, doc_spliter=args.doc_spliter,
+                   json_key=args.json_key,
+                   min_doc_length=args.min_doc_length)
+    if args.workers > 1:
+        with multiprocessing.Pool(args.workers) as pool:
+            results = pool.map(work, file_paths)
+    else:
+        results = [work(p) for p in file_paths]
+    out_paths = [p for _n, p in results]
+    total_bytes = sum(n for n, _p in results)
+
+    if not args.no_merge:
+        merged = merge_file(out_paths, args.output_path)
+        if not args.no_shuffle:
+            shuffle_file(merged, args.seed)
+    print(f"Processed {total_bytes} bytes of {len(file_paths)} files "
+          f"in {time.time() - start:.2f}s")
+
+
+if __name__ == "__main__":
+    main()

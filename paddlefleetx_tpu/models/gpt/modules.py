@@ -92,6 +92,43 @@ class GPTGenerationModule(GPTModule):
         gen_section.setdefault("pad_token_id", self.tokenizer.pad_token_id)
         self.generation_cfg = GenerationConfig.from_config(gen_section)
 
+    def export_fn(self):
+        """Export the full sampling loop (the reference exports
+        ``GPTForGeneration`` through dy2static for ``paddle.inference``;
+        here the jitted ``generate`` itself is the artifact).
+
+        Exported signature: ``(params, input_ids[b, prompt], mask[b,
+        prompt]) -> ids[b, max_dec_len]``; prompt capacity is
+        ``max_position_embeddings - max_dec_len``. Sampling randomness
+        is derived from the config seed and the prompt so the artifact
+        stays a pure function of its inputs.
+        """
+        import jax
+        import jax.numpy as jnp
+        from .generation import generate
+        model, gen_cfg = self.model, self.generation_cfg
+        seed = self.configs.Global.get("seed", 1024)
+        batch = self.configs.Global.micro_batch_size or 1
+        prompt_cap = (self.model_config.max_position_embeddings
+                      - gen_cfg.max_dec_len)
+
+        def fn(params, input_ids, attention_mask):
+            rng = jax.random.fold_in(
+                jax.random.key(seed),
+                jnp.sum(input_ids, dtype=jnp.uint32))
+            return generate(model, params, input_ids, attention_mask,
+                            rng, gen_cfg)
+
+        spec = [((batch, prompt_cap), "int32"),
+                ((batch, prompt_cap), "int32")]
+        metadata = {"pad_values": [gen_cfg.pad_token_id, 0],
+                    # generate() requires LEFT-padded prompts (the
+                    # prefill reads logits from the last slot)
+                    "pad_sides": ["left", "left"],
+                    "max_dec_len": gen_cfg.max_dec_len,
+                    "eos_token_id": gen_cfg.eos_token_id}
+        return fn, spec, metadata
+
     def generate(self, params, texts, rng=None):
         import jax
         import numpy as np

@@ -1,0 +1,65 @@
+"""Multi-process batch shell-command runner.
+
+Parity: reference ``ppfleetx/tools/multiprocess_tool.py`` — read a
+text file of shell commands (one per line), split them across worker
+processes, run each with the shell, report failures.
+
+    python -m paddlefleetx_tpu.tools.multiprocess_tool \
+        --num_proc 10 --shell_cmd_list_filename batch_cmd.txt
+"""
+
+from __future__ import annotations
+
+import argparse
+import multiprocessing
+import subprocess
+import time
+import warnings
+from multiprocessing import Process
+
+
+def process_fn(cmd_list):
+    for cmd in cmd_list:
+        ret = subprocess.call(cmd, shell=True)
+        if ret != 0:
+            print(f"execute command: {cmd} failed (exit {ret}).")
+
+
+def read_command(shell_cmd_list_filename):
+    with open(shell_cmd_list_filename, "r") as f:
+        return [line.strip() for line in f if line.strip()]
+
+
+def parallel_process(cmd_list, nproc: int = 20):
+    if nproc > multiprocessing.cpu_count():
+        warnings.warn(
+            "The set number of processes exceeds the number of cpu "
+            "cores, please confirm whether it is reasonable.")
+    num_cmd = len(cmd_list)
+    per_part = (num_cmd + nproc - 1) // nproc
+    workers = []
+    for i in range(min(nproc, num_cmd)):
+        start = i * per_part
+        chunk = cmd_list[start:start + per_part]
+        p = Process(target=process_fn, args=(chunk,))
+        workers.append(p)
+        p.start()
+    for p in workers:
+        p.join()
+
+
+def main(args):
+    start = time.time()
+    parallel_process(read_command(args.shell_cmd_list_filename),
+                     args.num_proc)
+    print(f"Cost time: {time.time() - start:.2f}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="multi-process batch processing tool")
+    parser.add_argument("--num_proc", type=int, default=20)
+    parser.add_argument("--shell_cmd_list_filename", type=str,
+                        required=True,
+                        help="txt file of shell commands to execute")
+    main(parser.parse_args())

@@ -1,0 +1,122 @@
+"""AOT model export: the TPU-native replacement for the reference's
+dygraph-to-static pipeline.
+
+The reference exports with ``paddle.jit.to_static`` + program pruning
+(reference ``utils/export.py:27-59``) into per-rank
+``rank_{i}/model.pdmodel|pdiparams`` dirs consumed by the
+``paddle.inference`` runtime (``core/engine/inference_engine.py``).
+Here the jitted function itself is the deployable artifact: the traced
+computation is serialized with ``jax.export`` (StableHLO, weights NOT
+baked in), parameters are saved as an Orbax checkpoint next to it, and
+a ``spec.json`` records the input signature. The artifact is
+topology-portable — one directory regardless of the training mesh,
+unlike the reference's per-rank dirs.
+
+Layout::
+
+    <dir>/model.jaxexport   serialized StableHLO computation
+    <dir>/params/           Orbax checkpoint of the parameter pytree
+    <dir>/spec.json         input shapes/dtypes + metadata
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from .log import logger
+
+_MODEL_FILE = "model.jaxexport"
+_SPEC_FILE = "spec.json"
+_PARAMS_DIR = "params"
+
+
+def export_inference_model(fn: Callable, params,
+                           input_spec: Sequence[Tuple[Sequence, str]],
+                           output_dir: str,
+                           metadata: Dict[str, Any] = None) -> str:
+    """Serialize ``fn(params, *inputs)`` + ``params`` to ``output_dir``.
+
+    ``input_spec`` is the module contract's ``[(shape, dtype), ...]``
+    (None dims become 1 — the exported program has static shapes).
+    """
+    os.makedirs(output_dir, exist_ok=True)
+    abstract_inputs = [
+        jax.ShapeDtypeStruct(
+            tuple(1 if d is None else int(d) for d in shape),
+            jax.numpy.dtype(dtype))
+        for shape, dtype in input_spec]
+    abstract_params = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params)
+    exported = jax.export.export(jax.jit(fn))(
+        abstract_params, *abstract_inputs)
+    with open(os.path.join(output_dir, _MODEL_FILE), "wb") as f:
+        f.write(exported.serialize())
+
+    params_path = os.path.abspath(os.path.join(output_dir, _PARAMS_DIR))
+    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+        ckptr.save(params_path, jax.device_get(params), force=True)
+
+    spec = {
+        "inputs": [[list(s.shape), s.dtype.name] for s in abstract_inputs],
+        "metadata": metadata or {},
+    }
+    with open(os.path.join(output_dir, _SPEC_FILE), "w") as f:
+        json.dump(spec, f, indent=2)
+    logger.info("exported inference model to %s", output_dir)
+    return output_dir
+
+
+def load_inference_model(model_dir: str):
+    """Returns ``(call, params, spec)``; ``call(params, *inputs)``
+    executes the deserialized computation on the current backend."""
+    with open(os.path.join(model_dir, _MODEL_FILE), "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    params_path = os.path.abspath(os.path.join(model_dir, _PARAMS_DIR))
+    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+        params = ckptr.restore(params_path)
+    with open(os.path.join(model_dir, _SPEC_FILE)) as f:
+        spec = json.load(f)
+
+    def call(p, *inputs):
+        return exported.call(p, *inputs)
+
+    return call, params, spec
+
+
+def pad_to_spec(arrays: List[np.ndarray], spec: Dict[str, Any],
+                pad_values: Sequence[float],
+                pad_sides: Sequence[str] = None) -> List[np.ndarray]:
+    """Pad each input up to the exported static shape (the exported
+    program cannot accept smaller batches/sequences).
+
+    ``pad_sides[i]`` is "right" (default) or "left"; left applies to
+    the LAST axis only (the sequence axis — generation prompts must be
+    left-padded so the final slot holds the last real token, matching
+    ``generate()``'s contract). Batch and leading axes always pad
+    right.
+    """
+    out = []
+    sides = pad_sides or ["right"] * len(arrays)
+    for arr, (shape, dtype), pad, side in zip(arrays, spec["inputs"],
+                                              pad_values, sides):
+        arr = np.asarray(arr)
+        if list(arr.shape) == shape:
+            out.append(arr.astype(dtype))
+            continue
+        if arr.ndim != len(shape) or any(
+                a > s for a, s in zip(arr.shape, shape)):
+            raise ValueError(
+                f"input shape {arr.shape} incompatible with exported "
+                f"spec {shape}")
+        widths = [(0, s - a) for a, s in zip(arr.shape, shape)]
+        if side == "left" and arr.ndim >= 1:
+            widths[-1] = (widths[-1][1], 0)
+        out.append(np.pad(arr, widths,
+                          constant_values=pad).astype(dtype))
+    return out

@@ -1,0 +1,57 @@
+"""Exported-model text generation (reference
+``tasks/gpt/inference.py:34-60``): tokenize a prompt, run the exported
+artifact through the InferenceEngine, decode.
+
+Unlike the training path, no Engine (and no random full-model init) is
+constructed — the artifact carries its own parameters.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+from paddlefleetx_tpu.core.inference_engine import (  # noqa: E402
+    InferenceEngine,
+)
+from paddlefleetx_tpu.data.tokenizers.gpt_tokenizer import (  # noqa: E402
+    GPTTokenizer,
+)
+from paddlefleetx_tpu.utils import env  # noqa: E402
+from paddlefleetx_tpu.utils.config import get_config, parse_args  # noqa: E402
+
+
+def main():
+    args = parse_args()
+    env.init_dist_env()
+    cfg = get_config(args.config, overrides=args.override, show=False)
+
+    inf_cfg = dict(cfg.get("Inference", {}))
+    model_dir = inf_cfg.get("model_dir", "./output")
+    candidate = os.path.join(model_dir, "export")
+    if os.path.isdir(candidate):
+        model_dir = candidate
+    engine = InferenceEngine(model_dir,
+                             mp_degree=inf_cfg.get("mp_degree", 1))
+
+    tokenizer = GPTTokenizer.from_pretrained(
+        cfg.get("Generation", {}).get("vocab_dir", "gpt2"))
+    input_text = "Hi, GPT2. Tell me who Jack Ma is."
+    ids = tokenizer.encode(input_text)
+    prompt = np.asarray([ids], np.int32)
+    mask = np.ones_like(prompt)
+
+    outs = engine.predict([prompt, mask])
+    out_ids = [int(x) for x in list(outs.values())[0][0]]
+    eos = engine.spec["metadata"].get(
+        "eos_token_id", tokenizer.eos_token_id)
+    if eos in out_ids:
+        out_ids = out_ids[: out_ids.index(eos)]
+    print("Prompt:", input_text)
+    print("Generation:", input_text + tokenizer.decode(out_ids))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""End-to-end corpus preprocessing: raw text -> jsonl -> token arrays
+consumable by GPTDataset."""
+
+import json
+import os
+
+import numpy as np
+
+from paddlefleetx_tpu.data.data_tools.gpt import (
+    preprocess_data, raw_trans_to_json,
+)
+
+
+def _write_raw(tmp_path):
+    raw = tmp_path / "raw"
+    os.makedirs(raw)
+    (raw / "a.txt").write_text(
+        "the quick brown fox jumps over the lazy dog\n"
+        "pack my box with five dozen liquor jugs\n"
+        "\n"
+        "how vexingly quick daft zebras jump and run around\n")
+    (raw / "b.txt").write_text(
+        "sphinx of black quartz judge my vow tonight\n")
+    return str(raw)
+
+
+def test_raw_to_json_to_ids(tmp_path):
+    raw = _write_raw(tmp_path)
+    out = str(tmp_path / "corpus")
+    raw_trans_to_json.main([
+        "--input_path", raw, "--output_path", out,
+        "--min_doc_length", "5"])
+    jsonl = out + ".jsonl"
+    assert os.path.isfile(jsonl)
+    lines = [json.loads(x) for x in open(jsonl)]
+    assert len(lines) == 3  # 2 docs in a.txt + 1 in b.txt
+    assert all("text" in d for d in lines)
+
+    prefix = str(tmp_path / "tokens")
+    preprocess_data.main([
+        "--input_path", jsonl, "--output_prefix", prefix,
+        "--append_eos"])
+    ids = np.load(prefix + "_ids.npy")
+    idx = np.load(prefix + "_idx.npz")
+    lens, docs = idx["lens"], idx["docs"]
+    assert ids.dtype == np.uint16
+    assert lens.sum() == len(ids)
+    assert docs[0] == 0 and docs[-1] == len(lens)
+    assert len(docs) - 1 == 3  # one entry per document
+
+    # the arrays feed GPTDataset directly
+    from paddlefleetx_tpu.data.dataset.gpt_dataset import GPTDataset
+    ds = GPTDataset(str(tmp_path), split=[100, 0, 0], max_seq_len=8,
+                    num_samples=4, mode="Train", eos_id=50256,
+                    build_data_file=True)
+    sample = ds[0]
+    assert sample[0].shape == (8,)
+
+
+def test_preprocess_split_sentences(tmp_path):
+    jsonl = tmp_path / "c.jsonl"
+    jsonl.write_text(json.dumps(
+        {"text": "first sentence here\nsecond one\nthird"}) + "\n")
+    prefix = str(tmp_path / "sent")
+    preprocess_data.main([
+        "--input_path", str(jsonl), "--output_prefix", prefix,
+        "--split_sentences"])
+    idx = np.load(prefix + "_idx.npz")
+    assert len(idx["lens"]) == 3  # one sentence per newline segment
+    assert len(idx["docs"]) - 1 == 1
+
+
+def test_multiprocess_tool(tmp_path):
+    from paddlefleetx_tpu.tools.multiprocess_tool import (
+        parallel_process, read_command,
+    )
+    cmds = tmp_path / "cmds.txt"
+    cmds.write_text("\n".join(
+        f"touch {tmp_path}/done_{i}" for i in range(4)))
+    parallel_process(read_command(str(cmds)), nproc=2)
+    assert all(os.path.exists(tmp_path / f"done_{i}") for i in range(4))

@@ -138,3 +138,20 @@ def test_checkpoint_save_load_resume(tmp_path):
 
 
 import jax  # noqa: E402  (used in helpers above)
+
+
+def test_profiler_window_writes_trace(tmp_path):
+    """Profiler.enable traces steps [start, stop) into profiler_log
+    (reference eager_engine.py:202-224 window semantics)."""
+    import os
+    cfg, engine, loader = _build(tmp_path, **{"Engine.max_steps": 6})
+    prof_dir = str(tmp_path / "prof")
+    engine._prof_window = (2, 4)
+    engine._prof_dir = prof_dir
+    engine._prof_active = False
+    engine.fit(epoch=1, train_data_loader=loader)
+    found = []
+    for root, _dirs, files in os.walk(prof_dir):
+        found.extend(files)
+    assert any(f.endswith(".xplane.pb") or "trace" in f for f in found), \
+        found
