@@ -216,6 +216,12 @@ class Engine(BasicEngine):
                 f"{type(module).__name__} does not implement internal "
                 f"pipeline microbatching (supports_pipeline); pp_degree "
                 f"must be 1 for this module")
+        if self.topo.cp_degree > 1 and \
+                not getattr(module, "supports_context_parallel", False):
+            raise ValueError(
+                f"{type(module).__name__} has no context-parallel "
+                f"(ring) attention; cp_degree must be 1 for this "
+                f"module")
         acc = 1 if self.topo.pp_degree > 1 else self.accumulate_steps
         tx, schedule = self.tx, self.lr_schedule
         root_rng = self.root_rng
@@ -293,6 +299,9 @@ class Engine(BasicEngine):
         data_size = data_world_size(self.mesh)
         n_loaders = process_data_loader_count(self.mesh)
 
+        from ..parallel.mesh import CP_AXIS
+        cp = self.mesh.shape.get(CP_AXIS, 1)
+
         def put(x):
             x = np.asarray(x)
             # batches indivisible by the dataflow axis (small offline
@@ -300,8 +309,21 @@ class Engine(BasicEngine):
             # uses the GLOBAL batch dim (local rows x distinct loader
             # ranks), not the process-local one
             global_rows = x.shape[0] * n_loaders
-            spec = P(DATA_AXES, *([None] * (x.ndim - 1))) \
-                if global_rows % data_size == 0 else P()
+            if global_rows % data_size == 0:
+                # context parallel: the sequence dim (axis 1 of token/
+                # label/mask arrays) shards over cp at the source.
+                # Single-process only: every loader yields the FULL
+                # sequence, so under multi-host assembly
+                # (make_array_from_process_local_data) a cp-sharded
+                # seq spec would stitch wrong halves together — let
+                # GSPMD reshard at the first constraint instead.
+                rest = [None] * (x.ndim - 1)
+                if cp > 1 and x.ndim >= 2 and x.shape[1] % cp == 0 \
+                        and jax.process_count() == 1:
+                    rest[0] = CP_AXIS
+                spec = P(DATA_AXES, *rest)
+            else:
+                spec = P()
             sharding = NamedSharding(self.mesh, spec)
             if jax.process_count() == 1:
                 return jax.device_put(x, sharding)

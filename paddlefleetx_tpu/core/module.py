@@ -24,6 +24,10 @@ class BasicModule:
     """Subclasses implement ``get_model``/``loss_fn``; the engine owns
     the step loop and calls the hooks."""
 
+    #: set True when the model handles cp-sharded sequences (ring
+    #: attention); the engine rejects cp_degree > 1 otherwise
+    supports_context_parallel = False
+
     def __init__(self, configs):
         self.configs = configs
         self.nranks = None  # filled by the engine with mesh world size
@@ -68,14 +72,11 @@ class BasicModule:
         return None
 
     def _data_section(self):
-        """First present Data mode section (eval-only configs have no
-        Train; offline eval builds modules too)."""
-        data = self.configs.Data
-        section = data.get("Train") or data.get("Eval") or \
+        """First present Data mode section, or None (eval-only configs
+        have no Train; dry-run configs may have no Data at all)."""
+        data = self.configs.get("Data") or {}
+        return data.get("Train") or data.get("Eval") or \
             data.get("Test")
-        if section is None:
-            raise ValueError("config has no Data.Train/Eval/Test section")
-        return section
 
 
 class LanguageModule(BasicModule):

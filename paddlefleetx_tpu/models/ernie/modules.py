@@ -76,7 +76,9 @@ class ErnieModule(LanguageModule):
                                       with_nsp_loss=False)
 
     def input_spec(self):
-        seq = self._data_section().dataset.max_seq_len
+        section = self._data_section()
+        seq = section.dataset.max_seq_len if section \
+            else self.model_config.max_position_embeddings
         micro = self.configs.Global.micro_batch_size
         return [((micro, seq), "int32")]
 

@@ -33,6 +33,8 @@ class GPTConfig:
     # TPU-specific knobs (absent in reference):
     scan_layers: bool = True              # lax.scan over layers
     use_flash_attention: bool = False     # Pallas kernel on TPU
+    context_parallel: bool = False        # ring attention over the cp
+    #                                       mesh axis (long context)
     dtype: str = "float32"                # compute dtype (bf16 for AMP-O2)
     param_dtype: str = "float32"
 

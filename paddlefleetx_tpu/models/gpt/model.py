@@ -32,6 +32,7 @@ from typing import Any, Callable, Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.ad_checkpoint import checkpoint_name
 
 from ...ops.attention import dot_product_attention
@@ -127,12 +128,35 @@ class MultiHeadAttention(nn.Module):
         dropout_rng = None
         if cfg.attention_probs_dropout_prob > 0.0 and not deterministic:
             dropout_rng = self.make_rng("dropout")
-        out = dot_product_attention(
-            q, k, v, bias=attn_bias, causal=True,
-            query_offset=query_offset,
-            dropout_rate=cfg.attention_probs_dropout_prob,
-            dropout_rng=dropout_rng, deterministic=deterministic,
-            use_flash=cfg.use_flash_attention)
+
+        ring_mesh = None
+        if cfg.context_parallel and not use_cache and attn_bias is None \
+                and (deterministic
+                     or cfg.attention_probs_dropout_prob == 0.0):
+            from ...parallel.mesh import (
+                CP_AXIS, DATA_AXES, MP_AXIS, get_mesh,
+            )
+            mesh = get_mesh()
+            if mesh is not None and mesh.shape.get(CP_AXIS, 1) > 1:
+                # shard_map needs exact divisibility; undersized
+                # shapes (e.g. the batch-1 abstract-init sample) take
+                # the dense path — parameters are unaffected
+                bsz = int(np.prod([mesh.shape[a] for a in DATA_AXES]))
+                if q.shape[0] % bsz == 0 and \
+                        q.shape[1] % mesh.shape[CP_AXIS] == 0 and \
+                        q.shape[2] % mesh.shape[MP_AXIS] == 0:
+                    ring_mesh = mesh
+        if ring_mesh is not None:
+            from ...ops.ring_attention import ring_attention_sharded
+            out = ring_attention_sharded(q, k, v, ring_mesh,
+                                         causal=True)
+        else:
+            out = dot_product_attention(
+                q, k, v, bias=attn_bias, causal=True,
+                query_offset=query_offset,
+                dropout_rate=cfg.attention_probs_dropout_prob,
+                dropout_rng=dropout_rng, deterministic=deterministic,
+                use_flash=cfg.use_flash_attention)
         out = checkpoint_name(out, "attn")
 
         out = nn.DenseGeneral(

@@ -30,8 +30,25 @@ class GPTModule(LanguageModule):
         process_configs(configs)
         super().__init__(configs)
 
+    #: ring attention handles the cp-sharded sequence axis
+    supports_context_parallel = True
+
     def get_model(self):
         self.model_config = GPTConfig.from_config(self.configs)
+        cp = (self.configs.get("Distributed") or {}).get("cp_degree", 1)
+        if (cp or 1) > 1:
+            if self.model_config.attention_probs_dropout_prob > 0:
+                # the ring path has no attention-prob dropout; a
+                # silent dense fallback would defeat cp's O((s/cp)^2)
+                # memory purpose
+                raise ValueError(
+                    "cp_degree > 1 requires "
+                    "attention_probs_dropout_prob = 0 (ring attention "
+                    "does not implement attention-prob dropout)")
+            if not self.model_config.context_parallel:
+                import dataclasses
+                self.model_config = dataclasses.replace(
+                    self.model_config, context_parallel=True)
         return GPTForPretraining(self.model_config)
 
     def loss_fn(self, params, batch, rng, train: bool = True):
@@ -59,7 +76,9 @@ class GPTModule(LanguageModule):
         return cross_entropy_loss(logits, labels, loss_mask)
 
     def input_spec(self):
-        seq = self._data_section().dataset.max_seq_len
+        section = self._data_section()
+        seq = section.dataset.max_seq_len if section \
+            else self.model_config.max_position_embeddings
         micro = self.configs.Global.micro_batch_size
         return [((micro, seq), "int32"), ((micro, seq), "int32")]
 

@@ -1,0 +1,118 @@
+"""Ring attention: exact attention over a sequence-sharded mesh axis.
+
+Long-context scaling beyond the reference (SURVEY §5.7: the reference
+has no ring/blockwise/context parallelism — its only sequence scaling
+is Megatron-SP, which still materializes full attention per rank and
+tops out at ~1k tokens). Here each device holds a sequence block; KV
+blocks rotate around the ``cp`` mesh axis with ``jax.lax.ppermute``
+(one ICI-neighbor hop per step — compute on the current block overlaps
+the transfer of the next) while a streaming log-sum-exp accumulator
+(the flash-attention recurrence) combines per-block partial outputs
+into the *exact* softmax result. Peak memory per device is
+O(s/N * s/N) score blocks instead of O(s * s).
+
+Layout: ``[b, s/N, h, d]`` per device, batch over dp x fsdp, heads
+over mp, sequence over cp — composes with every other axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, scale, causal, q_start, k_start):
+    """Scores + masked row-max/row-sum for one (q-block, kv-block)
+    pair; returns (out_block, row_max, row_sum) in fp32."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_start + jnp.arange(sq)[:, None]
+        k_pos = k_start + jnp.arange(sk)[None, :]
+        scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)                          # [b,h,q]
+    # rows with no visible key (fully masked) must not produce
+    # exp(NEG_INF - NEG_INF) = 1 garbage
+    safe_m = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    p = jnp.exp(scores - safe_m[..., None])
+    p = jnp.where(scores <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1)                               # noqa: E741
+    out = jnp.einsum("bhqk,bkhd->bqhd", p,
+                     v.astype(jnp.float32))
+    return out, m, l
+
+
+@partial(jax.jit, static_argnames=("axis_name", "causal", "scale"))
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   *, axis_name: str, causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Exact attention with KV blocks rotating over ``axis_name``.
+
+    Call under ``shard_map`` (or use :func:`ring_attention_sharded`):
+    arguments are the per-device blocks ``[b, s_local, h, d]``.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    scale = scale if scale is not None else d ** -0.5
+    q_start = idx * sq
+
+    perm = [(i, (i + 1) % n) for i in range(n)]  # send KV to the right
+
+    def step(carry, i):
+        k_blk, v_blk, out, m, l = carry  # noqa: E741
+        # after i rotations, this device holds the KV block that
+        # originated at ring position idx - i
+        src = (idx - i) % n
+        blk_out, blk_m, blk_l = _block_attn(
+            q, k_blk, v_blk, scale, causal, q_start, src * sq)
+        new_m = jnp.maximum(m, blk_m)
+        # renormalize both accumulators onto the new running max
+        safe = lambda x: jnp.where(  # noqa: E731
+            new_m <= NEG_INF / 2, 0.0, x)
+        alpha = jnp.exp(safe(m - new_m))
+        beta = jnp.exp(safe(blk_m - new_m))
+        out = out * alpha[..., None].swapaxes(1, 2) + \
+            blk_out * beta[..., None].swapaxes(1, 2)
+        l = l * alpha + blk_l * beta  # noqa: E741
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, out, new_m, l), None
+
+    # fresh accumulators must carry the same device-varying type as
+    # the loop outputs under shard_map; deriving them from q (a
+    # varying input) gives them that type on any jax version
+    zero_q = jnp.sum(q.astype(jnp.float32)) * 0.0
+    out0 = jnp.zeros((b, sq, h, d), jnp.float32) + zero_q
+    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32) + zero_q
+    l0 = jnp.zeros((b, h, sq), jnp.float32) + zero_q
+    (_, _, out, _, l), _ = jax.lax.scan(
+        step, (k, v, out0, m0, l0), jnp.arange(n))
+    out = out / jnp.maximum(l, 1e-30)[..., None].swapaxes(1, 2)
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                           mesh, *, axis_name: str = None,
+                           batch_axes=None, heads_axis: str = None,
+                           causal: bool = True) -> jax.Array:
+    """shard_map wrapper: global ``[b, s, h, d]`` -> global attention
+    output, with s sharded over ``axis_name`` and the ring running
+    inside. Axis defaults come from the mesh convention
+    (``parallel/mesh.py``), not re-spelled strings."""
+    from ..parallel.mesh import CP_AXIS, DATA_AXES, MP_AXIS
+    axis_name = axis_name or CP_AXIS
+    batch_axes = batch_axes or DATA_AXES
+    heads_axis = heads_axis or MP_AXIS
+    spec = P(batch_axes, axis_name, heads_axis, None)
+    fn = partial(ring_attention, axis_name=axis_name, causal=causal)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)

@@ -31,9 +31,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 PP_AXIS = "pp"
 DP_AXIS = "dp"
+CP_AXIS = "cp"
 FSDP_AXIS = "fsdp"
 MP_AXIS = "mp"
-MESH_AXES = (PP_AXIS, DP_AXIS, FSDP_AXIS, MP_AXIS)
+MESH_AXES = (PP_AXIS, DP_AXIS, CP_AXIS, FSDP_AXIS, MP_AXIS)
 #: the reference's dp x sharding composite dataflow axis (env.py:76-96)
 DATA_AXES = (DP_AXIS, FSDP_AXIS)
 
@@ -44,10 +45,19 @@ class TopologyConfig:
     dp_degree: int = 1
     mp_degree: int = 1
     pp_degree: int = 1
+    cp_degree: int = 1          # context parallel (ring attention) —
+    #                             beyond-reference (SURVEY §5.7)
     sharding_degree: int = 1
     sharding_stage: int = 1
     sharding_offload: bool = False
     sequence_parallel: bool = False
+
+    def __post_init__(self):
+        if self.cp_degree > 1 and self.sequence_parallel:
+            raise ValueError(
+                "cp_degree (ring attention) and sequence_parallel "
+                "(Megatron-SP seq-over-mp) both shard the sequence "
+                "axis; enable at most one")
 
     @classmethod
     def from_config(cls, config) -> "TopologyConfig":
@@ -58,6 +68,7 @@ class TopologyConfig:
             dp_degree=dist.get("dp_degree") or 1,
             mp_degree=dist.get("mp_degree") or 1,
             pp_degree=dist.get("pp_degree") or 1,
+            cp_degree=dist.get("cp_degree") or 1,
             sharding_degree=sharding.get("sharding_degree") or 1,
             sharding_stage=sharding.get("sharding_stage") or 1,
             sharding_offload=bool(sharding.get("sharding_offload", False)),
@@ -67,7 +78,7 @@ class TopologyConfig:
     @property
     def world_size(self) -> int:
         return (self.dp_degree * self.mp_degree * self.pp_degree
-                * self.sharding_degree)
+                * self.cp_degree * self.sharding_degree)
 
     @property
     def data_world_size(self) -> int:
@@ -82,8 +93,8 @@ def build_mesh(topo: TopologyConfig,
     coordinates onto the physical ICI torus; elsewhere (CPU test
     meshes) a plain reshape is used.
     """
-    shape = (topo.pp_degree, topo.dp_degree, topo.sharding_degree,
-             topo.mp_degree)
+    shape = (topo.pp_degree, topo.dp_degree, topo.cp_degree,
+             topo.sharding_degree, topo.mp_degree)
     n = int(np.prod(shape))
     if devices is None:
         if n != jax.device_count():
@@ -144,10 +155,12 @@ def _process_data_groups(mesh: Mesh):
     sets are distinct loader ranks. Returns (groups, my_group_index)
     with groups ordered by their first dataflow coordinate.
     """
+    dp_axis = mesh.axis_names.index(DP_AXIS)
+    fsdp_axis = mesh.axis_names.index(FSDP_AXIS)
     coords = {}
     for idx, dev in np.ndenumerate(mesh.devices):
-        _, dp_i, fsdp_i, _ = idx
-        pos = int(dp_i * mesh.shape[FSDP_AXIS] + fsdp_i)
+        pos = int(idx[dp_axis] * mesh.shape[FSDP_AXIS]
+                  + idx[fsdp_axis])
         coords.setdefault(dev.process_index, set()).add(pos)
     groups = {}
     for proc, pos_set in coords.items():

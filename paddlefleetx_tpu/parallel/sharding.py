@@ -29,7 +29,8 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import (
-    DATA_AXES, DP_AXIS, FSDP_AXIS, MP_AXIS, PP_AXIS, TopologyConfig,
+    CP_AXIS, DATA_AXES, DP_AXIS, FSDP_AXIS, MP_AXIS, PP_AXIS,
+    TopologyConfig,
 )
 
 Rules = Tuple[Tuple[str, Any], ...]
@@ -48,8 +49,14 @@ def make_sharding_rules(topo: TopologyConfig) -> Rules:
       explicit collectives.
     """
     embed_axis = FSDP_AXIS if topo.sharding_stage == 3 else None
-    seq_axis = MP_AXIS if (topo.sequence_parallel and topo.mp_degree > 1) \
-        else None
+    if topo.cp_degree > 1:
+        # context parallel: activations flow sequence-sharded over cp;
+        # attention runs the ring (ops/ring_attention.py)
+        seq_axis = CP_AXIS
+    elif topo.sequence_parallel and topo.mp_degree > 1:
+        seq_axis = MP_AXIS
+    else:
+        seq_axis = None
     # PP: stage s owns the contiguous layer block [s*L/pp, (s+1)*L/pp)
     # of the scan-stacked params — the LayerDesc segmentation of
     # reference hybrid_model.py:955, expressed as a sharding

@@ -178,11 +178,14 @@ def process_dist_config(config: AttrDict, nranks: Optional[int] = None) -> None:
         sharding["sharding_degree"] = 1
     sharding.setdefault("sharding_stage", 1)
     sharding.setdefault("sharding_offload", False)
-    other = dist["mp_degree"] * dist["pp_degree"] * sharding["sharding_degree"]
+    if not dist.get("cp_degree"):
+        dist["cp_degree"] = 1
+    other = (dist["mp_degree"] * dist["pp_degree"] * dist["cp_degree"]
+             * sharding["sharding_degree"])
     if nranks % other != 0:
         raise ValueError(
             f"device count {nranks} not divisible by "
-            f"mp*pp*sharding = {other}")
+            f"mp*pp*cp*sharding = {other}")
     if not dist.get("dp_degree"):
         dist["dp_degree"] = nranks // other
     elif dist["dp_degree"] * other != nranks:

@@ -16,7 +16,8 @@ def topo(**kw):
 
 def test_mesh_shape_dp2_mp2_fsdp2():
     mesh = build_mesh(topo(dp_degree=2, mp_degree=2, sharding_degree=2))
-    assert dict(mesh.shape) == {"pp": 1, "dp": 2, "fsdp": 2, "mp": 2}
+    assert dict(mesh.shape) == {"pp": 1, "dp": 2, "cp": 1, "fsdp": 2,
+                                "mp": 2}
     assert data_world_size(mesh) == 4
 
 
