@@ -124,20 +124,22 @@ class Engine(BasicEngine):
 
     def _abstract_state(self):
         model = self.module.model
-        spec = self.module.input_spec()
-        if spec:
-            shape, dtype = spec[0]
+        spec = self.module.input_spec() or [((1, 8), "int32")]
+        samples = []
+        for shape, dtype in spec:
             shape = tuple(1 if d is None else int(d) for d in shape)
             # a full-size dummy is wasteful for abstract init; shrink
             # the batch dim (weights don't depend on it)
-            shape = (1,) + shape[1:]
-            sample_shape, sample_dtype = shape, jnp.dtype(dtype)
-        else:
-            sample_shape, sample_dtype = (1, 8), jnp.int32
+            samples.append(((1,) + shape[1:], jnp.dtype(dtype)))
+
+        extra_rngs = getattr(self.module, "init_rng_collections", ())
 
         def init_fn(rng):
-            sample = jnp.zeros(sample_shape, sample_dtype)
-            variables = model.init({"params": rng}, sample)
+            rngs = {"params": rng}
+            for i, name in enumerate(extra_rngs):
+                rngs[name] = jax.random.fold_in(rng, i + 1)
+            variables = self.module.init_model_variables(
+                model, rngs, [jnp.zeros(s, d) for s, d in samples])
             params = variables["params"]
             state = {"params": params, "step": jnp.zeros((), jnp.int32)}
             if self.mode == "train":

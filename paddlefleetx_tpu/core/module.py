@@ -71,6 +71,12 @@ class BasicModule:
         """Abstract input shapes/dtypes for export (AOT compile)."""
         return None
 
+    def init_model_variables(self, model, rngs, samples):
+        """Parameter init call — override when the model needs extra
+        static arguments so the created tree matches what ``loss_fn``
+        will apply (e.g. Imagen's cascade stage selection)."""
+        return model.init(rngs, *samples)
+
     def _data_section(self):
         """First present Data mode section, or None (eval-only configs
         have no Train; dry-run configs may have no Data at all)."""

@@ -41,6 +41,7 @@ def _populate():
                                      "Lambada_Eval_Dataset"),
         "dataset.vision_dataset": ("GeneralClsDataset", "ImageFolder",
                                    "CIFAR"),
+        "dataset.multimodal_dataset": ("ImagenDataset",),
     }
     import importlib
     for mod, names in optional.items():

@@ -1,0 +1,84 @@
+"""Imagen training dataset.
+
+Parity: reference ``data/dataset/multimodal_dataset.py:36-180``
+(``ImagenDataset``): each input file is a TSV whose lines are
+``key \t embed.npy \t mask.npy \t base64image``; text embeddings and
+masks are precomputed (T5) ``.npy`` files next to the TSV; images are
+base64-decoded and box-downscaled/bicubic-resized then center-cropped
+to the stage resolution (``data_augmentation_for_imagen`` :77-94).
+Per-process file partitioning (``get_files`` :36-63) is expressed
+through the loader's ``num_replicas``/``rank`` contract instead of
+global state.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import os
+from typing import List, Optional
+
+import numpy as np
+
+
+def data_augmentation_for_imagen(img, resolution: int) -> np.ndarray:
+    """PIL image -> CHW float32 [0, 255-scale] center crop (reference
+    :77-94; kept in [0, 1] here — the model normalizes to [-1, 1])."""
+    from PIL import Image
+    arr = img
+    while min(arr.size) >= 2 * resolution:
+        arr = arr.resize(tuple(x // 2 for x in arr.size),
+                         resample=Image.BOX)
+    scale = resolution / min(arr.size)
+    arr = arr.resize(tuple(round(x * scale) for x in arr.size),
+                     resample=Image.BICUBIC)
+    a = np.asarray(arr.convert("RGB"), np.float32) / 255.0
+    y = (a.shape[0] - resolution) // 2
+    x = (a.shape[1] - resolution) // 2
+    a = a[y:y + resolution, x:x + resolution]
+    return np.transpose(a, (2, 0, 1))
+
+
+class ImagenDataset:
+    def __init__(self, input_path: str, input_resolution: int = 64,
+                 max_seq_len: int = 128, split: str = "train",
+                 input_resolusion: Optional[int] = None, **_):
+        # the reference spells it "resolusion"; accept both
+        if input_resolusion is not None:
+            input_resolution = input_resolusion
+        self.resolution = input_resolution
+        self.max_seq_len = max_seq_len
+        files = [line.strip() for line in open(input_path)
+                 if line.strip()]
+        self.samples: List = []
+        for path in files:
+            data_dir = os.path.dirname(path)
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        self.samples.append((data_dir, line))
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def __getitem__(self, idx: int):
+        from PIL import Image
+        data_dir, line = self.samples[idx]
+        fields = line.split("\t")
+        _key, embed_file, mask_file, b64 = fields[:4]
+        text_embed = np.load(os.path.join(data_dir, embed_file),
+                             mmap_mode="r")
+        attn_mask = np.load(os.path.join(data_dir, mask_file),
+                            mmap_mode="r")
+        img = Image.open(io.BytesIO(base64.b64decode(b64)))
+        image = data_augmentation_for_imagen(img, self.resolution)
+
+        # pad/trim the text sequence to max_seq_len
+        embed = np.zeros((self.max_seq_len, text_embed.shape[-1]),
+                         np.float32)
+        mask = np.zeros((self.max_seq_len,), np.int64)
+        n = min(self.max_seq_len, text_embed.shape[0])
+        embed[:n] = text_embed[:n]
+        mask[:n] = np.asarray(attn_mask[:n], np.int64)
+        return image, embed, mask

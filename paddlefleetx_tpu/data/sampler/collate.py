@@ -93,7 +93,14 @@ def gpt_eval_collate_fn(batch):
     return Tuple(Stack(), Stack(), Stack(), Stack(), Stack(), Stack())(batch)
 
 
+def imagen_collate_fn(batch):
+    """(image, text_embed, text_mask) stacking (reference
+    ``utils/batch_collate_fn.py`` imagen_collate_fn)."""
+    return default_collate_fn(batch)
+
+
 COLLATE_FNS: dict[str, Callable] = {
+    "imagen_collate_fn": imagen_collate_fn,
     "default_collate_fn": default_collate_fn,
     "gpt_collate_fn": gpt_collate_fn,
     "gpt_inference_collate_fn": gpt_inference_collate_fn,

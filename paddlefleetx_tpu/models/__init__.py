@@ -20,7 +20,8 @@ def build_module(config):
     """Instantiate the module named by ``config.Model.module``."""
     # populate the registry lazily to avoid heavy imports at package load
     import importlib
-    for mod in ("gpt.modules", "ernie.modules", "vit.modules"):
+    for mod in ("gpt.modules", "ernie.modules", "vit.modules",
+                "imagen.modules"):
         try:
             importlib.import_module(f".{mod}", __package__)
         except ModuleNotFoundError as e:

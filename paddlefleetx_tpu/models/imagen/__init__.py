@@ -1,0 +1,21 @@
+"""Imagen model family: cascaded text-to-image continuous-time
+diffusion."""
+
+from .diffusion import GaussianDiffusionContinuousTimes
+from .modeling import (
+    IMAGEN_MODELS,
+    ImagenModel,
+    build_imagen_model,
+    imagen_criterion,
+)
+from .unet import Unet, UnetConfig
+
+__all__ = [
+    "GaussianDiffusionContinuousTimes",
+    "IMAGEN_MODELS",
+    "ImagenModel",
+    "Unet",
+    "UnetConfig",
+    "build_imagen_model",
+    "imagen_criterion",
+]

@@ -1,0 +1,103 @@
+"""Continuous-time gaussian diffusion (v-diffusion parameterization).
+
+Parity: reference ``imagen/utils.py:321-424``
+(``GaussianDiffusionContinuousTimes`` and its log-SNR helpers, credited
+there to crowsonkb's v-diffusion-jax — this implementation returns to
+jax natively). Times are continuous in [0, 1]; the noise level is
+``log_snr(t)`` with either the cosine or the linear-beta schedule, and
+``alpha = sqrt(sigmoid(log_snr))``, ``sigma = sqrt(sigmoid(-log_snr))``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _log(t, eps=1e-12):
+    return jnp.log(jnp.clip(t, min=eps))
+
+
+def beta_linear_log_snr(t: jax.Array) -> jax.Array:
+    return -_log(jnp.expm1(1e-4 + 10 * (t ** 2)))
+
+
+def alpha_cosine_log_snr(t: jax.Array, s: float = 0.008) -> jax.Array:
+    return -_log(
+        jnp.cos((t + s) / (1 + s) * math.pi * 0.5) ** -2 - 1, eps=1e-5)
+
+
+def log_snr_to_alpha_sigma(log_snr: jax.Array
+                           ) -> Tuple[jax.Array, jax.Array]:
+    return (jnp.sqrt(jax.nn.sigmoid(log_snr)),
+            jnp.sqrt(jax.nn.sigmoid(-log_snr)))
+
+
+def _pad_like(x: jax.Array, t: jax.Array) -> jax.Array:
+    """Right-pad ``t``'s dims to broadcast against image-shaped ``x``."""
+    return t.reshape(t.shape + (1,) * (x.ndim - t.ndim))
+
+
+class GaussianDiffusionContinuousTimes:
+    """Stateless schedule object (no parameters — unlike the reference
+    nn.Layer, it needs no device registration)."""
+
+    def __init__(self, noise_schedule: str = "cosine",
+                 timesteps: int = 1000):
+        if noise_schedule == "linear":
+            self.log_snr = beta_linear_log_snr
+        elif noise_schedule == "cosine":
+            self.log_snr = alpha_cosine_log_snr
+        else:
+            raise ValueError(f"invalid noise schedule {noise_schedule}")
+        self.num_timesteps = timesteps
+
+    def get_times(self, batch_size: int, noise_level: float) -> jax.Array:
+        return jnp.full((batch_size,), noise_level, jnp.float32)
+
+    def sample_random_times(self, rng: jax.Array, batch_size: int,
+                            max_thres: float = 0.999) -> jax.Array:
+        return jax.random.uniform(rng, (batch_size,), jnp.float32, 0,
+                                  max_thres)
+
+    def get_condition(self, times: Optional[jax.Array]):
+        return self.log_snr(times) if times is not None else None
+
+    def get_sampling_timesteps(self, batch: int) -> jax.Array:
+        """[T, 2, b]: (t, t_next) pairs from 1 -> 0."""
+        times = jnp.linspace(1.0, 0.0, self.num_timesteps + 1)
+        pairs = jnp.stack([times[:-1], times[1:]], axis=1)  # [T, 2]
+        return jnp.broadcast_to(pairs[:, :, None],
+                                (self.num_timesteps, 2, batch))
+
+    def q_sample(self, x_start: jax.Array, t: jax.Array,
+                 noise: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        log_snr = self.log_snr(t)
+        alpha, sigma = log_snr_to_alpha_sigma(_pad_like(x_start, log_snr))
+        return alpha * x_start + sigma * noise, log_snr
+
+    def q_posterior(self, x_start: jax.Array, x_t: jax.Array,
+                    t: jax.Array, t_next: Optional[jax.Array] = None):
+        """Posterior q(x_{t_next} | x_t, x_start); eq. 33 of the
+        variational-diffusion supplement (as in the reference)."""
+        if t_next is None:
+            t_next = jnp.clip(t - 1.0 / self.num_timesteps, min=0.0)
+        log_snr = _pad_like(x_t, self.log_snr(t))
+        log_snr_next = _pad_like(x_t, self.log_snr(t_next))
+        alpha, _sigma = log_snr_to_alpha_sigma(log_snr)
+        alpha_next, sigma_next = log_snr_to_alpha_sigma(log_snr_next)
+        c = -jnp.expm1(log_snr - log_snr_next)
+        posterior_mean = alpha_next * (x_t * (1 - c) / alpha
+                                       + c * x_start)
+        posterior_variance = (sigma_next ** 2) * c
+        return posterior_mean, posterior_variance, \
+            _log(posterior_variance, eps=1e-20)
+
+    def predict_start_from_noise(self, x_t: jax.Array, t: jax.Array,
+                                 noise: jax.Array) -> jax.Array:
+        log_snr = _pad_like(x_t, self.log_snr(t))
+        alpha, sigma = log_snr_to_alpha_sigma(log_snr)
+        return (x_t - sigma * noise) / jnp.clip(alpha, min=1e-8)

@@ -1,0 +1,69 @@
+"""Imagen task module (reference
+``multimodal_model/multimodal_module.py:103-137``): build the cascade
+from the ``Model`` section, criterion from ``Loss``, train on
+(image, text_embed, text_mask) batches."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+
+from .. import register_module
+from ...core.module import BasicModule
+from ...utils.log import logger
+from .modeling import build_imagen_model, imagen_criterion
+
+
+@register_module("ImagenModule")
+class ImagenModule(BasicModule):
+    #: forward draws times/noise/cond-drop from this rng collection
+    init_rng_collections = ("diffusion",)
+
+    def __init__(self, configs):
+        loss_cfg = dict(configs.get("Loss", {}) or {})
+        self.loss_name = loss_cfg.get("name", "mse_loss")
+        self.p2_loss_weight_k = loss_cfg.get("p2_loss_weight_k", 1)
+        self.unet_number = configs.Model.get("unet_number", 1) or 1
+        super().__init__(configs)
+
+    def get_model(self):
+        model_setting = dict(self.configs.Model)
+        model_setting.pop("module", None)
+        model_setting.pop("unet_number", None)
+        name = model_setting.pop("name")
+        return build_imagen_model(name, **model_setting)
+
+    def init_model_variables(self, model, rngs, samples):
+        # init must visit the SAME cascade stage loss_fn trains, or
+        # that stage's params would not exist in the tree
+        return model.init(rngs, *samples, unet_number=self.unet_number)
+
+    def loss_fn(self, params, batch, rng, train: bool = True):
+        images, text_embeds, text_masks = batch
+        pred, target, log_snr, gamma = self.model.apply(
+            {"params": params}, images, text_embeds, text_masks,
+            unet_number=self.unet_number, rngs={"diffusion": rng})
+        return imagen_criterion(pred, target, log_snr, gamma,
+                                name=self.loss_name,
+                                p2_loss_weight_k=self.p2_loss_weight_k)
+
+    def input_spec(self):
+        cfg = self.configs.Model
+        size = (cfg.get("image_sizes") or [64])[self.unet_number - 1]
+        chans = cfg.get("in_chans", 3)
+        embed_dim = cfg.get("text_embed_dim", 1024)
+        micro = self.configs.Global.micro_batch_size
+        # __call__(images, text_embeds, ...) — init needs all three
+        return [((micro, chans, size, size), "float32"),
+                ((micro, 128, embed_dim), "float32"),
+                ((micro, 128), "int32")]
+
+    def training_step_end(self, log_dict: Dict[str, Any]) -> None:
+        bs = self.configs.Global.global_batch_size
+        logger.train(
+            "[train] epoch: %d, batch: %d, loss: %.9f, avg_batch_cost: "
+            "%.5f sec, ips: %.2f images/sec, learning rate: %.5e",
+            log_dict["epoch"], log_dict["batch"], log_dict["loss"],
+            log_dict["train_cost"], bs / log_dict["train_cost"],
+            log_dict["lr"])

@@ -1,0 +1,360 @@
+"""Imagen family: diffusion math, U-Net shapes/conditioning, criterion,
+dataset, engine training, and sampling."""
+
+import base64
+import io
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlefleetx_tpu.models.imagen import (
+    GaussianDiffusionContinuousTimes, ImagenModel, imagen_criterion,
+)
+from paddlefleetx_tpu.models.imagen.modeling import ImagenConfig
+from paddlefleetx_tpu.models.imagen.unet import Unet, UnetConfig
+
+TINY_UNET = dict(dim=16, dim_mults=(1, 2), num_resnet_blocks=1,
+                 layer_attns=(False, True),
+                 layer_cross_attns=(False, True), attn_heads=2,
+                 attn_dim_head=8, text_embed_dim=32, num_latents=4,
+                 cross_embed_kernel_sizes=(3, 7))
+
+
+def tiny_imagen(**kw):
+    base = dict(unets=("Unet64_397M",), image_sizes=(16,),
+                text_embed_dim=32, timesteps=8,
+                unet_overrides=tuple(TINY_UNET.items()))
+    base.update(kw)
+    return ImagenModel(ImagenConfig(**base))
+
+
+# -- diffusion math -----------------------------------------------------
+
+def test_q_sample_preserves_signal_noise_split():
+    sched = GaussianDiffusionContinuousTimes("cosine", 10)
+    x = jnp.ones((2, 4, 4, 3))
+    noise = jnp.zeros_like(x)
+    t = jnp.asarray([0.0, 0.999])
+    noisy, log_snr = sched.q_sample(x, t, noise)
+    # t=0: alpha ~ 1 (signal passes); t~1: alpha ~ 0
+    assert float(noisy[0].mean()) > 0.99
+    assert abs(float(noisy[1].mean())) < 0.1
+    assert float(log_snr[0]) > float(log_snr[1])
+
+
+def test_predict_start_inverts_q_sample():
+    sched = GaussianDiffusionContinuousTimes("cosine", 10)
+    rng = jax.random.key(0)
+    x = jax.random.normal(rng, (2, 4, 4, 3))
+    noise = jax.random.normal(jax.random.key(1), x.shape)
+    t = jnp.asarray([0.3, 0.7])
+    noisy, _ = sched.q_sample(x, t, noise)
+    back = sched.predict_start_from_noise(noisy, t, noise)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_posterior_at_tiny_gap_returns_xnext_near_xt():
+    sched = GaussianDiffusionContinuousTimes("linear", 100)
+    x_start = jnp.zeros((1, 2, 2, 3))
+    x_t = jnp.ones((1, 2, 2, 3))
+    t = jnp.asarray([0.5])
+    mean, var, _ = sched.q_posterior(x_start, x_t, t,
+                                     t_next=jnp.asarray([0.499]))
+    assert np.all(np.isfinite(np.asarray(mean)))
+    assert float(var[0, 0, 0, 0]) >= 0
+
+
+def test_sampling_timesteps_cover_1_to_0():
+    sched = GaussianDiffusionContinuousTimes("cosine", 5)
+    pairs = sched.get_sampling_timesteps(batch=2)
+    assert pairs.shape == (5, 2, 2)
+    assert float(pairs[0, 0, 0]) == 1.0
+    assert float(pairs[-1, 1, 0]) == 0.0
+
+
+# -- criterion ----------------------------------------------------------
+
+def test_criterion_p2_weighting():
+    pred = jnp.ones((2, 4, 4, 3))
+    target = jnp.zeros_like(pred)
+    log_snr = jnp.asarray([0.0, 0.0])
+    plain = imagen_criterion(pred, target, log_snr, 0.0)
+    np.testing.assert_allclose(float(plain), 1.0, rtol=1e-6)
+    weighted = imagen_criterion(pred, target, log_snr, 1.0,
+                                p2_loss_weight_k=1.0)
+    np.testing.assert_allclose(float(weighted), 0.5, rtol=1e-6)
+    l1 = imagen_criterion(pred * 2, target, log_snr, 0.0,
+                          name="l1_loss")
+    np.testing.assert_allclose(float(l1), 2.0, rtol=1e-6)
+
+
+# -- U-Net --------------------------------------------------------------
+
+def test_unet_forward_shape_and_conditioning():
+    cfg = UnetConfig(**TINY_UNET)
+    unet = Unet(cfg)
+    x = jnp.zeros((2, 16, 16, 3))
+    t = jnp.zeros((2,))
+    emb = jnp.asarray(np.random.default_rng(0).normal(size=(2, 6, 32)),
+                      jnp.float32)
+    mask = jnp.ones((2, 6), jnp.int32)
+    params = unet.init({"params": jax.random.key(0)}, x, t,
+                       text_embeds=emb, text_mask=mask)["params"]
+    out = unet.apply({"params": params}, x, t, text_embeds=emb,
+                     text_mask=mask)
+    assert out.shape == (2, 16, 16, 3)
+    # zero-init final conv -> exactly zero prediction at init
+    np.testing.assert_allclose(np.asarray(out), 0.0)
+
+    # conditioning matters: different text embeds -> different output
+    params2 = jax.tree.map(
+        lambda p: p + 0.01 * np.random.default_rng(1).normal(
+            size=p.shape).astype(np.float32), params)
+    a = unet.apply({"params": params2}, x, t, text_embeds=emb,
+                   text_mask=mask)
+    b = unet.apply({"params": params2}, x, t, text_embeds=emb + 1.0,
+                   text_mask=mask)
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+
+    # cond_drop_mask=True reproduces the null-conditioned output
+    drop = unet.apply({"params": params2}, x, t, text_embeds=emb,
+                      text_mask=mask,
+                      cond_drop_mask=jnp.ones((2,), bool))
+    drop2 = unet.apply({"params": params2}, x, t,
+                       text_embeds=emb + 5.0, text_mask=mask,
+                       cond_drop_mask=jnp.ones((2,), bool))
+    np.testing.assert_allclose(np.asarray(drop), np.asarray(drop2),
+                               atol=1e-6)
+
+
+def test_lowres_cond_unet():
+    cfg = UnetConfig(lowres_cond=True, **TINY_UNET)
+    unet = Unet(cfg)
+    x = jnp.zeros((1, 16, 16, 3))
+    t = jnp.zeros((1,))
+    lr = jnp.zeros((1, 16, 16, 3))
+    params = unet.init({"params": jax.random.key(0)}, x, t,
+                       lowres_cond_img=lr,
+                       lowres_noise_times=t)["params"]
+    out = unet.apply({"params": params}, x, t, lowres_cond_img=lr,
+                     lowres_noise_times=t)
+    assert out.shape == (1, 16, 16, 3)
+
+
+# -- full model ---------------------------------------------------------
+
+def test_imagen_train_math_and_sampling():
+    model = tiny_imagen()
+    images = jnp.asarray(
+        np.random.default_rng(0).uniform(0, 1, (2, 3, 16, 16)),
+        jnp.float32)  # NCHW like the reference collate
+    emb = jnp.asarray(np.random.default_rng(1).normal(size=(2, 6, 32)),
+                      jnp.float32)
+    mask = jnp.ones((2, 6), jnp.int32)
+    variables = model.init(
+        {"params": jax.random.key(0), "diffusion": jax.random.key(1)},
+        images, emb, mask)
+    pred, target, log_snr, gamma = model.apply(
+        variables, images, emb, mask,
+        rngs={"diffusion": jax.random.key(2)})
+    assert pred.shape == (2, 16, 16, 3)
+    assert target.shape == pred.shape and log_snr.shape == (2,)
+    loss = imagen_criterion(pred, target, log_snr, gamma)
+    assert np.isfinite(float(loss))
+
+    out = model.apply(
+        variables, 1, (2, 16, 16, 3), emb, mask,
+        method="sample_stage", rngs={"diffusion": jax.random.key(3)})
+    assert out.shape == (2, 16, 16, 3)
+    assert 0.0 <= float(out.min()) and float(out.max()) <= 1.0
+
+
+def test_imagen_cascade_second_stage():
+    model = tiny_imagen(unets=("Unet64_397M", "Unet64_397M"),
+                        image_sizes=(8, 16))
+    images = jnp.asarray(
+        np.random.default_rng(0).uniform(0, 1, (2, 3, 16, 16)),
+        jnp.float32)
+    emb = jnp.zeros((2, 6, 32), jnp.float32)
+    mask = jnp.ones((2, 6), jnp.int32)
+    variables = model.init(
+        {"params": jax.random.key(0), "diffusion": jax.random.key(1)},
+        images, emb, mask, unet_number=2)
+    pred, target, log_snr, _ = model.apply(
+        variables, images, emb, mask, unet_number=2,
+        rngs={"diffusion": jax.random.key(2)})
+    assert pred.shape == (2, 16, 16, 3)
+
+
+def test_standalone_sr_model_trains():
+    """lowres_cond single-unet models (imagen_SR256-style) synthesize
+    their conditioning image from the training batch."""
+    model = tiny_imagen(
+        unet_overrides=tuple({**TINY_UNET, "lowres_cond": True}.items()))
+    images = jnp.asarray(
+        np.random.default_rng(0).uniform(0, 1, (2, 3, 16, 16)),
+        jnp.float32)
+    emb = jnp.zeros((2, 6, 32), jnp.float32)
+    mask = jnp.ones((2, 6), jnp.int32)
+    variables = model.init(
+        {"params": jax.random.key(0), "diffusion": jax.random.key(1)},
+        images, emb, mask)
+    pred, target, _, _ = model.apply(
+        variables, images, emb, mask,
+        rngs={"diffusion": jax.random.key(2)})
+    assert pred.shape == target.shape == (2, 16, 16, 3)
+
+
+def test_cascade_stage2_init_matches_training(tmp_path):
+    """init_model_variables must create the SAME stage's params that
+    loss_fn trains (unet_number threading)."""
+    from paddlefleetx_tpu.models import build_module
+    from paddlefleetx_tpu.utils.config import AttrDict, process_configs
+
+    cfg = AttrDict({
+        "Global": AttrDict({"device": "cpu", "seed": 1,
+                            "global_batch_size": None,
+                            "local_batch_size": 1,
+                            "micro_batch_size": 1}),
+        "Engine": AttrDict({"max_steps": 1,
+                            "mix_precision": AttrDict({})}),
+        "Model": AttrDict({
+            "module": "ImagenModule",
+            "name": "imagen_397M_text2im_64",
+            "unet_number": 2,
+            "unets": ("Unet64_397M", "Unet64_397M"),
+            "image_sizes": (8, 16), "text_embed_dim": 32,
+            "timesteps": 4,
+            "unet_overrides": tuple(TINY_UNET.items()),
+        }),
+        "Loss": AttrDict({"name": "mse_loss"}),
+        "Distributed": AttrDict({"dp_degree": 1, "sharding":
+                                 AttrDict({})}),
+        "Optimizer": AttrDict({"name": "Adam",
+                               "lr": AttrDict({"learning_rate": 1e-4})}),
+        "Data": AttrDict({}),
+    })
+    process_configs(cfg, nranks=1)
+    module = build_module(cfg)
+    samples = [jnp.zeros(tuple(1 if d is None else d for d in s),
+                         jnp.dtype(t)) for s, t in module.input_spec()]
+    variables = module.init_model_variables(
+        module.model,
+        {"params": jax.random.key(0), "diffusion": jax.random.key(1)},
+        samples)
+    assert "unet_1" in variables["params"]
+    loss = module.loss_fn(
+        variables["params"],
+        (samples[0], samples[1], samples[2].astype(jnp.int32)),
+        jax.random.key(2))
+    assert np.isfinite(float(loss))
+
+
+# -- dataset ------------------------------------------------------------
+
+def _write_imagen_corpus(tmp_path, n=4):
+    from PIL import Image
+    rng = np.random.default_rng(0)
+    data_dir = tmp_path / "data"
+    os.makedirs(data_dir)
+    lines = []
+    for i in range(n):
+        arr = rng.integers(0, 255, (40, 40, 3)).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="PNG")
+        b64 = base64.b64encode(buf.getvalue()).decode()
+        embed = rng.normal(size=(6, 32)).astype(np.float32)
+        np.save(data_dir / f"embed_{i}.npy", embed)
+        np.save(data_dir / f"mask_{i}.npy", np.ones((6,), np.int64))
+        lines.append(f"k{i}\tembed_{i}.npy\tmask_{i}.npy\t{b64}")
+    tsv = data_dir / "part0.tsv"
+    tsv.write_text("\n".join(lines))
+    filelist = tmp_path / "filelist.txt"
+    filelist.write_text(str(tsv) + "\n")
+    return str(filelist)
+
+
+def test_imagen_dataset(tmp_path):
+    from paddlefleetx_tpu.data.dataset.multimodal_dataset import (
+        ImagenDataset,
+    )
+    filelist = _write_imagen_corpus(tmp_path)
+    ds = ImagenDataset(filelist, input_resolution=16, max_seq_len=8)
+    assert len(ds) == 4
+    image, embed, mask = ds[0]
+    assert image.shape == (3, 16, 16)
+    assert 0.0 <= image.min() and image.max() <= 1.0
+    assert embed.shape == (8, 32) and mask.shape == (8,)
+    assert mask[:6].all() and not mask[6:].any()
+
+
+def test_imagen_trains_through_engine(tmp_path):
+    from paddlefleetx_tpu.core import Engine
+    from paddlefleetx_tpu.data import build_dataloader
+    from paddlefleetx_tpu.models import build_module
+    from paddlefleetx_tpu.utils.config import AttrDict, process_configs
+
+    filelist = _write_imagen_corpus(tmp_path, n=32)
+    cfg = AttrDict({
+        "Global": AttrDict({"device": "cpu", "seed": 2022,
+                            "global_batch_size": None,
+                            "local_batch_size": 1,
+                            "micro_batch_size": 1}),
+        "Engine": AttrDict({
+            "max_steps": 4, "logging_freq": 2, "eval_freq": 1000,
+            "mix_precision": AttrDict({}),
+            "save_load": AttrDict({"save_steps": 1000,
+                                   "output_dir": str(tmp_path / "o")}),
+        }),
+        "Model": AttrDict({
+            "module": "ImagenModule",
+            "name": "imagen_397M_text2im_64",
+            "unet_number": 1,
+            "image_sizes": (16,),
+            "text_embed_dim": 32,
+            "timesteps": 8,
+            "unet_overrides": tuple(TINY_UNET.items()),
+        }),
+        "Loss": AttrDict({"name": "mse_loss", "p2_loss_weight_k": 1}),
+        "Distributed": AttrDict({"dp_degree": 8, "mp_degree": 1,
+                                 "pp_degree": 1,
+                                 "sharding": AttrDict({})}),
+        "Optimizer": AttrDict({
+            "name": "Adam",
+            "lr": AttrDict({"name": "CosineAnnealingWithWarmupDecay",
+                            "decay_steps": 100, "warmup_rate": 0.1,
+                            "max_lr": 1e-3, "min_lr": 1e-4}),
+            "grad_clip": AttrDict({"clip_norm": 1.0}),
+        }),
+        "Data": AttrDict({"Train": AttrDict({
+            "dataset": AttrDict({
+                "name": "ImagenDataset", "input_path": filelist,
+                "input_resolution": 16, "max_seq_len": 8}),
+            "sampler": AttrDict({"name": "DistributedBatchSampler",
+                                 "batch_size": 1, "shuffle": False,
+                                 "drop_last": True}),
+            "loader": AttrDict({"collate_fn": "imagen_collate_fn",
+                                "num_workers": 1}),
+        })}),
+    })
+    process_configs(cfg, nranks=8)
+    module = build_module(cfg)
+    engine = Engine(cfg, module, mode="train")
+    loader = build_dataloader(cfg.Data, "Train", num_replicas=1, rank=0)
+    loader.batch_sampler.batch_size = cfg.Global.global_batch_size
+
+    losses = []
+    orig = module.training_step_end
+
+    def capture(log):
+        losses.append(log["loss"])
+        orig(log)
+
+    module.training_step_end = capture
+    engine.fit(epoch=1, train_data_loader=loader)
+    assert len(losses) == 2
+    assert all(np.isfinite(x) for x in losses)
