@@ -1,0 +1,15 @@
+#!/bin/bash
+# Single-device fp32 smoke (reference N1C1/gpt_bs16_fp32_DP1-MP1-PP1.sh):
+# shrunken model, a few hundred steps, ips: + loss: parsed by the driver.
+cd "$(dirname "$0")/../../../../.."
+python benchmarks/run_benchmark.py \
+  --model_item gpt_bs16_fp32_DP1-MP1-PP1 \
+  --config configs/nlp/gpt/pretrain_gpt_345M_single_card.yaml \
+  --max_steps "${MAX_STEPS:-100}" \
+  --overrides \
+    Global.local_batch_size=16 Global.micro_batch_size=16 \
+    Model.num_layers=4 Model.hidden_size=1024 \
+    Engine.logging_freq=10 Engine.eval_freq=100000 \
+    "Data.Train.dataset.input_dir=${DATA_DIR:?set DATA_DIR}" \
+    "Data.Eval.dataset.input_dir=${DATA_DIR}" \
+  "$@"

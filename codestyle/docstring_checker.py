@@ -1,0 +1,116 @@
+"""Docstring style checker.
+
+Parity: reference ``codestyle/docstring_checker.py`` (a 349-line
+pylint plugin enforcing docstring presence/shape, with its own unit
+test — the reference's only unit-tested component, SURVEY §4). pylint
+isn't a dependency here, so this is a standalone ``ast``-based checker
+with the same rule set:
+
+  D001  module missing docstring
+  D002  public class missing docstring
+  D003  public function/method missing docstring (> ``max_lines``
+        lines; one-liners and private names are exempt)
+  D004  docstring does not start with a capital letter or quote
+  D005  one-line docstring should end with a period
+
+Run: ``python codestyle/docstring_checker.py <paths...>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import sys
+from typing import Iterator, List
+
+MAX_UNDOCUMENTED_LINES = 10
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str
+    line: int
+    code: str
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+def _is_public(name: str) -> bool:
+    return not name.startswith("_")
+
+
+def _doc_findings(node, doc, path) -> Iterator[Finding]:
+    if doc is None:
+        return
+    stripped = doc.strip()
+    if not stripped:
+        return
+    first = stripped[0]
+    if not (first.isupper() or first in "\"'`[(0123456789"):
+        yield Finding(path, node.lineno, "D004",
+                      "docstring should start with a capital letter")
+    if "\n" not in stripped and not stripped.endswith((".", "!", "?",
+                                                      ":", "`", ")")):
+        yield Finding(path, node.lineno, "D005",
+                      "one-line docstring should end with a period")
+
+
+def check_source(source: str, path: str = "<string>") -> List[Finding]:
+    tree = ast.parse(source)
+    findings: List[Finding] = []
+
+    mod_doc = ast.get_docstring(tree)
+    if mod_doc is None:
+        findings.append(Finding(path, 1, "D001",
+                                "module missing docstring"))
+    else:
+        findings.extend(_doc_findings(tree.body[0], mod_doc, path))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and _is_public(node.name):
+            doc = ast.get_docstring(node)
+            if doc is None:
+                findings.append(Finding(
+                    path, node.lineno, "D002",
+                    f"public class {node.name!r} missing docstring"))
+            else:
+                findings.extend(_doc_findings(node, doc, path))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and _is_public(node.name):
+            doc = ast.get_docstring(node)
+            n_lines = (node.end_lineno or node.lineno) - node.lineno
+            if doc is None and n_lines > MAX_UNDOCUMENTED_LINES:
+                findings.append(Finding(
+                    path, node.lineno, "D003",
+                    f"public function {node.name!r} missing docstring"))
+            elif doc is not None:
+                findings.extend(_doc_findings(node, doc, path))
+    return findings
+
+
+def check_file(path: str) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as f:
+        return check_source(f.read(), path)
+
+
+def main(argv=None) -> int:
+    import os
+    args = argv if argv is not None else sys.argv[1:]
+    findings: List[Finding] = []
+    for target in args:
+        if os.path.isdir(target):
+            for root, _dirs, files in os.walk(target):
+                findings.extend(
+                    f for name in sorted(files) if name.endswith(".py")
+                    for f in check_file(os.path.join(root, name)))
+        else:
+            findings.extend(check_file(target))
+    for f in findings:
+        print(f)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

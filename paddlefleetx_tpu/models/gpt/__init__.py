@@ -1,3 +1,4 @@
+"""gpt subpackage."""
 from .config import GPTConfig  # noqa: F401
 from .model import (  # noqa: F401
     GPTEmbeddings, GPTForPretraining, GPTModel, MultiHeadAttention,

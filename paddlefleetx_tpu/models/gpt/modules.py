@@ -27,7 +27,9 @@ class GPTModule(LanguageModule):
 
     def __init__(self, configs):
         from ..language_utils import process_configs
+        from ...ops.quantization import QuantizationConfig
         process_configs(configs)
+        self.qat_cfg = QuantizationConfig.from_config(configs)
         super().__init__(configs)
 
     #: ring attention handles the cp-sharded sequence axis
@@ -59,6 +61,10 @@ class GPTModule(LanguageModule):
         pp = (self.configs.get("Distributed") or {}).get("pp_degree", 1) \
             or 1
         if pp > 1:
+            if self.qat_cfg.enable:
+                raise ValueError("QAT is not supported with pipeline "
+                                 "parallelism (reference QAT recipe is "
+                                 "mp-only, pretrain_gpt_345M_mp8_qat)")
             from .model import pipelined_lm_loss
             # microbatch count = accumulate_steps (reference
             # ``utils/config.py:117``); eval batches that don't divide
@@ -70,9 +76,16 @@ class GPTModule(LanguageModule):
                 pp=pp, num_microbatches=m, rng=rng,
                 position_ids=position_ids, deterministic=deterministic)
         rngs = None if deterministic else {"dropout": rng}
-        logits = self.model.apply(
-            {"params": params}, tokens, position_ids=position_ids,
-            deterministic=deterministic, rngs=rngs)
+        if self.qat_cfg.enable:
+            from ...ops.quantization import qat_apply
+            logits = qat_apply(
+                self.model, self.qat_cfg, params, tokens,
+                position_ids=position_ids, deterministic=deterministic,
+                rngs=rngs)
+        else:
+            logits = self.model.apply(
+                {"params": params}, tokens, position_ids=position_ids,
+                deterministic=deterministic, rngs=rngs)
         return cross_entropy_loss(logits, labels, loss_mask)
 
     def input_spec(self):

@@ -1,1 +1,2 @@
+"""ops subpackage."""
 from .attention import dot_product_attention  # noqa: F401

@@ -1,0 +1,46 @@
+"""Environment/config sanity checks.
+
+Parity: reference ``utils/check.py`` (GPU-version and config checks);
+here the checks are TPU/JAX-shaped: device availability, topology vs
+device count, batch-size algebra.
+"""
+
+from __future__ import annotations
+
+from .log import logger
+
+
+def check_device(expected: str = None) -> str:
+    import jax
+    platform = jax.devices()[0].platform
+    if expected and expected not in ("gpu", platform):
+        # reference configs say "gpu"; on this stack that means
+        # "the accelerator" — only warn on real mismatches
+        logger.warning("config requests device %r but jax is running "
+                       "on %r", expected, platform)
+    return platform
+
+
+def check_config(config) -> None:
+    """Cross-field invariants the reference asserts during
+    ``process_configs`` (utils/config.py:54,95)."""
+    import jax
+    glob = config.get("Global", {})
+    dist = config.get("Distributed", {})
+    world = dist.get("world_size") or jax.device_count()
+    lbs = glob.get("local_batch_size")
+    mbs = glob.get("micro_batch_size")
+    if lbs and mbs and lbs % mbs != 0:
+        raise ValueError(
+            f"local_batch_size {lbs} not divisible by "
+            f"micro_batch_size {mbs}")
+    degrees = [dist.get("mp_degree") or 1, dist.get("pp_degree") or 1,
+               dist.get("cp_degree") or 1,
+               (dist.get("sharding") or {}).get("sharding_degree") or 1,
+               dist.get("dp_degree") or 1]
+    prod = 1
+    for d in degrees:
+        prod *= d
+    if prod != world:
+        raise ValueError(
+            f"topology product {prod} != world size {world}")

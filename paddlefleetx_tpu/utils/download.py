@@ -1,0 +1,64 @@
+"""Cached artifact resolution (vocab files, pretrained weights).
+
+Parity: reference ``utils/download.py`` — a retrying cached downloader
+where process rank 0 fetches while other ranks spin-wait on the cached
+file (:118+). This deployment is zero-egress: resolution covers the
+explicit path, the cache directory (``PFX_CACHE_HOME``, default
+``~/.cache/paddlefleetx_tpu``), and a same-process rank-0-writes /
+others-wait protocol for locally *produced* artifacts; an actual URL
+fetch raises with instructions instead of downloading.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from .log import logger
+
+CACHE_HOME = os.environ.get(
+    "PFX_CACHE_HOME",
+    os.path.join(os.path.expanduser("~"), ".cache", "paddlefleetx_tpu"))
+
+
+def cached_path(name_or_path: str,
+                cache_subdir: str = "") -> Optional[str]:
+    """Resolve ``name_or_path`` to a local file: as given, or under
+    the cache home. Returns None if absent."""
+    if os.path.exists(name_or_path):
+        return name_or_path
+    candidate = os.path.join(CACHE_HOME, cache_subdir,
+                             os.path.basename(name_or_path))
+    return candidate if os.path.exists(candidate) else None
+
+
+def get_weights_path_from_url(url: str, md5sum: Optional[str] = None
+                              ) -> str:
+    """Reference API surface; zero-egress deployments must pre-stage
+    the file into the cache."""
+    cached = cached_path(os.path.basename(url), "weights")
+    if cached:
+        return cached
+    raise FileNotFoundError(
+        f"{os.path.basename(url)} not found under {CACHE_HOME}/weights "
+        f"and downloading is disabled (zero egress). Pre-stage the "
+        f"file there (source: {url}).")
+
+
+def wait_for_file(path: str, producer_rank: bool,
+                  produce_fn=None, timeout: float = 3600.0) -> str:
+    """Rank-0-produces / others-spin-wait (reference ``download.py``
+    main-process gate; also the dataset index-build protocol,
+    ``gpt_dataset.py:47-69``)."""
+    if producer_rank:
+        if not os.path.exists(path) and produce_fn is not None:
+            produce_fn()
+        return path
+    t0 = time.time()
+    while not os.path.exists(path):
+        if time.time() - t0 > timeout:
+            raise TimeoutError(f"timed out waiting for {path}")
+        time.sleep(1)
+    logger.debug("found %s after waiting", path)
+    return path

@@ -1,0 +1,10 @@
+"""Version info (reference ``utils/version.py``)."""
+
+__version__ = "0.1.0"
+
+
+def show() -> str:
+    import jax
+    line = (f"paddlefleetx_tpu {__version__} | jax {jax.__version__} | "
+            f"backend {jax.default_backend()}")
+    return line

@@ -1,0 +1,3 @@
+#!/bin/bash
+# pretrain_ernie_base (reference projects layout)
+python ./tools/train.py -c ./configs/nlp/ernie/pretrain_ernie_base.yaml "$@"

@@ -1,0 +1,3 @@
+#!/bin/bash
+# evaluate_gpt_345M_single_card (reference projects layout)
+python ./tools/eval.py -c ./configs/nlp/gpt/eval_gpt_345M_single_card.yaml "$@"

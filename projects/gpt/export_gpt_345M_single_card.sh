@@ -1,0 +1,3 @@
+#!/bin/bash
+# export_gpt_345M_single_card (reference projects layout)
+python ./tools/export.py -c ./configs/nlp/gpt/generation_gpt_345M_single_card.yaml "$@"

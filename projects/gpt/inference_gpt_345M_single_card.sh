@@ -1,0 +1,3 @@
+#!/bin/bash
+# inference_gpt_345M_single_card (reference projects layout)
+python ./tasks/gpt/inference.py -c ./configs/nlp/gpt/generation_gpt_345M_single_card.yaml "$@"

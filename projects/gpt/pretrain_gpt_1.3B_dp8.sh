@@ -1,0 +1,3 @@
+#!/bin/bash
+# pretrain_gpt_1.3B_dp8 (reference projects layout)
+python ./tools/train.py -c ./configs/nlp/gpt/pretrain_gpt_1.3B_dp8.yaml "$@"

@@ -1,0 +1,3 @@
+#!/bin/bash
+# pretrain_gpt_175B_mp8_pp16 (reference projects layout)
+python ./tools/train.py -c ./configs/nlp/gpt/pretrain_gpt_175B_mp8_pp16.yaml "$@"

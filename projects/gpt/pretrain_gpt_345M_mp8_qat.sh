@@ -1,0 +1,3 @@
+#!/bin/bash
+# pretrain_gpt_345M_mp8_qat (reference projects layout)
+python ./tools/train.py -c ./configs/nlp/gpt/pretrain_gpt_345M_mp8_qat.yaml "$@"

@@ -1,0 +1,3 @@
+#!/bin/bash
+# pretrain_gpt_345M_single_card (reference projects layout)
+python ./tools/train.py -c ./configs/nlp/gpt/pretrain_gpt_345M_single_card.yaml "$@"

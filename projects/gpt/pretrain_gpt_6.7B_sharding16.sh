@@ -1,0 +1,3 @@
+#!/bin/bash
+# pretrain_gpt_6.7B_sharding16 (reference projects layout)
+python ./tools/train.py -c ./configs/nlp/gpt/pretrain_gpt_6.7B_sharding16.yaml "$@"

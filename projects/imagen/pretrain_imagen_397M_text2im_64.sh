@@ -1,0 +1,3 @@
+#!/bin/bash
+# pretrain_imagen_397M_text2im_64 (reference projects layout)
+python ./tools/train.py -c ./configs/mm/imagen/imagen_397M_text2im_64.yaml "$@"

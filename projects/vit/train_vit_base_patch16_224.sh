@@ -1,0 +1,3 @@
+#!/bin/bash
+# train_vit_base_patch16_224 (reference projects layout)
+python ./tools/train.py -c ./configs/vis/vit/ViT_base_patch16_224_pt_in1k_2n16c_dp_fp16o2.yaml "$@"

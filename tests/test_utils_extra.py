@@ -1,0 +1,109 @@
+"""download/check/version utils + benchmark driver parsing."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+
+def test_cached_path(tmp_path, monkeypatch):
+    from paddlefleetx_tpu.utils import download
+    f = tmp_path / "x.bin"
+    f.write_text("hi")
+    assert download.cached_path(str(f)) == str(f)
+    monkeypatch.setattr(download, "CACHE_HOME", str(tmp_path))
+    sub = tmp_path / "weights"
+    sub.mkdir()
+    (sub / "w.bin").write_text("w")
+    assert download.cached_path("http://host/w.bin", "weights") == \
+        str(sub / "w.bin")
+    assert download.cached_path("missing.bin") is None
+    with pytest.raises(FileNotFoundError):
+        download.get_weights_path_from_url("http://host/nope.bin")
+
+
+def test_wait_for_file(tmp_path):
+    from paddlefleetx_tpu.utils.download import wait_for_file
+    path = tmp_path / "artifact"
+
+    def produce():
+        path.write_text("done")
+
+    # producer writes
+    assert wait_for_file(str(path), True, produce) == str(path)
+    os.remove(path)
+
+    # waiter sees the file once the producer thread lands it
+    t = threading.Thread(
+        target=lambda: (time.sleep(0.2), path.write_text("ok")))
+    t.start()
+    assert wait_for_file(str(path), False, timeout=10) == str(path)
+    t.join()
+
+
+def test_check_config():
+    from paddlefleetx_tpu.utils.check import check_config
+    check_config({"Global": {"local_batch_size": 8,
+                             "micro_batch_size": 4},
+                  "Distributed": {"dp_degree": 8, "world_size": 8}})
+    with pytest.raises(ValueError):
+        check_config({"Global": {"local_batch_size": 8,
+                                 "micro_batch_size": 3},
+                      "Distributed": {"world_size": 8}})
+    with pytest.raises(ValueError):
+        check_config({"Global": {},
+                      "Distributed": {"dp_degree": 2,
+                                      "world_size": 8}})
+
+
+def test_version_line():
+    from paddlefleetx_tpu.utils.version import show
+    assert "paddlefleetx_tpu" in show()
+
+
+def test_benchmark_driver_end_to_end(tmp_path):
+    """The TIPC driver runs a tiny topology on the CPU mesh and parses
+    ips/loss from the logs."""
+    import subprocess
+    import sys
+    sys.path.insert(0, "tests")
+    from test_data import make_corpus
+    make_corpus(tmp_path, n_docs=60, doc_len_range=(20, 60), vocab=128,
+                eos=127)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cmd = [sys.executable, os.path.join(repo, "benchmarks",
+                                        "run_benchmark.py"),
+           "--config", "configs/nlp/gpt/pretrain_gpt_base.yaml",
+           "--max_steps", "6", "--cpu-devices", "8",
+           "--model_item", "tipc_smoke",
+           "--overrides",
+           "Global.device=cpu", "Global.local_batch_size=4",
+           "Global.micro_batch_size=4",
+           "Model.vocab_size=128", "Model.hidden_size=32",
+           "Model.num_layers=2", "Model.num_attention_heads=4",
+           "Model.ffn_hidden_size=64",
+           "Model.max_position_embeddings=64",
+           "Model.hidden_dropout_prob=0.0",
+           "Model.attention_probs_dropout_prob=0.0",
+           "Distributed.dp_degree=4", "Distributed.mp_degree=2",
+           "Engine.logging_freq=2", "Engine.eval_freq=1000",
+           f"Engine.save_load.output_dir={tmp_path}/out",
+           f"Data.Train.dataset.input_dir={tmp_path}",
+           "Data.Train.dataset.split=[80,20,0]",
+           "Data.Train.dataset.max_seq_len=32",
+           "Data.Train.dataset.eos_id=127",
+           f"Data.Eval.dataset.input_dir={tmp_path}",
+           "Data.Eval.dataset.split=[80,20,0]",
+           "Data.Eval.dataset.max_seq_len=32",
+           "Data.Eval.dataset.eos_id=127"]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS",)}
+    proc = subprocess.run(cmd, capture_output=True, text=True, cwd=repo,
+                          env=env, timeout=420)
+    out = proc.stdout.strip().splitlines()[-1]
+    result = json.loads(out)
+    assert result["ok"], result
+    assert result["ips"] > 0
+    assert result["last_loss"] is not None

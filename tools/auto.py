@@ -1,0 +1,18 @@
+"""Auto-parallel training entry point.
+
+Parity: reference ``tools/auto.py:37-60`` drives Paddle's semi-auto
+engine (annotate-then-partition). On TPU, GSPMD *is* that engine —
+one unified code path serves both the reference's eager-hybrid and
+auto configs (SURVEY §7 design stance) — so this entry point runs the
+same trainer; ``GPTModuleAuto`` configs resolve to the same module.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if __name__ == "__main__":
+    import runpy
+    runpy.run_path(os.path.join(os.path.dirname(__file__), "train.py"),
+                   run_name="__main__")

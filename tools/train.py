@@ -12,6 +12,13 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+if os.environ.get("PFX_CPU_DEVICES"):
+    # virtual CPU mesh for podless topology runs (site customization
+    # may force another platform before env vars are read, so this
+    # goes through jax.config, not the environment)
+    from paddlefleetx_tpu.parallel.mesh import cpu_mesh_env
+    cpu_mesh_env(int(os.environ["PFX_CPU_DEVICES"]))
+
 import jax  # noqa: E402
 
 from paddlefleetx_tpu.core import Engine  # noqa: E402
