@@ -77,9 +77,20 @@ class Engine(BasicEngine):
         self.max_steps = raw_max_steps \
             if raw_max_steps and raw_max_steps > 0 else sys.maxsize
         self.logging_freq = eng.get("logging_freq", 1)
+        # 'step' gates mid-epoch eval on step % eval_freq; 'epoch'
+        # evaluates at epoch end on epoch % eval_freq (reference
+        # eager_engine.py:296-372)
+        self.run_mode = eng.get("run_mode", "step")
         self.eval_freq = eng.get("eval_freq", sys.maxsize)
-        self.eval_iters = eng.get("eval_iters", 10)
-        self.test_iters = eng.get("test_iters", self.eval_iters * 10)
+        # eval_iters <= 0 means "walk the whole loader" (the vis
+        # configs set -1 for full-validation epochs)
+        eval_iters = eng.get("eval_iters", 10)
+        self.eval_iters = eval_iters if eval_iters and eval_iters > 0 \
+            else None
+        test_iters = eng.get("test_iters",
+                             eval_iters * 10 if eval_iters else 0)
+        self.test_iters = test_iters if test_iters and test_iters > 0 \
+            else sys.maxsize
         self.accumulate_steps = eng.get("accumulate_steps", 1) or 1
         save_load = eng.get("save_load", {})
         self.save_steps = save_load.get("save_steps", sys.maxsize)
@@ -369,6 +380,12 @@ class Engine(BasicEngine):
                                   valid_data_loader)
             self.module.training_epoch_end(
                 {"epoch": ep, "train_cost": time.time() - t0})
+            if self.run_mode == "epoch" and \
+                    (ep + 1) % self.eval_freq == 0 and \
+                    valid_data_loader is not None:
+                with self.mesh, nn.logical_axis_rules(self.rules):
+                    self._evaluate_impl(ep, valid_data_loader,
+                                        max_iters=self.eval_iters)
             if (ep + 1) % self.save_epoch == 0 and \
                     int(self.state["step"]) % self.save_steps != 0:
                 self.save(ep + 1)
@@ -411,7 +428,8 @@ class Engine(BasicEngine):
                         "train_cost": cost,
                     })
                     step_start = time.time()
-                if step % self.eval_freq == 0 and \
+                if self.run_mode == "step" and \
+                        step % self.eval_freq == 0 and \
                         valid_data_loader is not None:
                     self._evaluate_impl(epoch, valid_data_loader,
                                         max_iters=self.eval_iters)

@@ -209,18 +209,15 @@ class ErnieModel(nn.Module):
                  attention_mask=None, task_type_ids=None,
                  deterministic: bool = True):
         cfg = self.config
-        if attention_mask is None and cfg.use_flash_attention:
-            # Flash path: treat the batch as unpadded (true for
-            # GPTDataset pretraining streams — a pad-derived mask there
-            # would also mis-mask legitimate id-0 tokens). Pass an
-            # explicit attention_mask to mask pads; that falls back to
-            # the XLA attention path.
+        if attention_mask is None:
+            # No mask: treat the batch as unpadded, on BOTH attention
+            # paths. On pretraining streams token id 0 is a legitimate
+            # vocab token, so inferring the mask from pad_token_id
+            # (what the reference does) silently drops those positions
+            # — and would make flash vs XLA attention disagree on the
+            # same batch. Padded batches must pass an explicit mask.
             bias = None
         else:
-            if attention_mask is None:
-                # reference: mask pad positions
-                attention_mask = (input_ids != cfg.pad_token_id).astype(
-                    jnp.int32)
             bias = attention_mask_bias(attention_mask,
                                        jnp.dtype(cfg.dtype))
         x = ErnieEmbeddings(cfg, name="embeddings")(

@@ -80,6 +80,8 @@ class GPTModule(LanguageModule):
             from ...ops.quantization import qat_apply
             logits = qat_apply(
                 self.model, self.qat_cfg, params, tokens,
+                stacked_module="decoder"
+                if self.model_config.scan_layers else None,
                 position_ids=position_ids, deterministic=deterministic,
                 rngs=rngs)
         else:

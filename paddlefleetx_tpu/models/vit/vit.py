@@ -285,7 +285,11 @@ def _zoo(**kw) -> Any:
     return build
 
 
-# reference zoo (vit.py:261-434), same names the YAMLs use
+# reference zoo, mirrored builder-for-builder (vit.py:261-434): the
+# 224-res variants carry a representation head sized to embed_dim,
+# the 384-res transfer variants drop it; base/large/g/G/6B use
+# epsilon=1e-6 + qkv_bias while huge keeps the class defaults.
+# tiny/small are repo extras (timm-standard shapes) for cheap tests.
 VISION_MODELS = {
     "ViT": lambda **kw: ViT(ViTConfig(**kw)),
     "ViT_tiny_patch16_224": _zoo(patch_size=16, embed_dim=192, depth=12,
@@ -293,35 +297,48 @@ VISION_MODELS = {
     "ViT_small_patch16_224": _zoo(patch_size=16, embed_dim=384, depth=12,
                                   num_heads=6),
     "ViT_base_patch16_224": _zoo(patch_size=16, embed_dim=768, depth=12,
-                                 num_heads=12, qkv_bias=True),
+                                 num_heads=12, qkv_bias=True,
+                                 epsilon=1e-6, representation_size=768),
     "ViT_base_patch16_384": _zoo(img_size=384, patch_size=16,
                                  embed_dim=768, depth=12, num_heads=12,
-                                 qkv_bias=True),
+                                 qkv_bias=True, epsilon=1e-6),
     "ViT_base_patch32_224": _zoo(patch_size=32, embed_dim=768, depth=12,
-                                 num_heads=12, qkv_bias=True),
+                                 num_heads=12, qkv_bias=True,
+                                 epsilon=1e-6, representation_size=768),
     "ViT_base_patch32_384": _zoo(img_size=384, patch_size=32,
                                  embed_dim=768, depth=12, num_heads=12,
-                                 qkv_bias=True),
+                                 qkv_bias=True, epsilon=1e-6),
     "ViT_large_patch16_224": _zoo(patch_size=16, embed_dim=1024,
-                                  depth=24, num_heads=16, qkv_bias=True),
+                                  depth=24, num_heads=16, qkv_bias=True,
+                                  epsilon=1e-6,
+                                  representation_size=1024),
     "ViT_large_patch16_384": _zoo(img_size=384, patch_size=16,
                                   embed_dim=1024, depth=24, num_heads=16,
-                                  qkv_bias=True),
+                                  qkv_bias=True, epsilon=1e-6),
     "ViT_large_patch32_224": _zoo(patch_size=32, embed_dim=1024,
-                                  depth=24, num_heads=16, qkv_bias=True),
+                                  depth=24, num_heads=16, qkv_bias=True,
+                                  epsilon=1e-6,
+                                  representation_size=1024),
     "ViT_large_patch32_384": _zoo(img_size=384, patch_size=32,
                                   embed_dim=1024, depth=24, num_heads=16,
-                                  qkv_bias=True),
+                                  qkv_bias=True, epsilon=1e-6),
     "ViT_huge_patch14_224": _zoo(patch_size=14, embed_dim=1280,
-                                 depth=32, num_heads=16),
+                                 depth=32, num_heads=16,
+                                 representation_size=1280),
     "ViT_huge_patch14_384": _zoo(img_size=384, patch_size=14,
                                  embed_dim=1280, depth=32, num_heads=16),
     "ViT_g_patch14_224": _zoo(patch_size=14, embed_dim=1408, depth=40,
-                              num_heads=16, mlp_ratio=4864 / 1408),
+                              num_heads=16, mlp_ratio=4.364,
+                              qkv_bias=True, epsilon=1e-6,
+                              representation_size=1408),
     "ViT_G_patch14_224": _zoo(patch_size=14, embed_dim=1664, depth=48,
-                              num_heads=16, mlp_ratio=8192 / 1664),
+                              num_heads=16, mlp_ratio=4.9231,
+                              qkv_bias=True, epsilon=1e-6,
+                              representation_size=1664),
     "ViT_6B_patch14_224": _zoo(patch_size=14, embed_dim=2320, depth=80,
-                               num_heads=16),
+                               num_heads=16, mlp_ratio=4.955,
+                               qkv_bias=True, epsilon=1e-6,
+                               representation_size=2320),
 }
 
 

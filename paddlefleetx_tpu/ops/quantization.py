@@ -44,26 +44,44 @@ class QuantizationConfig:
         return cls(**{k: v for k, v in section.items() if k in fields})
 
 
-def fake_quant(x: jax.Array, bits: int = 8) -> jax.Array:
-    """Symmetric per-tensor abs-max fake quantization with a
-    straight-through gradient."""
+def fake_quant(x: jax.Array, bits: int = 8,
+               layer_axis: int | None = None) -> jax.Array:
+    """Symmetric abs-max fake quantization with a straight-through
+    gradient. Per-tensor scale by default; with ``layer_axis`` the
+    scale is computed independently along that axis (one scale per
+    scan-stacked layer)."""
     qmax = 2.0 ** (bits - 1) - 1
-    scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    if layer_axis is None:
+        scale = jnp.max(jnp.abs(x))
+    else:
+        red = tuple(i for i in range(x.ndim) if i != layer_axis)
+        scale = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    scale = jnp.maximum(scale, 1e-8)
     q = jnp.round(x / scale * qmax)
     q = jnp.clip(q, -qmax, qmax) * (scale / qmax)
     # STE: forward sees q, backward sees identity
     return x + jax.lax.stop_gradient(q - x)
 
 
-def quantize_params(params, bits: int = 8):
+def quantize_params(params, bits: int = 8,
+                    stacked_module: str | None = None):
     """Fake-quantize every dense/conv kernel leaf (path ends in
     'kernel'); biases, norms, and embeddings stay full precision —
     mirroring the reference's quantizable_layer_type list (Linear and
-    its parallel variants)."""
+    its parallel variants).
+
+    ``stacked_module`` names the scan-over-layers module ("decoder" /
+    "encoder"): its kernels carry a leading ``[num_layers, ...]`` axis
+    and get one scale per layer, matching the reference where
+    paddleslim quantizes each Linear independently — a single
+    per-tensor scale across 24 stacked layers would starve
+    small-magnitude layers of resolution."""
     def maybe_q(path, leaf):
         names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
         if names and names[-1] == "kernel":
-            return fake_quant(leaf, bits)
+            axis = 0 if stacked_module is not None \
+                and stacked_module in names else None
+            return fake_quant(leaf, bits, layer_axis=axis)
         return leaf
 
     return jax.tree_util.tree_map_with_path(maybe_q, params)
@@ -83,10 +101,12 @@ def activation_quant_interceptor(bits: int = 8):
 
 
 def qat_apply(model: nn.Module, cfg: QuantizationConfig, params,
-              *args, **kwargs) -> Any:
+              *args, stacked_module: str | None = None,
+              **kwargs) -> Any:
     """``model.apply`` with QAT: weight kernels fake-quantized, dense
     inputs fake-quantized."""
-    qparams = quantize_params(params, cfg.weight_bits)
+    qparams = quantize_params(params, cfg.weight_bits,
+                              stacked_module=stacked_module)
     with nn.intercept_methods(
             activation_quant_interceptor(cfg.activation_bits)):
         return model.apply({"params": qparams}, *args, **kwargs)

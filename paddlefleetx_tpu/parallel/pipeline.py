@@ -1,48 +1,59 @@
-"""Pipeline parallelism: an SPMD microbatch pipeline over the ``pp`` axis.
+"""Pipeline parallelism: SPMD microbatch pipelines over the ``pp`` axis.
 
 The reference's PP stack is bespoke machinery inside Paddle —
 ``PipelineLayer`` flattens the model into ``LayerDesc`` lists
 (reference ``hybrid_model.py:895-961``), a 1F1B scheduler drives
 ``train_batch`` with NCCL P2P send/recv between stage ranks
-(``eager_engine.py:406-415``), and shared embeddings are tied across
-first/last stages via ``SharedLayerDesc``.
+(``eager_engine.py:406-415``), interleaved stages come from
+``virtual_pp_degree`` chunk assignment (``hybrid_model.py:962``,
+validation ``models/language_model/utils.py:76-100``), and shared
+embeddings are tied across first/last stages via ``SharedLayerDesc``.
 
 TPU-native design: none of that machinery is rank-local here. The
 whole pipeline is ONE jitted SPMD program:
 
   - layer parameters stay in the same stacked ``[L, ...]`` layout the
     scan-over-layers model already uses, sharded over ``pp`` on the
-    leading axis (stage s owns layers ``[s*L/S, (s+1)*L/S)``), so
-    checkpoints are topology-portable — unlike the reference's
-    per-rank ``pdparams`` dirs;
-  - a ``[S, microbatch, ...]`` stage buffer is sharded over ``pp``;
-    each pipeline tick runs every stage's local layers in parallel
-    (a ``vmap`` over stages of a ``lax.scan`` over the stage's
-    layers) and advances the buffer with ``jnp.roll``, which GSPMD
-    lowers to a collective-permute between ICI neighbors — the NCCL
-    P2P of the reference;
-  - the GPipe fill/drain schedule is a ``lax.scan`` over
-    ``M + S - 1`` ticks; microbatch gradient accumulation falls out
-    of ``jax.grad`` through that scan (the backward pass pipelines in
-    reverse automatically, where the reference needed a hand-written
-    1F1B backward);
+    leading axis, so checkpoints are topology-portable — unlike the
+    reference's per-rank ``pdparams`` dirs. With ``virtual_pp_degree
+    = vpp > 1`` the reshape to ``[vpp, S, L/(S*vpp), ...]`` (sharded
+    over ``pp`` on axis 1) gives physical stage ``s`` the
+    non-contiguous layer chunks ``{s, S+s, 2S+s, ...}`` — exactly the
+    reference's interleaved assignment;
+  - a ``[vpp, S, microbatch, ...]`` slot buffer is sharded over
+    ``pp``; each pipeline tick runs every virtual stage's local
+    layers in parallel (a ``vmap`` over slots of a ``lax.scan`` over
+    the slot's layers) and advances the buffer with a roll along the
+    virtual-stage order, which GSPMD lowers to a collective-permute
+    between ICI neighbors — the NCCL P2P of the reference;
+  - two schedules are provided. ``pipeline_forward`` is the
+    forward-only GPipe fill/drain (``M + S*vpp - 1`` ticks); taking
+    ``jax.grad`` through it yields a GPipe-memory-profile backward.
+    ``pipeline_value_and_grad`` is an explicit 1F1B: each tick runs
+    one forward slot-wave and one backward slot-wave (per-slot
+    ``jax.vjp`` with recompute, the reference 1F1B's memory story),
+    so the activation stash holds at most ``2*S*vpp`` microbatch
+    activations per slot-ring instead of all ``M`` — peak activation
+    memory is bounded by pipeline depth, not microbatch count;
   - embeddings and the LM head are compute-replicated over ``pp``
     (their FLOPs are negligible next to the decoder stack), which
     makes the reference's ``SharedLayerDesc`` embedding tying
     (``hybrid_model.py:934-945``) trivial: there is only one
     embedding table, visible to both ends of the pipeline.
 
-Schedule note: this is GPipe (bubble fraction ``(S-1)/(M+S-1)``).
-The reference's default is 1F1B, which has the same bubble but lower
-peak activation memory; under XLA the remat policy covers most of
-that difference. Interleaved/virtual stages (``virtual_pp_degree``)
-map to a circular schedule and are validated but not yet scheduled
-differently.
+Schedule timing (K = S*vpp virtual stages): forward of microbatch
+``m`` at virtual stage ``k`` happens at tick ``m + k``; its loss (and
+output cotangent) at tick ``m + K - 1``; its backward at stage ``k``
+at tick ``m + 2K - 1 - k``. An activation stashed at the forward tick
+is consumed ``2(K - 1 - k) + 1 < 2K`` ticks later, so a depth-``2K``
+ring buffer never collides. The 1F1B bubble is the same ``(K-1)``-tick
+fill/drain as GPipe's; the win is memory (the reference's motivation
+for defaulting to 1F1B).
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -59,6 +70,62 @@ def _constrain(x, spec: P):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
+def _slot_params(stacked_params: Any, S: int, vpp: int) -> Tuple[Any, int]:
+    """``[L, ...]`` stacked params -> ``[vpp, S, L/(S*vpp), ...]``
+    sharded over ``pp`` on the physical-stage axis. Virtual stage
+    ``k = v*S + s`` owns the contiguous layer block ``[k*Lc, (k+1)*Lc)``
+    — i.e. physical stage ``s`` owns interleaved chunks."""
+    leaves = jax.tree.leaves(stacked_params)
+    if not leaves:
+        raise ValueError("stacked_params has no leaves")
+    L = leaves[0].shape[0]
+    K = S * vpp
+    if L % K != 0:
+        raise ValueError(
+            f"num_layers {L} not divisible by pp*vpp {K}")
+    Lc = L // K
+    slotted = jax.tree.map(
+        lambda p: _constrain(p.reshape(vpp, S, Lc, *p.shape[1:]),
+                             P(None, PP_AXIS)), stacked_params)
+    return slotted, Lc
+
+
+def _advance(processed: jax.Array, vpp: int) -> jax.Array:
+    """Forward roll along the virtual-stage order: slot k's output
+    becomes slot k+1's next input. The s-axis roll is the inter-stage
+    collective-permute; chunk wrap (s=S-1 -> next chunk's s=0) moves
+    within the same device ring."""
+    nxt = jnp.roll(processed, 1, axis=1)
+    if vpp > 1:
+        wrapped = jnp.roll(processed[:, -1], 1, axis=0)
+        nxt = nxt.at[:, 0].set(wrapped)
+    return nxt
+
+
+def _retreat(b_out: jax.Array, dy_prev: jax.Array, vpp: int) -> jax.Array:
+    """Backward roll: slot k's next cotangent is slot k+1's backward
+    output; the last virtual stage ingests the loss cotangent."""
+    g = jnp.roll(b_out, -1, axis=1)
+    if vpp > 1:
+        wrapped = jnp.roll(b_out[:, 0], -1, axis=0)
+        g = g.at[:, -1].set(wrapped)
+    return g.at[-1, -1].set(dy_prev)
+
+
+def _slot_keys(base_rng: jax.Array, m_arr: jax.Array,
+               K: int) -> jax.Array:
+    """Per-slot dropout keys folded by (microbatch, virtual stage) so
+    a 1F1B backward recompute reproduces the forward's masks exactly
+    (tick-based folding would not: F and B of the same microbatch
+    happen at different ticks)."""
+    k_arr = jnp.arange(K)
+
+    def key_for(m, k):
+        return jax.random.fold_in(jax.random.fold_in(base_rng, m), k)
+
+    return jax.vmap(key_for)(m_arr, k_arr)
+
+
 def pipeline_forward(
     layer_apply: Callable[[Any, jax.Array, jax.Array], jax.Array],
     stacked_params: Any,
@@ -66,24 +133,27 @@ def pipeline_forward(
     *,
     pp: int,
     num_microbatches: int,
+    vpp: int = 1,
     out_fn: Optional[Callable[[Any, jax.Array, Any], Any]] = None,
     out_init: Any = None,
     extras: Any = None,
     rng: Optional[jax.Array] = None,
 ) -> Any:
-    """Run ``x`` through ``L`` stacked layers with a ``pp``-stage
-    microbatch pipeline.
+    """Run ``x`` through ``L`` stacked layers with a GPipe-scheduled
+    ``pp``-stage (optionally ``vpp``-way interleaved) pipeline.
 
     Args:
       layer_apply: ``(layer_params, h, rng_key) -> h`` — one decoder
         layer as a pure function (wrap with ``jax.checkpoint`` for
         recompute before passing).
       stacked_params: pytree whose leaves have leading dim ``L``
-        (``nn.scan`` layout), ``L % pp == 0``.
+        (``nn.scan`` layout), ``L % (pp * vpp) == 0``.
       x: ``[B, ...]`` input activations, ``B % num_microbatches == 0``.
-      pp: number of pipeline stages (== mesh ``pp`` axis size).
+      pp: number of physical pipeline stages (mesh ``pp`` axis size).
       num_microbatches: M; the reference's ``accumulate_steps``
         (``utils/config.py:117``).
+      vpp: interleaved virtual stages per physical stage (the
+        reference's ``virtual_pp_degree``).
       out_fn: optional per-microbatch reducer ``(acc, y_mb, extras_mb)
         -> acc`` applied to the last stage's output (e.g. LM head +
         loss). When given, the full ``[B, ...]`` output is never
@@ -92,69 +162,64 @@ def pipeline_forward(
       out_init: initial reducer carry (required with ``out_fn``).
       extras: pytree of ``[B, ...]`` arrays sliced per-microbatch and
         fed to ``out_fn`` (labels, loss masks).
-      rng: base dropout key; folded per (tick, stage, layer).
+      rng: base dropout key; folded per (microbatch, virtual stage,
+        layer).
 
     Returns the reducer carry, or the ``[B, ...]`` outputs when
     ``out_fn`` is None.
     """
     S, M = pp, num_microbatches
-    leaves = jax.tree.leaves(stacked_params)
-    if not leaves:
-        raise ValueError("stacked_params has no leaves")
-    L = leaves[0].shape[0]
-    if L % S != 0:
-        raise ValueError(f"num_layers {L} not divisible by pp {S}")
-    Ls = L // S
+    K = S * vpp
     B = x.shape[0]
     if B % M != 0:
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    slot_params, Lc = _slot_params(stacked_params, S, vpp)
 
     x_mb = x.reshape(M, B // M, *x.shape[1:])
     x_mb = _constrain(x_mb, P(None, DATA_AXES))
-    stage_params = jax.tree.map(
-        lambda p: _constrain(p.reshape(S, Ls, *p.shape[1:]),
-                             P(PP_AXIS)), stacked_params)
     extras_mb = None
     if extras is not None:
         extras_mb = jax.tree.map(
             lambda e: e.reshape(M, B // M, *e.shape[1:]), extras)
 
-    state0 = _constrain(jnp.zeros((S,) + x_mb.shape[1:], x.dtype),
-                        P(PP_AXIS, DATA_AXES))
+    state0 = _constrain(
+        jnp.zeros((vpp, S) + x_mb.shape[1:], x.dtype),
+        P(None, PP_AXIS, DATA_AXES))
     collect = out_fn is None
     acc0 = jnp.zeros_like(x_mb) if collect else out_init
     base_rng = rng if rng is not None else jax.random.key(0)
 
+    def stage_fn(sp, h, key):
+        def body(h, xs):
+            lp, k = xs
+            return layer_apply(lp, h, k), None
+        h, _ = jax.lax.scan(body, h, (sp, jax.random.split(key, Lc)))
+        return h
+
+    slot_stage = jax.vmap(jax.vmap(stage_fn))
+
     def tick(carry, t):
         state, acc = carry
-        # stage 0 ingests microbatch t (clamped past the fill phase —
-        # the drain ticks feed it a stale microbatch whose output is
-        # never collected)
+        # virtual stage 0 ingests microbatch t (clamped past the fill
+        # phase — drain ticks feed it a stale microbatch whose output
+        # is never collected)
         inp = jax.lax.dynamic_index_in_dim(
             x_mb, jnp.minimum(t, M - 1), 0, keepdims=False)
-        state = _constrain(state.at[0].set(inp), P(PP_AXIS, DATA_AXES))
+        state = _constrain(state.at[0, 0].set(inp),
+                           P(None, PP_AXIS, DATA_AXES))
 
-        tick_rng = jax.random.fold_in(base_rng, t)
-        stage_rngs = jax.vmap(
-            lambda i: jax.random.fold_in(tick_rng, i))(jnp.arange(S))
+        m_arr = jnp.clip(t - jnp.arange(K), 0, M - 1)
+        keys = _slot_keys(base_rng, m_arr, K).reshape(vpp, S)
+        processed = slot_stage(slot_params, state, keys)
+        processed = _constrain(processed, P(None, PP_AXIS, DATA_AXES))
 
-        def stage_fn(sp, h, key):
-            def body(h, xs):
-                lp, k = xs
-                return layer_apply(lp, h, k), None
-            h, _ = jax.lax.scan(body, h, (sp, jax.random.split(key, Ls)))
-            return h
-
-        processed = jax.vmap(stage_fn)(stage_params, state, stage_rngs)
-        processed = _constrain(processed, P(PP_AXIS, DATA_AXES))
-
-        # collect the last stage's output for microbatch t-(S-1); ticks
-        # before the pipeline is full carry warmup garbage — the cond
-        # skips the collection (and the reducer's head/loss FLOPs)
-        # entirely on those ticks
-        y = processed[-1]
-        idx = jnp.clip(t - (S - 1), 0, M - 1)
-        valid = t >= S - 1
+        # collect the last virtual stage's output for microbatch
+        # t-(K-1); ticks before the pipeline is full carry warmup
+        # garbage — the cond skips the collection (and the reducer's
+        # head/loss FLOPs) entirely on those ticks
+        y = processed[-1, -1]
+        idx = jnp.clip(t - (K - 1), 0, M - 1)
+        valid = t >= K - 1
         if collect:
             acc = jax.lax.cond(
                 valid,
@@ -171,14 +236,193 @@ def pipeline_forward(
                 return out_fn(a, y, ex)
             acc = jax.lax.cond(valid, reduce, lambda a: a, acc)
 
-        # advance the pipeline: stage s+1's next input is stage s's
-        # output — GSPMD lowers this roll over the pp-sharded axis to
-        # a collective-permute (the reference's NCCL P2P send/recv)
-        state = jnp.roll(processed, 1, axis=0)
+        state = _advance(processed, vpp)
         return (state, acc), None
 
     (_, acc), _ = jax.lax.scan(tick, (state0, acc0),
-                               jnp.arange(M + S - 1))
+                               jnp.arange(M + K - 1))
     if collect:
         return acc.reshape(B, *x.shape[1:])
     return acc
+
+
+def pipeline_value_and_grad(
+    layer_apply: Callable[[Any, jax.Array, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    *,
+    pp: int,
+    num_microbatches: int,
+    vpp: int = 1,
+    loss_and_grad: Callable[[jax.Array, Any],
+                            Tuple[jax.Array, jax.Array, Any]],
+    extras: Any = None,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, Any, Any, jax.Array]:
+    """Explicit 1F1B schedule: loss AND gradients in one pass.
+
+    Unlike ``jax.grad(pipeline_forward)`` — which structurally runs
+    all forwards before any backward and therefore stashes every
+    microbatch's activations (the GPipe memory profile) — each tick
+    here runs one forward slot-wave and one backward slot-wave. A
+    microbatch's backward starts ``1`` tick after its loss, so the
+    activation ring holds at most ``2K`` entries per slot regardless
+    of ``M`` (the 1F1B property; reference default schedule,
+    ``hybrid_model.py:962`` area). The per-slot backward is
+    ``jax.vjp`` of the slot forward — recompute-from-stashed-input,
+    i.e. full recompute granularity, matching how the reference runs
+    PP with recompute enabled.
+
+    Args:
+      layer_apply / stacked_params / x / pp / vpp / extras / rng: as
+        in ``pipeline_forward``.
+      num_microbatches: M (gradient accumulation happens inside the
+        schedule).
+      loss_and_grad: ``(y_mb, extras_mb) -> (loss_mb, dy_mb,
+        dhead_mb)`` — per-microbatch loss, its cotangent wrt ``y_mb``,
+        and the gradient pytree for any head/criterion parameters
+        closed over by the caller (summed over microbatches here).
+
+    Returns ``(loss_sum, d_stacked, dhead_sum, dx)`` where
+    ``d_stacked`` matches ``stacked_params``' ``[L, ...]`` layout,
+    ``dhead_sum`` sums ``dhead_mb`` over microbatches, and ``dx`` is
+    the ``[B, ...]`` cotangent wrt ``x`` (feed it to the embedding
+    vjp). All sums are over microbatches — divide by M for a mean.
+    """
+    S, M = pp, num_microbatches
+    K = S * vpp
+    D = 2 * K  # activation ring depth; see module docstring
+    B = x.shape[0]
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    slot_params, Lc = _slot_params(stacked_params, S, vpp)
+
+    x_mb = x.reshape(M, B // M, *x.shape[1:])
+    x_mb = _constrain(x_mb, P(None, DATA_AXES))
+    extras_mb = jax.tree.map(
+        lambda e: e.reshape(M, B // M, *e.shape[1:]), extras) \
+        if extras is not None else None
+    base_rng = rng if rng is not None else jax.random.key(0)
+    mb_shape = x_mb.shape[1:]
+
+    def stage_fn(sp, h, key):
+        def body(h, xs):
+            lp, k = xs
+            return layer_apply(lp, h, k), None
+        h, _ = jax.lax.scan(body, h, (sp, jax.random.split(key, Lc)))
+        return h
+
+    slot_stage = jax.vmap(jax.vmap(stage_fn))
+
+    def slot_vjp(sp, h, key, g):
+        _, pull = jax.vjp(lambda p, hh: stage_fn(p, hh, key), sp, h)
+        return pull(g)
+
+    slot_backward = jax.vmap(jax.vmap(slot_vjp))
+
+    # zero templates for the loss head's outputs
+    y_abs = jax.ShapeDtypeStruct(mb_shape, x.dtype)
+    ex_abs = jax.tree.map(
+        lambda e: jax.ShapeDtypeStruct(e.shape[1:], e.dtype), extras_mb) \
+        if extras_mb is not None else None
+    _, dy_abs, dhead_abs = jax.eval_shape(loss_and_grad, y_abs, ex_abs)
+    zeros_of = lambda ab: jax.tree.map(  # noqa: E731
+        lambda a: jnp.zeros(a.shape, a.dtype), ab)
+
+    fstate0 = _constrain(jnp.zeros((vpp, S) + mb_shape, x.dtype),
+                         P(None, PP_AXIS, DATA_AXES))
+    bstate0 = fstate0
+    stash0 = _constrain(jnp.zeros((vpp, S, D) + mb_shape, x.dtype),
+                        P(None, PP_AXIS, None, DATA_AXES))
+    dparams0 = jax.tree.map(
+        lambda p: _constrain(jnp.zeros(p.shape, jnp.float32),
+                             P(None, PP_AXIS)), slot_params)
+    dhead0 = zeros_of(dhead_abs)
+    dy0 = zeros_of(dy_abs)
+    dx0 = _constrain(jnp.zeros((M,) + mb_shape, jnp.float32),
+                     P(None, DATA_AXES))
+    loss0 = jnp.zeros((), jnp.float32)
+
+    k_arr = jnp.arange(K)
+
+    def tick(carry, t):
+        fstate, b_out, dy_prev, stash, loss_sum, dparams, dhead, dx = \
+            carry
+
+        # ---- forward wave -------------------------------------------
+        inp = jax.lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+        fstate = _constrain(fstate.at[0, 0].set(inp),
+                            P(None, PP_AXIS, DATA_AXES))
+        stash = _constrain(stash.at[:, :, t % D].set(fstate),
+                           P(None, PP_AXIS, None, DATA_AXES))
+        m_f = jnp.clip(t - k_arr, 0, M - 1)
+        f_keys = _slot_keys(base_rng, m_f, K).reshape(vpp, S)
+        processed = slot_stage(slot_params, fstate, f_keys)
+        processed = _constrain(processed, P(None, PP_AXIS, DATA_AXES))
+
+        # ---- loss head on the freshly finished microbatch -----------
+        m_l = t - (K - 1)
+        y_last = processed[-1, -1]
+        ex = jax.tree.map(
+            lambda e: jax.lax.dynamic_index_in_dim(
+                e, jnp.clip(m_l, 0, M - 1), 0, keepdims=False),
+            extras_mb) if extras_mb is not None else None
+
+        def do_loss(_):
+            return loss_and_grad(y_last, ex)
+
+        def no_loss(_):
+            return loss0, dy0, zeros_of(dhead_abs)
+
+        valid_l = jnp.logical_and(m_l >= 0, m_l < M)
+        loss_mb, dy_new, dhead_mb = jax.lax.cond(valid_l, do_loss,
+                                                 no_loss, None)
+        loss_sum = loss_sum + loss_mb
+        dhead = jax.tree.map(jnp.add, dhead, dhead_mb)
+
+        # ---- backward wave ------------------------------------------
+        m_b = t - (2 * K - 1 - k_arr)
+        valid_b = jnp.logical_and(m_b >= 0, m_b < M)
+        g_in = _retreat(b_out, dy_prev, vpp)
+        g_in = _constrain(g_in, P(None, PP_AXIS, DATA_AXES))
+        depth = (t - (2 * K - 1) + 2 * k_arr) % D  # forward-tick slot
+        x_in = jax.vmap(jax.vmap(
+            lambda st, d: jax.lax.dynamic_index_in_dim(
+                st, d, 0, keepdims=False)))(
+            stash, depth.reshape(vpp, S))
+        b_keys = _slot_keys(base_rng, jnp.clip(m_b, 0, M - 1),
+                            K).reshape(vpp, S)
+        dp, dh = slot_backward(slot_params, x_in, b_keys,
+                               g_in.astype(x.dtype))
+        mask = valid_b.reshape(vpp, S)
+        dparams = jax.tree.map(
+            lambda acc, g: acc + jnp.where(
+                mask.reshape(mask.shape + (1,) * (g.ndim - 2)),
+                g.astype(jnp.float32), 0.0),
+            dparams, dp)
+        b_out_new = _constrain(dh.astype(jnp.float32),
+                               P(None, PP_AXIS, DATA_AXES))
+
+        # cotangent wrt the pipeline input, for the embedding backward
+        m_b0 = t - (2 * K - 1)
+        dx = jax.lax.cond(
+            jnp.logical_and(m_b0 >= 0, m_b0 < M),
+            lambda d: jax.lax.dynamic_update_index_in_dim(
+                d, dh[0, 0].astype(jnp.float32),
+                jnp.clip(m_b0, 0, M - 1), 0),
+            lambda d: d, dx)
+
+        fstate = _advance(processed, vpp)
+        return (fstate, b_out_new, dy_new, stash, loss_sum, dparams,
+                dhead, dx), None
+
+    carry0 = (fstate0, bstate0, dy0, stash0, loss0, dparams0, dhead0,
+              dx0)
+    (_, _, _, _, loss_sum, dparams, dhead, dx), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(M + 2 * K - 1))
+
+    d_stacked = jax.tree.map(
+        lambda g, p: g.reshape(p.shape).astype(p.dtype),
+        dparams, stacked_params)
+    return loss_sum, d_stacked, dhead, dx.reshape(B, *x.shape[1:])

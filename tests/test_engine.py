@@ -140,6 +140,29 @@ def test_checkpoint_save_load_resume(tmp_path):
 import jax  # noqa: E402  (used in helpers above)
 
 
+def test_epoch_run_mode_evaluates_at_epoch_end(tmp_path):
+    """run_mode='epoch' (the vis configs): no mid-epoch eval even with
+    eval_freq=1, one full-loader eval at epoch end, and eval_iters=-1
+    walks the whole loader instead of breaking at batch 0 with a NaN
+    mean (reference eager_engine.py:296-372 gates on run_mode)."""
+    cfg, engine, loader = _build(tmp_path, **{
+        "Engine.max_steps": 3, "Engine.eval_freq": 1,
+        "Engine.eval_iters": -1, "Engine.run_mode": "epoch"})
+    assert engine.eval_iters is None  # -1 -> walk the whole loader
+    assert engine.test_iters > 0  # not eval_iters * 10 == -10
+
+    step_logs, epoch_logs = [], []
+    engine.module.validation_step_end = step_logs.append
+    engine.module.validation_epoch_end = epoch_logs.append
+
+    valid_batches = [next(iter(loader)) for _ in range(2)]
+    engine.fit(epoch=1, train_data_loader=loader,
+               valid_data_loader=valid_batches)
+    assert len(epoch_logs) == 1  # once, at epoch end — not per step
+    assert len(step_logs) == len(valid_batches)  # whole loader walked
+    assert np.isfinite(epoch_logs[0]["loss"])
+
+
 def test_profiler_window_writes_trace(tmp_path):
     """Profiler.enable traces steps [start, stop) into profiler_log
     (reference eager_engine.py:202-224 window semantics)."""

@@ -70,6 +70,22 @@ def test_pad_tokens_are_masked_out():
                                atol=1e-6)
 
 
+def test_no_mask_means_unpadded():
+    """attention_mask=None treats the batch as unpadded: token id 0 is
+    a legitimate vocab token on pretraining streams and must not be
+    inferred as padding (flash and XLA paths agree by construction)."""
+    rng = np.random.default_rng(2)
+    ids = jnp.asarray(rng.integers(1, 64, (1, 16)), jnp.int32)
+    ids = ids.at[0, 5].set(0)  # legit id-0 token mid-sequence
+    model = ErnieForMaskedLM(CFG)
+    params = _init_params(model, ids)
+    none_mask = model.apply({"params": params}, ids)
+    ones_mask = model.apply({"params": params}, ids,
+                            attention_mask=jnp.ones((1, 16), jnp.int32))
+    np.testing.assert_allclose(np.asarray(none_mask),
+                               np.asarray(ones_mask), atol=1e-6)
+
+
 def test_mlm_masking_semantics():
     cfg = ErnieConfig(vocab_size=64, masked_lm_prob=0.5, pad_token_id=0)
     rng = np.random.default_rng(2)

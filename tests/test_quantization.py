@@ -49,6 +49,33 @@ def test_quantize_params_kernels_only():
         float(params["norm"]["scale"][0])
 
 
+def test_stacked_kernel_per_layer_scale():
+    """Scan-stacked [L, in, out] kernels get one scale per layer: a
+    tiny-magnitude layer keeps its resolution instead of inheriting
+    the largest layer's scale (reference paddleslim quantizes each
+    Linear independently)."""
+    big = np.full((4, 4), 100.0, np.float32)
+    small = np.linspace(-0.01, 0.01, 16, dtype=np.float32) \
+        .reshape(4, 4)
+    stacked = jnp.asarray(np.stack([big, small]))
+    params = {"decoder": {"fc": {"kernel": stacked}}}
+
+    out = quantize_params(params, bits=8, stacked_module="decoder")
+    got = out["decoder"]["fc"]["kernel"]
+    # each layer matches an independent per-tensor fake_quant
+    np.testing.assert_allclose(np.asarray(got[0]),
+                               np.asarray(fake_quant(stacked[0])))
+    np.testing.assert_allclose(np.asarray(got[1]),
+                               np.asarray(fake_quant(stacked[1])))
+    # and the small layer is NOT flattened to zero (shared-scale
+    # quantization would round everything below 100/127/2 away)
+    assert float(jnp.max(jnp.abs(got[1]))) > 0
+
+    # without the stacked hint the shared scale destroys the layer
+    shared = quantize_params(params, bits=8)["decoder"]["fc"]["kernel"]
+    np.testing.assert_allclose(np.asarray(shared[1]), 0.0)
+
+
 def test_qat_gpt_trains(tmp_path):
     """QAT-enabled GPT through the engine: loss finite and decreasing,
     quantized forward close to the fp forward."""

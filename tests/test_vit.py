@@ -64,6 +64,31 @@ def test_zoo_names():
         build_vision_model({"name": "ResNet5000"})
 
 
+def test_zoo_mirrors_reference_builders():
+    """Zoo entries must match the reference architectures exactly
+    (reference vit.py:261-434): representation head on 224-res
+    variants, epsilon=1e-6 + qkv_bias on base/large/g/G/6B, and the
+    published mlp ratios — else checkpoints don't transfer."""
+    expect = {
+        "ViT_base_patch16_224": (768, 768, 1e-6, True, 4.0),
+        "ViT_base_patch16_384": (768, None, 1e-6, True, 4.0),
+        "ViT_large_patch16_224": (1024, 1024, 1e-6, True, 4.0),
+        "ViT_large_patch32_384": (1024, None, 1e-6, True, 4.0),
+        "ViT_huge_patch14_224": (1280, 1280, 1e-5, False, 4.0),
+        "ViT_huge_patch14_384": (1280, None, 1e-5, False, 4.0),
+        "ViT_g_patch14_224": (1408, 1408, 1e-6, True, 4.364),
+        "ViT_G_patch14_224": (1664, 1664, 1e-6, True, 4.9231),
+        "ViT_6B_patch14_224": (2320, 2320, 1e-6, True, 4.955),
+    }
+    for name, (dim, rep, eps, qkv, ratio) in expect.items():
+        cfg = build_vision_model({"name": name}).config
+        assert cfg.embed_dim == dim, name
+        assert cfg.representation_size == rep, name
+        assert cfg.epsilon == eps, name
+        assert cfg.qkv_bias == qkv, name
+        assert abs(cfg.mlp_ratio - ratio) < 1e-9, name
+
+
 def test_celoss_matches_manual():
     rng = np.random.default_rng(1)
     logits = jnp.asarray(rng.normal(size=(4, 6)), jnp.float32)
