@@ -91,11 +91,17 @@ def build_dataloader(config, mode: str, num_replicas: int = 1,
     if name not in SAMPLERS:
         raise ValueError(
             f"unknown sampler {name!r}; available: {sorted(SAMPLERS)}")
+    # auto-schema sections carry no sampler block; entry points resize
+    # the sampler from the global-batch algebra after build (train.py)
+    sampler_cfg.setdefault("batch_size", 1)
     sampler = SAMPLERS[name](dataset, num_replicas=num_replicas, rank=rank,
                              **sampler_cfg)
-    loader_cfg = copy.deepcopy(dict(config[mode].get("loader", {})))
+    loader_cfg = copy.deepcopy(dict(config[mode].get("loader", {}) or {}))
     loader_cfg.pop("return_list", None)
-    collate_name = loader_cfg.pop("collate_fn", None)
+    # auto-config schema puts collate_fn (and sample_split, which GSPMD
+    # subsumes) at section level (reference ``data/__init__.py:25-57``)
+    collate_name = loader_cfg.pop("collate_fn", None) or \
+        config[mode].get("collate_fn")
     # unnamed -> field-stacking default (vision configs name none)
     collate = COLLATE_FNS[collate_name or "default_collate_fn"]
     return DataLoader(dataset, sampler, collate, **loader_cfg)

@@ -35,13 +35,18 @@ def fused_adamw(learning_rate: Callable, beta1: float = 0.9,
                 beta2: float = 0.999, epsilon: float = 1e-8,
                 weight_decay: float = 0.01,
                 grad_clip_norm: Optional[float] = None,
+                state_dtype: Optional[str] = None,
                 **_) -> optax.GradientTransformation:
     txs = []
     if grad_clip_norm:
         txs.append(optax.clip_by_global_norm(grad_clip_norm))
+    # state_dtype: AMP-O3 analogue (reference use_optimizer_fp16) —
+    # first moment stored reduced-precision; nu stays fp32 (bf16 nu
+    # would quantize the effective lr too coarsely)
     txs.append(optax.adamw(
         learning_rate, b1=beta1, b2=beta2, eps=epsilon,
-        weight_decay=weight_decay, mask=_decay_mask))
+        weight_decay=weight_decay, mask=_decay_mask,
+        mu_dtype=state_dtype))
     return optax.chain(*txs)
 
 

@@ -245,6 +245,22 @@ def process_engine_config(config: AttrDict) -> None:
     mp = engine.setdefault("mix_precision", AttrDict())
     # bf16 replaces fp16+GradScaler on TPU; keep the reference knobs as
     # accepted aliases so reference YAMLs run unchanged.
+    # Auto-config schema (reference ``process_auto_strategy``,
+    # ``utils/config.py:418-448``): ``level`` o1/o2/o3.
+    #   o1 -> selective autocast: params fp32, compute bf16 (the
+    #         black/white lists are XLA's problem, accepted+ignored)
+    #   o2 -> pure bf16 compute + fp32 master weights (== use_pure_fp16)
+    #   o3 -> o2 plus bf16 optimizer moments (reference
+    #         use_optimizer_fp16); wired to the optimizer's mu_dtype
+    level = mp.get("level")
+    if level is not None:
+        if level not in ("o0", "o1", "o2", "o3"):
+            raise ValueError(
+                f"mix_precision.level must be o0/o1/o2/o3, got {level!r}")
+        mp.setdefault("use_pure_fp16", level in ("o1", "o2", "o3"))
+        if level == "o3":
+            opt = config.setdefault("Optimizer", AttrDict())
+            opt.setdefault("state_dtype", "bfloat16")
     mp.setdefault("use_pure_fp16", False)
     mp.setdefault("dtype", "bfloat16" if mp.get("use_pure_fp16") else "float32")
     mp.setdefault("scale_loss", 1.0)
