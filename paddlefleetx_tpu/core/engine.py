@@ -299,9 +299,11 @@ class Engine(BasicEngine):
                 train_step, donate_argnums=(0,),
                 out_shardings=(self.state_shardings, None))
         self._eval_step = jax.jit(eval_step)
-        model = self.module.model
-        self._apply_fn = jax.jit(lambda p, ids: model.apply(
-            {"params": p}, ids, deterministic=True))
+
+        def predict_step(state, batch):
+            return module.predict_step(state["params"], batch, root_rng)
+
+        self._predict_step = jax.jit(predict_step)
 
     def _put_batch(self, batch):
         """Collated numpy tuple -> global device arrays sharded over the
@@ -488,15 +490,28 @@ class Engine(BasicEngine):
             return self._evaluate_impl(epoch, valid_data_loader)
 
     def predict(self, epoch: int = 1, test_data_loader=None):
+        """Test-set walk (reference ``eager_engine.py:531-583``): each
+        batch runs ``module.predict_step`` (default: eval-mode loss),
+        host hooks fire via ``test_step_end``, capped at test_iters."""
         outs = []
+        t0 = time.time()
         with self.mesh, nn.logical_axis_rules(self.rules):
             for i, batch in enumerate(test_data_loader):
                 if i >= self.test_iters:
+                    logger.info("The predicting process is complete.")
                     break
                 batch = self.module.pretreating_batch(batch)
-                tokens = self._put_batch(batch)[0]
-                outs.append(jax.device_get(
-                    self._apply_fn(self.state["params"], tokens)))
+                out = jax.device_get(
+                    self._predict_step(self.state,
+                                       self._put_batch(batch)))
+                outs.append(out)
+                arr = out.get("loss") if isinstance(out, dict) else out
+                self.module.test_step_end({
+                    "epoch": epoch, "batch": i,
+                    # dict outputs without a loss entry log nan
+                    "loss": float(np.mean(arr)) if arr is not None
+                    else float("nan"),
+                    "test_cost": (time.time() - t0) / (i + 1)})
         return outs
 
     # -- checkpoint -----------------------------------------------------

@@ -41,6 +41,13 @@ class BasicModule:
         """Return scalar loss. ``batch`` is the collated tuple."""
         raise NotImplementedError
 
+    def predict_step(self, params, batch, rng):
+        """Pure per-batch test output for ``Engine.predict`` (reference
+        ``test_step``, ``language_module.py:83-88``: eval-mode loss);
+        override to return custom predictions (scalar, array, or a
+        dict with a ``loss`` entry for logging)."""
+        return self.loss_fn(params, batch, rng, train=False)
+
     # -- host-side hooks -----------------------------------------------
     def pretreating_batch(self, batch):
         return batch

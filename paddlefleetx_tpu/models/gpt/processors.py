@@ -69,3 +69,41 @@ def top_p_filter(logits: jax.Array, top_p: float) -> jax.Array:
         jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
         keepdims=True)
     return jnp.where(logits < threshold, NEG_INF, logits)
+
+
+def hamming_diversity_processor(scores: jax.Array,
+                                current_tokens: jax.Array,
+                                beam_group_idx: int,
+                                diversity_rate: float, num_beams: int,
+                                num_beam_groups: int) -> jax.Array:
+    """Diverse (group) beam search penalty (reference
+    ``HammingDiversityLogitsProcessor``, ``processor.py:106-155``):
+    subtract ``diversity_rate`` times the frequency with which earlier
+    groups already chose each token at this step.
+
+    ``scores``: [batch * group_size, V] for the group being scored;
+    ``current_tokens``: [batch * num_beams] this-step choices of all
+    beams (only beams before this group are read).
+    """
+    if not isinstance(diversity_rate, float) or diversity_rate <= 0.0:
+        raise ValueError(
+            "`diversity_rate` should be a float strictly larger than 0.")
+    if not isinstance(num_beams, int) or num_beams < 2:
+        raise ValueError(
+            "`num_beams` should be an integer strictly larger than 1.")
+    if not isinstance(num_beam_groups, int) or num_beam_groups < 2:
+        raise ValueError(
+            "`num_beam_groups` should be an integer strictly larger "
+            "than 1.")
+    num_sub = num_beams // num_beam_groups
+    group_start = beam_group_idx * num_sub
+    if group_start == 0:
+        return scores
+    group_size = min(group_start + num_sub, num_beams) - group_start
+    vocab = scores.shape[-1]
+    batch = current_tokens.shape[0] // num_beams
+    prev = current_tokens.reshape(batch, num_beams)[:, :group_start]
+    # bincount over earlier groups' tokens, vectorized as one-hot sums
+    freq = jnp.sum(jax.nn.one_hot(prev, vocab, dtype=scores.dtype),
+                   axis=1)
+    return scores - diversity_rate * jnp.repeat(freq, group_size, axis=0)

@@ -55,6 +55,9 @@ class ImagenConfig:
     lowres_sample_noise_level: float = 0.2
     condition_on_text: bool = True
     auto_normalize_img: bool = True
+    #: SR stages: True draws one aug-noise level per sample, False one
+    #: per batch (reference ``modeling.py`` per_sample_random_aug_noise_level)
+    per_sample_random_aug_noise_level: bool = False
     p2_loss_weight_gamma: float = 0.5
     dynamic_thresholding: bool = True
     dynamic_thresholding_percentile: float = 0.95
@@ -147,8 +150,13 @@ class ImagenModel(nn.Module):
             prev = cfg.image_sizes[i - 1] if i > 0 else \
                 max(1, size // 4)
             lowres_cond_img = _resize(_resize(images, prev), size)
-            lowres_aug_times = jnp.broadcast_to(
-                self.lowres_schedule.sample_random_times(lrt_rng, 1), (b,))
+            if cfg.per_sample_random_aug_noise_level:
+                lowres_aug_times = \
+                    self.lowres_schedule.sample_random_times(lrt_rng, b)
+            else:
+                lowres_aug_times = jnp.broadcast_to(
+                    self.lowres_schedule.sample_random_times(lrt_rng, 1),
+                    (b,))
 
         x_start = self._normalize(_resize(images, size))
         noise = jax.random.normal(n_rng, x_start.shape, x_start.dtype)

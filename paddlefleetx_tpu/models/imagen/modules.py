@@ -24,13 +24,16 @@ class ImagenModule(BasicModule):
         loss_cfg = dict(configs.get("Loss", {}) or {})
         self.loss_name = loss_cfg.get("name", "mse_loss")
         self.p2_loss_weight_k = loss_cfg.get("p2_loss_weight_k", 1)
-        self.unet_number = configs.Model.get("unet_number", 1) or 1
+        # reference SR configs name the knob only_train_unet_number
+        self.unet_number = configs.Model.get("unet_number") or \
+            configs.Model.get("only_train_unet_number") or 1
         super().__init__(configs)
 
     def get_model(self):
         model_setting = dict(self.configs.Model)
-        model_setting.pop("module", None)
-        model_setting.pop("unet_number", None)
+        for compat in ("module", "unet_number", "only_train_unet_number",
+                       "text_encoder_name"):  # embeds are precomputed
+            model_setting.pop(compat, None)
         name = model_setting.pop("name")
         return build_imagen_model(name, **model_setting)
 

@@ -1,18 +1,22 @@
-"""Cached artifact resolution (vocab files, pretrained weights).
+"""Cached artifact resolution and a retrying, hash-verified downloader.
 
-Parity: reference ``utils/download.py`` — a retrying cached downloader
-where process rank 0 fetches while other ranks spin-wait on the cached
-file (:118+). This deployment is zero-egress: resolution covers the
-explicit path, the cache directory (``PFX_CACHE_HOME``, default
-``~/.cache/paddlefleetx_tpu``), and a same-process rank-0-writes /
-others-wait protocol for locally *produced* artifacts; an actual URL
-fetch raises with instructions instead of downloading.
+Parity: reference ``utils/download.py`` — ``_download`` retries the
+fetch up to a retry budget, verifies md5, writes to a temp file and
+atomically moves into the cache (:71-114); ``download`` gates the fetch
+on rank 0 while other ranks spin-wait on the cached file (:118-128).
+This deployment is zero-egress, so network schemes fail fast with a
+pre-staging hint, but the full retry/verify/atomic-move machinery runs
+for any reachable URL (``file://`` included, which the tests use).
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
+import shutil
 import time
+import urllib.error
+import urllib.request
 from typing import Optional
 
 from .log import logger
@@ -20,6 +24,8 @@ from .log import logger
 CACHE_HOME = os.environ.get(
     "PFX_CACHE_HOME",
     os.path.join(os.path.expanduser("~"), ".cache", "paddlefleetx_tpu"))
+
+DOWNLOAD_RETRY_LIMIT = 3
 
 
 def cached_path(name_or_path: str,
@@ -33,17 +39,92 @@ def cached_path(name_or_path: str,
     return candidate if os.path.exists(candidate) else None
 
 
+def _md5check(fullname: str, md5sum: Optional[str]) -> bool:
+    """Reference ``_md5check`` (:130-146): True when no sum is given."""
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            md5.update(chunk)
+    ok = md5.hexdigest() == md5sum
+    if not ok:
+        logger.warning("md5 mismatch for %s: %s != %s", fullname,
+                       md5.hexdigest(), md5sum)
+    return ok
+
+
+def _download(url: str, path: str, md5sum: Optional[str] = None,
+              retries: int = DOWNLOAD_RETRY_LIMIT) -> str:
+    """Fetch ``url`` into directory ``path`` with retry + md5 verify +
+    atomic move (reference ``_download`` :71-114)."""
+    os.makedirs(path, exist_ok=True)
+    fname = os.path.basename(url)
+    fullname = os.path.join(path, fname)
+    attempt = 0
+    while not (os.path.exists(fullname) and _md5check(fullname, md5sum)):
+        if attempt >= retries:
+            raise RuntimeError(
+                f"download of {url} failed after {retries} attempts")
+        attempt += 1
+        logger.info("downloading %s (attempt %d/%d)", url, attempt,
+                    retries)
+        tmp_fullname = fullname + "_tmp"
+        try:
+            with urllib.request.urlopen(url) as req, \
+                    open(tmp_fullname, "wb") as f:
+                shutil.copyfileobj(req, f)
+            shutil.move(tmp_fullname, fullname)
+        except (urllib.error.URLError, OSError) as e:
+            logger.warning("fetch attempt %d for %s failed: %s",
+                           attempt, url, e)
+            if os.path.exists(tmp_fullname):
+                os.remove(tmp_fullname)
+            time.sleep(min(2 ** attempt, 8) * 0.01)
+    return fullname
+
+
+def _process_rank() -> int:
+    for var in ("PFX_RANK", "JAX_PROCESS_INDEX", "RANK"):
+        if os.environ.get(var):
+            return int(os.environ[var])
+    return 0
+
+
+def download(url: str, path: str, md5sum: Optional[str] = None) -> str:
+    """Rank-0 downloads; other ranks spin-wait until the file exists
+    AND passes the hash (reference ``download`` :118-128 waits on
+    existence only, which would accept a stale file rank 0 is still
+    re-fetching)."""
+    fullname = os.path.join(path, os.path.basename(url))
+    if _process_rank() != 0:
+        t0 = time.time()
+        while True:
+            if os.path.exists(fullname) and _md5check(fullname, md5sum):
+                return fullname
+            if time.time() - t0 > 3600.0:
+                raise TimeoutError(
+                    f"timed out waiting for verified {fullname}")
+            time.sleep(1)
+    return _download(url, path, md5sum)
+
+
 def get_weights_path_from_url(url: str, md5sum: Optional[str] = None
                               ) -> str:
-    """Reference API surface; zero-egress deployments must pre-stage
-    the file into the cache."""
+    """Resolve (or fetch) a weights artifact into the cache
+    (reference ``get_weights_path_from_url`` → ``get_path_from_url``)."""
+    weights_dir = os.path.join(CACHE_HOME, "weights")
     cached = cached_path(os.path.basename(url), "weights")
-    if cached:
+    if cached and _md5check(cached, md5sum):
         return cached
-    raise FileNotFoundError(
-        f"{os.path.basename(url)} not found under {CACHE_HOME}/weights "
-        f"and downloading is disabled (zero egress). Pre-stage the "
-        f"file there (source: {url}).")
+    try:
+        return download(url, weights_dir, md5sum)
+    except (RuntimeError, urllib.error.URLError, OSError) as e:
+        raise FileNotFoundError(
+            f"{os.path.basename(url)} not found under "
+            f"{CACHE_HOME}/weights and could not be fetched ({e}); on "
+            f"zero-egress deployments pre-stage the file there "
+            f"(source: {url}).") from e
 
 
 def wait_for_file(path: str, producer_rank: bool,

@@ -1,0 +1,3 @@
+#!/bin/bash
+# imagen SR 1024 single card (reference projects/imagen/run_super_resolusion_1024_single.sh)
+python ./tools/train.py -c ./configs/mm/imagen/imagen_super_resolution_1024.yaml "$@"

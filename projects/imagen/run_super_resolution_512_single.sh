@@ -1,0 +1,3 @@
+#!/bin/bash
+# imagen SR 512 single card (reference projects/imagen/run_super_resolusion_512_single.sh)
+python ./tools/train.py -c ./configs/mm/imagen/imagen_super_resolution_512.yaml "$@"

@@ -219,3 +219,18 @@ def test_ernie_trains_through_engine(tmp_path):
     assert len(losses) == 4
     assert np.isfinite(losses[-1])
     assert losses[-1] < losses[0], losses
+
+
+def test_ernie_345M_config_parses():
+    import os
+    from paddlefleetx_tpu.utils.config import get_config
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = get_config(os.path.join(
+        repo, "configs/nlp/ernie/pretrain_ernie_345M_single_card.yaml"),
+        nranks=1)
+    assert cfg.Model.module == "ErnieModule"
+    assert cfg.Model.num_hidden_layers == 2
+    assert cfg.Model.task_type_vocab_size == 3
+    from paddlefleetx_tpu.models.ernie.config import ErnieConfig
+    mc = ErnieConfig.from_config(cfg)
+    assert mc.hidden_size == 1024 and mc.num_attention_heads == 1

@@ -118,3 +118,89 @@ def test_min_length_suppresses_eos():
     assert out[0, 2] < -1e8
     out2 = np.asarray(min_length_processor(logits, jnp.asarray(5), 3, 2))
     assert out2[0, 2] == 0.0
+
+
+def test_generation_dp8_matches_single_device(model_and_params):
+    """Distributed generation (generation_gpt_345M_dp8.yaml topology):
+    the prompt batch sharded over a dp-8 mesh must sample exactly the
+    single-device tokens — GSPMD partitions the same program."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    model, params = model_and_params
+    prompt = jnp.asarray(
+        np.random.default_rng(3).integers(0, 90, (8, 7)), jnp.int32)
+    gen_cfg = GenerationConfig(
+        max_dec_len=6, decode_strategy="greedy_search",
+        eos_token_id=EOS, pad_token_id=PAD)
+    single = np.asarray(generate(model, params, prompt, None,
+                                 jax.random.key(1), gen_cfg))
+
+    mesh = Mesh(np.asarray(jax.devices()).reshape(8), ("dp",))
+    sharded_prompt = jax.device_put(
+        prompt, NamedSharding(mesh, P("dp", None)))
+    repl_params = jax.device_put(
+        params, NamedSharding(mesh, P()))
+    with mesh:
+        dist = np.asarray(generate(model, repl_params, sharded_prompt,
+                                   None, jax.random.key(1), gen_cfg))
+    np.testing.assert_array_equal(dist, single)
+
+
+def test_dp8_generation_config_parses():
+    import os
+    from paddlefleetx_tpu.utils.config import get_config
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = get_config(
+        os.path.join(repo, "configs/nlp/gpt/generation_gpt_345M_dp8.yaml"),
+        nranks=8)
+    assert cfg.Distributed.dp_degree == 8
+    assert cfg.Model.module == "GPTGenerationModule"
+    assert cfg.Generation.top_k == 50
+    inf = get_config(
+        os.path.join(repo, "configs/nlp/gpt/inference_gpt_345M_dp8.yaml"),
+        nranks=8)
+    assert inf.Inference.mp_degree == 1
+    assert inf.Data.Test.loader.collate_fn == "gpt_inference_collate_fn"
+
+
+def test_hamming_diversity_matches_bincount_loop():
+    """Penalty equals diversity_rate x per-batch bincount of earlier
+    groups' tokens (reference processor.py:146-153 semantics)."""
+    from paddlefleetx_tpu.models.gpt.processors import (
+        hamming_diversity_processor,
+    )
+    rng = np.random.default_rng(0)
+    batch, num_beams, groups, vocab = 2, 4, 2, 11
+    sub = num_beams // groups
+    tokens = jnp.asarray(rng.integers(0, vocab, batch * num_beams),
+                         jnp.int32)
+    scores = jnp.asarray(rng.normal(size=(batch * sub, vocab)),
+                         jnp.float32)
+    # group 0 is unpenalized
+    np.testing.assert_array_equal(
+        np.asarray(hamming_diversity_processor(
+            scores, tokens, 0, 0.7, num_beams, groups)),
+        np.asarray(scores))
+    got = np.asarray(hamming_diversity_processor(
+        scores, tokens, 1, 0.7, num_beams, groups))
+    expect = np.asarray(scores).copy()
+    toks = np.asarray(tokens)
+    for b in range(batch):
+        freq = np.bincount(toks[b * num_beams: b * num_beams + sub],
+                           minlength=vocab)
+        expect[b * sub:(b + 1) * sub] -= 0.7 * freq
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+def test_hamming_diversity_validation():
+    from paddlefleetx_tpu.models.gpt.processors import (
+        hamming_diversity_processor,
+    )
+    s = jnp.zeros((2, 5)); t = jnp.zeros((4,), jnp.int32)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="diversity_rate"):
+        hamming_diversity_processor(s, t, 1, 0.0, 4, 2)
+    with _pytest.raises(ValueError, match="num_beams"):
+        hamming_diversity_processor(s, t, 1, 0.5, 1, 2)
+    with _pytest.raises(ValueError, match="num_beam_groups"):
+        hamming_diversity_processor(s, t, 1, 0.5, 4, 1)

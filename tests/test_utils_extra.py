@@ -107,3 +107,60 @@ def test_benchmark_driver_end_to_end(tmp_path):
     assert result["ok"], result
     assert result["ips"] > 0
     assert result["last_loss"] is not None
+
+
+def test_download_file_url_with_md5(tmp_path, monkeypatch):
+    """_download fetches file:// URLs, verifies md5, moves atomically
+    (reference download.py:71-114)."""
+    import hashlib
+    from paddlefleetx_tpu.utils import download
+    src = tmp_path / "src" / "w.bin"
+    src.parent.mkdir()
+    src.write_bytes(b"weights-payload")
+    md5 = hashlib.md5(b"weights-payload").hexdigest()
+    dest = tmp_path / "cache"
+    got = download._download(src.as_uri(), str(dest), md5sum=md5)
+    assert got == str(dest / "w.bin")
+    assert (dest / "w.bin").read_bytes() == b"weights-payload"
+    assert not (dest / "w.bin_tmp").exists()
+
+
+def test_download_bad_cache_refetches(tmp_path, monkeypatch):
+    """A cached file failing its md5 is re-fetched from source."""
+    import hashlib
+    from paddlefleetx_tpu.utils import download
+    monkeypatch.setattr(download, "CACHE_HOME", str(tmp_path / "home"))
+    src = tmp_path / "srv" / "w.bin"
+    src.parent.mkdir()
+    src.write_bytes(b"good")
+    md5 = hashlib.md5(b"good").hexdigest()
+    stale = tmp_path / "home" / "weights" / "w.bin"
+    stale.parent.mkdir(parents=True)
+    stale.write_bytes(b"corrupt")
+    got = download.get_weights_path_from_url(src.as_uri(), md5sum=md5)
+    assert open(got, "rb").read() == b"good"
+
+
+def test_download_retries_then_raises(tmp_path):
+    from paddlefleetx_tpu.utils import download
+    missing = (tmp_path / "absent.bin").as_uri()
+    with pytest.raises(RuntimeError, match="after 2 attempts"):
+        download._download(missing, str(tmp_path / "out"), retries=2)
+
+
+def test_download_nonzero_rank_waits(tmp_path, monkeypatch):
+    from paddlefleetx_tpu.utils import download
+    monkeypatch.setenv("PFX_RANK", "1")
+    src = tmp_path / "w.bin"
+    target = tmp_path / "cache" / "w.bin"
+
+    def land():
+        time.sleep(0.2)
+        target.parent.mkdir(exist_ok=True)
+        target.write_bytes(b"x")
+
+    t = threading.Thread(target=land)
+    t.start()
+    got = download.download(src.as_uri(), str(tmp_path / "cache"))
+    t.join()
+    assert got == str(target) and os.path.exists(got)
