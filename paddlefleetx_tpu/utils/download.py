@@ -55,15 +55,24 @@ def _md5check(fullname: str, md5sum: Optional[str]) -> bool:
 
 
 def _download(url: str, path: str, md5sum: Optional[str] = None,
-              retries: int = DOWNLOAD_RETRY_LIMIT) -> str:
+              retries: int = DOWNLOAD_RETRY_LIMIT,
+              timeout: float = 30.0) -> str:
     """Fetch ``url`` into directory ``path`` with retry + md5 verify +
-    atomic move (reference ``_download`` :71-114)."""
+    atomic move (reference ``_download`` :71-114). The hash is checked
+    on the temp file BEFORE the move, so a truncated fetch never lands
+    in the cache; permanent failure leaves a ``.failed`` sentinel so
+    waiting ranks fail fast instead of spinning out their timeout."""
     os.makedirs(path, exist_ok=True)
     fname = os.path.basename(url)
     fullname = os.path.join(path, fname)
+    sentinel = fullname + ".failed"
+    if os.path.exists(sentinel):
+        os.remove(sentinel)
     attempt = 0
     while not (os.path.exists(fullname) and _md5check(fullname, md5sum)):
         if attempt >= retries:
+            with open(sentinel, "w") as f:
+                f.write(url)
             raise RuntimeError(
                 f"download of {url} failed after {retries} attempts")
         attempt += 1
@@ -71,10 +80,13 @@ def _download(url: str, path: str, md5sum: Optional[str] = None,
                     retries)
         tmp_fullname = fullname + "_tmp"
         try:
-            with urllib.request.urlopen(url) as req, \
+            with urllib.request.urlopen(url, timeout=timeout) as req, \
                     open(tmp_fullname, "wb") as f:
                 shutil.copyfileobj(req, f)
-            shutil.move(tmp_fullname, fullname)
+            if _md5check(tmp_fullname, md5sum):
+                shutil.move(tmp_fullname, fullname)
+            else:
+                os.remove(tmp_fullname)
         except (urllib.error.URLError, OSError) as e:
             logger.warning("fetch attempt %d for %s failed: %s",
                            attempt, url, e)
@@ -102,6 +114,9 @@ def download(url: str, path: str, md5sum: Optional[str] = None) -> str:
         while True:
             if os.path.exists(fullname) and _md5check(fullname, md5sum):
                 return fullname
+            if os.path.exists(fullname + ".failed"):
+                raise RuntimeError(
+                    f"rank 0 failed to download {url}")
             if time.time() - t0 > 3600.0:
                 raise TimeoutError(
                     f"timed out waiting for verified {fullname}")

@@ -95,8 +95,12 @@ def golden():
     ({"pp_degree": 2}, 4),
     ({"pp_degree": 4, "dp_degree": 2}, 2),
     ({"pp_degree": 2, "mp_degree": 2, "dp_degree": 2}, 4),
+    # the dryrun_multichip composite as a pytest case: TP inside a
+    # stage + ZeRO-3 param sharding + pipeline, all at once
+    ({"pp_degree": 2, "mp_degree": 2, "sharding_degree": 2,
+      "sharding_stage": 3}, 2),
     ({"pp_degree": 2}, 1),
-], ids=["pp2", "pp4xdp2", "pp2xmp2xdp2", "pp2-m1"])
+], ids=["pp2", "pp4xdp2", "pp2xmp2xdp2", "pp2xmp2xfsdp2", "pp2-m1"])
 def test_pipelined_matches_single_device(golden, topo_kw, microbatches):
     params, ids, labels, mask, ref_loss, ref_grads = golden
     topo = TopologyConfig(**topo_kw)

@@ -164,3 +164,27 @@ def test_download_nonzero_rank_waits(tmp_path, monkeypatch):
     got = download.download(src.as_uri(), str(tmp_path / "cache"))
     t.join()
     assert got == str(target) and os.path.exists(got)
+
+
+def test_download_corrupt_fetch_never_lands_in_cache(tmp_path):
+    """md5 is checked on the temp file BEFORE the cache move."""
+    import hashlib
+    from paddlefleetx_tpu.utils import download
+    src = tmp_path / "srv" / "w.bin"
+    src.parent.mkdir()
+    src.write_bytes(b"truncated")
+    wrong = hashlib.md5(b"full-content").hexdigest()
+    dest = tmp_path / "cache"
+    with pytest.raises(RuntimeError, match="failed after"):
+        download._download(src.as_uri(), str(dest), md5sum=wrong,
+                           retries=2)
+    assert not (dest / "w.bin").exists()         # nothing corrupt cached
+    assert (dest / "w.bin.failed").exists()      # failure sentinel
+
+
+def test_download_waiter_sees_rank0_failure(tmp_path, monkeypatch):
+    from paddlefleetx_tpu.utils import download
+    monkeypatch.setenv("PFX_RANK", "1")
+    (tmp_path / "w.bin.failed").write_text("url")
+    with pytest.raises(RuntimeError, match="rank 0 failed"):
+        download.download("file:///nope/w.bin", str(tmp_path))
