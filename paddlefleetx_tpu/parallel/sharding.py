@@ -159,3 +159,28 @@ def optimizer_state_shardings(opt_state_shapes, param_specs, mesh: Mesh,
 
     return jax.tree_util.tree_map_with_path(_leaf_sharding,
                                             opt_state_shapes)
+
+
+def offload_to_host(shardings, shapes):
+    """ZeRO offload (reference ``sharding_offload``,
+    ``eager_engine.py:233-247``): place optimizer-state arrays in
+    ``pinned_host`` memory; the train step streams them through HBM
+    during the update. Only leaves actually partitioned over the mesh
+    are offloaded — the SPMD partitioner rejects host placement of
+    REPLICATED values (step counters, indivisible moments), and a
+    replicated leaf gains nothing from ZeRO offload anyway.
+    """
+    del shapes  # placement depends on the spec, not the rank
+
+    def _host(s):
+        partitioned = any(d is not None for d in (s.spec or ()))
+        return s.with_memory_kind("pinned_host") if partitioned else s
+
+    return jax.tree.map(_host, shardings)
+
+
+def device_memory_kinds(shardings):
+    """The device-memory twin of an offloaded sharding tree (what the
+    train step device_puts onto before the optimizer update)."""
+    return jax.tree.map(lambda s: s.with_memory_kind("device"),
+                        shardings)

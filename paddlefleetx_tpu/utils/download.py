@@ -111,12 +111,22 @@ def download(url: str, path: str, md5sum: Optional[str] = None) -> str:
     fullname = os.path.join(path, os.path.basename(url))
     if _process_rank() != 0:
         t0 = time.time()
+        sentinel = fullname + ".failed"
         while True:
             if os.path.exists(fullname) and _md5check(fullname, md5sum):
                 return fullname
-            if os.path.exists(fullname + ".failed"):
-                raise RuntimeError(
-                    f"rank 0 failed to download {url}")
+            # only trust a sentinel from THIS run: a stale one left in
+            # a shared cache by a previous job must not kill the retry
+            # rank 0 is about to make (rank 0 clears it in _download,
+            # but a waiter scheduled first would see it earlier)
+            if os.path.exists(sentinel):
+                try:
+                    fresh = os.path.getmtime(sentinel) >= t0 - 60.0
+                except OSError:   # rank 0 removed it mid-check
+                    fresh = False
+                if fresh:
+                    raise RuntimeError(
+                        f"rank 0 failed to download {url}")
             if time.time() - t0 > 3600.0:
                 raise TimeoutError(
                     f"timed out waiting for verified {fullname}")
