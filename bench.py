@@ -1,11 +1,25 @@
 """Headline benchmark: GPT-345M pretraining throughput on one chip.
 
-Prints ONE JSON line ``{"metric", "value", "unit", "vs_baseline"}``.
-Baseline: the reference's published single-card number — ~16,200
-tokens/s on V100-32G (reference ``projects/gpt/docs/single_card.md:41-49``,
-recorded in BASELINE.md). ``vs_baseline`` = ours / 16200.
+Prints ONE JSON line ``{"metric", "value", "unit", "vs_baseline",
+"mfu"}``. Baseline: the reference's published single-card number —
+~16,200 tokens/s on V100-32G (reference
+``projects/gpt/docs/single_card.md:41-49``, recorded in BASELINE.md).
+``vs_baseline`` = ours / 16200.
+
+``mfu`` is model-FLOPs utilization against the chip's bf16 peak
+(Megatron formula: 72*L*h^2*(1 + s/6h + V/12Lh) FLOPs/token, counting
+the model's own fwd+bwd only — remat recompute burns hardware FLOPs
+but does not count as model FLOPs, which is why ``recompute="full"``
+costs ~6/8 of the roofline before hardware efficiency).
+
+``--mode generation`` instead benchmarks the decode path (sampling
+through the fixed-capacity KV cache) in decoded tokens/s — the
+reference publishes generation behavior via ``tasks/gpt/generation.py``
+but no number; this attaches one.
 """
 
+import argparse
+import functools
 import json
 import sys
 import time
@@ -22,23 +36,35 @@ from paddlefleetx_tpu.models.gpt import (  # noqa: E402
 )
 
 BASELINE_TOKENS_PER_SEC = 16200.0
+# bf16 peak of the bench chip (v5e). v5p would be 459e12.
+PEAK_FLOPS = {"tpu": 197e12}
 
 
-def main():
+def _gpt345m(on_tpu: bool, **kw):
+    return GPTConfig(
+        vocab_size=50304, hidden_size=1024, num_layers=24,
+        num_attention_heads=16, ffn_hidden_size=4096,
+        max_position_embeddings=1024, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+        dtype="bfloat16" if on_tpu else "float32",
+        use_flash_attention=on_tpu, **kw)
+
+
+def model_flops_per_token(cfg: GPTConfig, seq: int) -> float:
+    L, h, V = cfg.num_layers, cfg.hidden_size, cfg.vocab_size
+    return 72.0 * L * h * h * (1 + seq / (6.0 * h) + V / (12.0 * L * h))
+
+
+def bench_train():
     on_tpu = jax.devices()[0].platform == "tpu"
     batch, seq = (8, 1024) if on_tpu else (2, 256)
     # remat "full": the 16G v5e chip can't hold 345M fp32 states plus
     # un-rematerialized bs8/seq1024 activations (reference ran fp16 on
     # a 32G V100); recompute trades MXU flops for HBM, the TPU-native
-    # operating point.
-    cfg = GPTConfig(
-        vocab_size=50304, hidden_size=1024, num_layers=24,
-        num_attention_heads=16, ffn_hidden_size=4096,
-        max_position_embeddings=1024, hidden_dropout_prob=0.0,
-        attention_probs_dropout_prob=0.0,
-        use_recompute=on_tpu, recompute_granularity="full",
-        dtype="bfloat16" if on_tpu else "float32",
-        use_flash_attention=on_tpu)
+    # operating point. Measured r2: core_attn/full_attn OOM at bs8
+    # even with donated buffers and bf16 first moments.
+    cfg = _gpt345m(on_tpu, use_recompute=on_tpu,
+                   recompute_granularity="full")
     model = GPTForPretraining(cfg)
 
     rng = np.random.default_rng(0)
@@ -53,7 +79,9 @@ def main():
                      optax.adamw(2e-4, weight_decay=0.01))
     opt_state = tx.init(params)
 
-    @jax.jit
+    # donate params/opt_state — the engine's real train step does
+    # (engine.py donate_argnums), and undonated copies waste ~4.2G HBM
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, ids, labels, mask):
         def loss_fn(p):
             return cross_entropy_loss(
@@ -77,12 +105,74 @@ def main():
     dt = time.perf_counter() - t0
     tokens_per_sec = batch * seq * n_steps / dt
 
+    peak = PEAK_FLOPS.get(jax.devices()[0].platform)
+    mfu = (tokens_per_sec * model_flops_per_token(cfg, seq) / peak) \
+        if peak else None
     print(json.dumps({
         "metric": "gpt345m_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 3),
+        "mfu": round(mfu, 4) if mfu is not None else None,
     }))
+
+
+def bench_generation():
+    """Decode tokens/s: batch sampling through the fixed KV cache."""
+    from paddlefleetx_tpu.models.gpt.generation import (
+        GenerationConfig, generate,
+    )
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = _gpt345m(True)
+        batch, prompt_len, dec_len = 8, 128, 256
+    else:
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_attention_heads=4,
+                        max_position_embeddings=64,
+                        hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+        batch, prompt_len, dec_len = 2, 8, 16
+    model = GPTForPretraining(cfg)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, cfg.vocab_size - 2, (batch, prompt_len)),
+        jnp.int32)
+    params = jax.jit(model.init)(
+        {"params": jax.random.key(0)}, prompt)["params"]
+    gen_cfg = GenerationConfig(
+        max_dec_len=dec_len, decode_strategy="sampling", top_k=50,
+        top_p=0.75, eos_token_id=cfg.vocab_size - 1,
+        pad_token_id=cfg.vocab_size - 1)
+
+    out = generate(model, params, prompt, None, jax.random.key(1),
+                   gen_cfg)
+    np.asarray(out)  # compile + run sync
+    n_rounds = 3
+    t0 = time.perf_counter()
+    for i in range(n_rounds):
+        out = generate(model, params, prompt, None,
+                       jax.random.key(2 + i), gen_cfg)
+    np.asarray(out)
+    dt = time.perf_counter() - t0
+    decode_tps = batch * dec_len * n_rounds / dt
+    print(json.dumps({
+        "metric": "gpt345m_generation_decode_tokens_per_sec",
+        "value": round(decode_tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,  # the reference publishes no number
+    }))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--mode", choices=["train", "generation"],
+                   default="train")
+    args = p.parse_args()
+    if args.mode == "train":
+        bench_train()
+    else:
+        bench_generation()
 
 
 if __name__ == "__main__":

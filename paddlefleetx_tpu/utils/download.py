@@ -56,7 +56,7 @@ def _md5check(fullname: str, md5sum: Optional[str]) -> bool:
 
 def _download(url: str, path: str, md5sum: Optional[str] = None,
               retries: int = DOWNLOAD_RETRY_LIMIT,
-              timeout: float = 30.0) -> str:
+              timeout: float = 30.0, backoff: float = 1.0) -> str:
     """Fetch ``url`` into directory ``path`` with retry + md5 verify +
     atomic move (reference ``_download`` :71-114). The hash is checked
     on the temp file BEFORE the move, so a truncated fetch never lands
@@ -92,7 +92,9 @@ def _download(url: str, path: str, md5sum: Optional[str] = None,
                            attempt, url, e)
             if os.path.exists(tmp_fullname):
                 os.remove(tmp_fullname)
-            time.sleep(min(2 ** attempt, 8) * 0.01)
+            # second-scale backoff by default so transient blips can
+            # clear; tests pass a small factor
+            time.sleep(min(2 ** attempt, 8) * backoff)
     return fullname
 
 
@@ -103,7 +105,8 @@ def _process_rank() -> int:
     return 0
 
 
-def download(url: str, path: str, md5sum: Optional[str] = None) -> str:
+def download(url: str, path: str, md5sum: Optional[str] = None,
+             sentinel_grace: float = 120.0) -> str:
     """Rank-0 downloads; other ranks spin-wait until the file exists
     AND passes the hash (reference ``download`` :118-128 waits on
     existence only, which would accept a stale file rank 0 is still
@@ -112,21 +115,32 @@ def download(url: str, path: str, md5sum: Optional[str] = None) -> str:
     if _process_rank() != 0:
         t0 = time.time()
         sentinel = fullname + ".failed"
+        last_stat = last_ok = None
         while True:
-            if os.path.exists(fullname) and _md5check(fullname, md5sum):
-                return fullname
-            # only trust a sentinel from THIS run: a stale one left in
-            # a shared cache by a previous job must not kill the retry
-            # rank 0 is about to make (rank 0 clears it in _download,
-            # but a waiter scheduled first would see it earlier)
-            if os.path.exists(sentinel):
+            if os.path.exists(fullname):
+                # re-hash only when the file changed — a multi-GB
+                # artifact must not be fully re-read once per second
+                # while rank 0 refetches
                 try:
-                    fresh = os.path.getmtime(sentinel) >= t0 - 60.0
-                except OSError:   # rank 0 removed it mid-check
-                    fresh = False
-                if fresh:
-                    raise RuntimeError(
-                        f"rank 0 failed to download {url}")
+                    st = os.stat(fullname)
+                    stat_key = (st.st_size, st.st_mtime_ns)
+                except OSError:
+                    stat_key = None
+                if stat_key is not None:
+                    if stat_key != last_stat:
+                        last_stat = stat_key
+                        last_ok = _md5check(fullname, md5sum)
+                    if last_ok:
+                        return fullname
+            # a sentinel might be this run's failure OR a leftover a
+            # healthy rank 0 is about to clear (it removes it at the
+            # top of _download); give rank 0 a grace window to clear
+            # it, then fail fast instead of spinning out the timeout
+            if os.path.exists(sentinel) and \
+                    time.time() - t0 > sentinel_grace:
+                raise RuntimeError(
+                    f"rank 0 failed to download {url} "
+                    f"(sentinel {sentinel} persisted)")
             if time.time() - t0 > 3600.0:
                 raise TimeoutError(
                     f"timed out waiting for verified {fullname}")

@@ -145,7 +145,8 @@ def test_download_retries_then_raises(tmp_path):
     from paddlefleetx_tpu.utils import download
     missing = (tmp_path / "absent.bin").as_uri()
     with pytest.raises(RuntimeError, match="after 2 attempts"):
-        download._download(missing, str(tmp_path / "out"), retries=2)
+        download._download(missing, str(tmp_path / "out"), retries=2,
+                           backoff=0.01)
 
 
 def test_download_nonzero_rank_waits(tmp_path, monkeypatch):
@@ -177,7 +178,7 @@ def test_download_corrupt_fetch_never_lands_in_cache(tmp_path):
     dest = tmp_path / "cache"
     with pytest.raises(RuntimeError, match="failed after"):
         download._download(src.as_uri(), str(dest), md5sum=wrong,
-                           retries=2)
+                           retries=2, backoff=0.01)
     assert not (dest / "w.bin").exists()         # nothing corrupt cached
     assert (dest / "w.bin.failed").exists()      # failure sentinel
 
@@ -187,4 +188,5 @@ def test_download_waiter_sees_rank0_failure(tmp_path, monkeypatch):
     monkeypatch.setenv("PFX_RANK", "1")
     (tmp_path / "w.bin.failed").write_text("url")
     with pytest.raises(RuntimeError, match="rank 0 failed"):
-        download.download("file:///nope/w.bin", str(tmp_path))
+        download.download("file:///nope/w.bin", str(tmp_path),
+                          sentinel_grace=0.0)
