@@ -66,10 +66,10 @@ class ErnieConfig:
             model["num_hidden_layers"] = model.pop("num_layers")
         if "ffn_hidden_size" in model and "intermediate_size" not in model:
             model["intermediate_size"] = model.pop("ffn_hidden_size")
-        mix = config.get("Engine", {}).get("mix_precision", {})
+        from ...utils.config import bf16_enabled
         fields = {f.name for f in dataclasses.fields(cls)}
         kwargs = {k: v for k, v in model.items()
                   if k in fields and v is not None}
-        if mix.get("use_pure_fp16") or mix.get("dtype") == "bfloat16":
+        if bf16_enabled(config):
             kwargs.setdefault("dtype", "bfloat16")
         return cls(**kwargs)

@@ -58,8 +58,8 @@ class GPTConfig:
     @classmethod
     def from_config(cls, config) -> "GPTConfig":
         """Build from a parsed YAML tree (Model + Engine sections)."""
+        from ...utils.config import bf16_enabled
         model = dict(config.get("Model", {}))
-        mix = config.get("Engine", {}).get("mix_precision", {})
         fields = {f.name for f in dataclasses.fields(cls)}
         kwargs = {k: v for k, v in model.items()
                   if k in fields and v is not None}
@@ -67,6 +67,6 @@ class GPTConfig:
                 not model.get("recompute_granularity"):
             kwargs["recompute_granularity"] = "full"
         # AMP-O2 / use_pure_fp16 maps to bf16 compute on TPU
-        if mix.get("use_pure_fp16") or mix.get("dtype") == "bfloat16":
+        if bf16_enabled(config):
             kwargs.setdefault("dtype", "bfloat16")
         return cls(**kwargs)

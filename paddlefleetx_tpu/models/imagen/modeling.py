@@ -58,6 +58,10 @@ class ImagenConfig:
     #: SR stages: True draws one aug-noise level per sample, False one
     #: per batch (reference ``modeling.py`` per_sample_random_aug_noise_level)
     per_sample_random_aug_noise_level: bool = False
+    #: U-Net compute dtype (AMP-O2 -> bfloat16). The diffusion schedule
+    #: math stays fp32; unet inputs are cast at the call boundary so
+    #: promotion doesn't silently drag the net back to fp32.
+    dtype: str = "float32"
     p2_loss_weight_gamma: float = 0.5
     dynamic_thresholding: bool = True
     dynamic_thresholding_percentile: float = 0.95
@@ -177,12 +181,19 @@ class ImagenModel(nn.Module):
             cond_drop_mask = jax.random.uniform(drop_rng, (b,)) < \
                 cfg.cond_drop_prob
 
+        cdt = jnp.dtype(cfg.dtype)
+
+        def _c(v):
+            return v.astype(cdt) if v is not None and \
+                jnp.issubdtype(v.dtype, jnp.floating) else v
+
         pred = self.unets[i](
-            x_noisy, scheduler.get_condition(times),
-            text_embeds=text_embeds if cfg.condition_on_text else None,
+            _c(x_noisy), _c(scheduler.get_condition(times)),
+            text_embeds=_c(text_embeds) if cfg.condition_on_text
+            else None,
             text_mask=text_masks if cfg.condition_on_text else None,
-            lowres_cond_img=lowres_noisy,
-            lowres_noise_times=lowres_times_cond,
+            lowres_cond_img=_c(lowres_noisy),
+            lowres_noise_times=_c(lowres_times_cond),
             cond_drop_mask=cond_drop_mask)
 
         target = noise if self.objectives[i] == "noise" else x_start

@@ -8,6 +8,7 @@ from __future__ import annotations
 from typing import Any, Dict
 
 import jax
+import jax.numpy as jnp
 
 from .. import register_module
 from ...core.module import BasicModule
@@ -27,6 +28,12 @@ class ImagenModule(BasicModule):
         # reference SR configs name the knob only_train_unet_number
         self.unet_number = configs.Model.get("unet_number") or \
             configs.Model.get("only_train_unet_number") or 1
+        # AMP-O2: bf16 compute + fp32 master params. The U-Net layers
+        # follow input/param promotion, so casting both at the apply
+        # boundary runs the whole cascade in bf16 while the optimizer
+        # keeps fp32 masters; the criterion upcasts before the loss.
+        from ...utils.config import bf16_enabled
+        self.bf16_compute = bf16_enabled(configs)
         super().__init__(configs)
 
     def get_model(self):
@@ -35,6 +42,8 @@ class ImagenModule(BasicModule):
                        "text_encoder_name"):  # embeds are precomputed
             model_setting.pop(compat, None)
         name = model_setting.pop("name")
+        if self.bf16_compute:
+            model_setting.setdefault("dtype", "bfloat16")
         return build_imagen_model(name, **model_setting)
 
     def init_model_variables(self, model, rngs, samples):
@@ -44,6 +53,14 @@ class ImagenModule(BasicModule):
 
     def loss_fn(self, params, batch, rng, train: bool = True):
         images, text_embeds, text_masks = batch
+        if self.bf16_compute:
+            # bf16 master->compute cast of params ONLY: images stay
+            # fp32 so the diffusion schedule and the regression target
+            # (noise is drawn in x_start.dtype) keep full precision;
+            # ImagenModel casts the U-Net inputs at its call boundary
+            params = jax.tree.map(
+                lambda x: x.astype(jnp.bfloat16)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
         pred, target, log_snr, gamma = self.model.apply(
             {"params": params}, images, text_embeds, text_masks,
             unet_number=self.unet_number, rngs={"diffusion": rng})

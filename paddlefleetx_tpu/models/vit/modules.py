@@ -38,7 +38,14 @@ class GeneralClsModule(BasicModule):
         self.acc_list = []
 
     def get_model(self):
-        return build_vision_model(self.configs.Model.model)
+        model_cfg = dict(self.configs.Model.model)
+        # AMP-O2 (the fp16o2 recipes): bf16 compute + fp32 params —
+        # the reference decorates the model via paddle.amp (O2); here
+        # the dtype policy flows into the flax modules directly
+        from ...utils.config import bf16_enabled
+        if bf16_enabled(self.configs):
+            model_cfg.setdefault("dtype", "bfloat16")
+        return build_vision_model(model_cfg)
 
     def loss_fn(self, params, batch, rng, train: bool = True):
         images, labels = batch

@@ -35,8 +35,17 @@ from .log import logger, advertise
 
 __all__ = [
     "AttrDict", "parse_config", "override_config", "get_config",
-    "process_configs", "parse_args", "print_config",
+    "process_configs", "parse_args", "print_config", "bf16_enabled",
 ]
+
+
+def bf16_enabled(config) -> bool:
+    """Single point of truth for the AMP-O2 policy: does this config
+    ask for bf16 compute (with fp32 master params)? Model families
+    consult this instead of re-sniffing the mix_precision section."""
+    mix = (config.get("Engine", {}) or {}).get("mix_precision", {}) or {}
+    return bool(mix.get("use_pure_fp16")
+                or mix.get("dtype") == "bfloat16")
 
 
 class AttrDict(dict):

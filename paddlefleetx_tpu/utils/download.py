@@ -105,8 +105,7 @@ def _process_rank() -> int:
     return 0
 
 
-def download(url: str, path: str, md5sum: Optional[str] = None,
-             sentinel_grace: float = 120.0) -> str:
+def download(url: str, path: str, md5sum: Optional[str] = None) -> str:
     """Rank-0 downloads; other ranks spin-wait until the file exists
     AND passes the hash (reference ``download`` :118-128 waits on
     existence only, which would accept a stale file rank 0 is still
@@ -132,15 +131,22 @@ def download(url: str, path: str, md5sum: Optional[str] = None,
                         last_ok = _md5check(fullname, md5sum)
                     if last_ok:
                         return fullname
-            # a sentinel might be this run's failure OR a leftover a
-            # healthy rank 0 is about to clear (it removes it at the
-            # top of _download); give rank 0 a grace window to clear
-            # it, then fail fast instead of spinning out the timeout
-            if os.path.exists(sentinel) and \
-                    time.time() - t0 > sentinel_grace:
-                raise RuntimeError(
-                    f"rank 0 failed to download {url} "
-                    f"(sentinel {sentinel} persisted)")
+            # fail fast ONLY on a sentinel written during this wait
+            # (rank 0 failed just now and refreshed its mtime). A
+            # stale sentinel is ignored: a healthy rank 0 may be busy
+            # with other artifacts for minutes before clearing it, and
+            # killing the job on leftovers from a previous run is the
+            # worse failure mode — the loop timeout stays the backstop
+            # for the rare rank-0-failed-before-we-started ordering.
+            if os.path.exists(sentinel):
+                try:
+                    fresh = os.path.getmtime(sentinel) >= t0 - 5.0
+                except OSError:       # rank 0 removed it mid-check
+                    fresh = False
+                if fresh:
+                    raise RuntimeError(
+                        f"rank 0 failed to download {url} "
+                        f"(sentinel {sentinel})")
             if time.time() - t0 > 3600.0:
                 raise TimeoutError(
                     f"timed out waiting for verified {fullname}")

@@ -1,0 +1,3 @@
+#!/bin/bash
+# auto_gpt_1.3B_dp8 (reference projects/gpt/auto_gpt_1.3B_dp8.sh)
+python ./tools/auto.py -c ./configs/nlp/gpt/auto/pretrain_gpt_1.3B_dp8.yaml "$@"

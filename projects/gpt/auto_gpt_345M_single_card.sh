@@ -1,4 +1,4 @@
 #!/bin/bash
 # auto_gpt_345M_single_card (reference projects layout)
-# GSPMD is the auto engine: the auto path and the hybrid path are one code path here
-python ./tools/train.py -c ./configs/nlp/gpt/pretrain_gpt_345M_single_card.yaml "$@"
+# GSPMD is the auto engine: tools/auto.py routes to the unified trainer
+python ./tools/auto.py -c ./configs/nlp/gpt/auto/pretrain_gpt_345M_single_card.yaml "$@"

@@ -1,0 +1,3 @@
+#!/bin/bash
+# auto_gpt_6.7B_sharding16 (reference projects/gpt/auto_gpt_6.7B_sharding16.sh)
+python ./tools/auto.py -c ./configs/nlp/gpt/auto/pretrain_gpt_6.7B_sharding16.yaml "$@"

@@ -428,3 +428,58 @@ def test_per_sample_aug_noise_level():
         rngs={"diffusion": jax.random.key(2)})
     assert pred.shape == (2, 16, 16, 3)
     assert np.isfinite(np.asarray(pred)).all()
+
+
+def test_imagen_fp16o2_runs_bf16_unet_fp32_params():
+    """AMP-O2 for imagen: the U-Net computes in bf16 (inputs cast at
+    the call boundary, params cast in loss_fn) with fp32 masters."""
+    from paddlefleetx_tpu.models import build_module
+    from paddlefleetx_tpu.utils.config import AttrDict, process_configs
+
+    cfg = AttrDict({
+        "Global": AttrDict({"device": "cpu", "seed": 1,
+                            "global_batch_size": None,
+                            "local_batch_size": 1,
+                            "micro_batch_size": 1}),
+        "Engine": AttrDict({"max_steps": 1, "mix_precision":
+                            AttrDict({"use_pure_fp16": True})}),
+        "Model": AttrDict({
+            "module": "ImagenModule", "name": "imagen_397M_text2im_64",
+            "image_sizes": (16,), "text_embed_dim": 32, "timesteps": 4,
+            "unet_overrides": tuple(TINY_UNET.items()),
+        }),
+        "Loss": AttrDict({"name": "mse_loss"}),
+        "Distributed": AttrDict({"dp_degree": 1,
+                                 "sharding": AttrDict({})}),
+        "Optimizer": AttrDict({"name": "Adam",
+                               "lr": AttrDict({"learning_rate": 1e-4})}),
+        "Data": AttrDict({}),
+    })
+    process_configs(cfg, nranks=1)
+    module = build_module(cfg)
+    assert module.bf16_compute
+    assert module.model.config.dtype == "bfloat16"
+    images = jnp.asarray(
+        np.random.default_rng(0).uniform(0, 1, (1, 3, 16, 16)),
+        jnp.float32)
+    emb = jnp.zeros((1, 6, 32), jnp.float32)
+    mask = jnp.ones((1, 6), jnp.int32)
+    variables = module.init_model_variables(
+        module.model,
+        {"params": jax.random.key(0), "diffusion": jax.random.key(1)},
+        (images, emb, mask))
+    for leaf in jax.tree_util.tree_leaves(variables["params"]):
+        assert leaf.dtype == jnp.float32          # fp32 masters
+    # the prediction comes back in the unet compute dtype
+    cast = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        variables["params"])
+    pred, target, _, _ = module.model.apply(
+        {"params": cast}, images, emb, mask,
+        rngs={"diffusion": jax.random.key(2)})
+    assert pred.dtype == jnp.bfloat16             # bf16 compute
+    # and the module-level loss is still a finite fp32 scalar
+    loss = module.loss_fn(variables["params"], (images, emb, mask),
+                          jax.random.key(3))
+    assert loss.dtype == jnp.float32 and np.isfinite(float(loss))

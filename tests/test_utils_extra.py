@@ -188,5 +188,4 @@ def test_download_waiter_sees_rank0_failure(tmp_path, monkeypatch):
     monkeypatch.setenv("PFX_RANK", "1")
     (tmp_path / "w.bin.failed").write_text("url")
     with pytest.raises(RuntimeError, match="rank 0 failed"):
-        download.download("file:///nope/w.bin", str(tmp_path),
-                          sentinel_grace=0.0)
+        download.download("file:///nope/w.bin", str(tmp_path))
