@@ -66,11 +66,24 @@ def dot_product_attention(
     ``bias`` is an additive mask broadcastable to ``[b, h, sq, sk]``
     (the reference's ``attn_mask`` convention, additive -1e4 style).
     """
-    if use_flash and bias is None and dropout_rate == 0.0:
+    if use_flash and dropout_rate == 0.0:
+        # the decode kernel takes a per-key additive bias (generation's
+        # left-pad mask: [b, 1, 1, skv]); the training kernel does not
+        decode_bias_ok = causal and q.shape[1] == 1 and (
+            bias is None or
+            (bias.ndim == 4 and bias.shape[1] == bias.shape[2] == 1
+             and bias.shape[0] == q.shape[0]
+             and bias.shape[-1] == k.shape[1]))
         try:
-            from .pallas.flash_attention import flash_attention
-            return flash_attention(q, k, v, causal=causal,
-                                   query_offset=query_offset)
+            from .pallas import flash_attention as fa
+            if decode_bias_ok:
+                # cached decode: single query token, dynamic cache
+                # index — the kernel skips blocks past the index
+                return fa.flash_decode(q, k, v, query_offset,
+                                       bias=bias)
+            if bias is None:
+                return fa.flash_attention(q, k, v, causal=causal,
+                                          query_offset=query_offset)
         except (ImportError, NotImplementedError):
             pass
     return _xla_attention(q, k, v, bias, causal, query_offset, dropout_rate,

@@ -299,3 +299,127 @@ def flash_attention(q, k, v, causal: bool = True, query_offset=0,
     out = _flash(to_bh(q), to_bh(k), to_bh(v), d ** -0.5, causal,
                  block_q, block_kv)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+# -- cached decode -----------------------------------------------------
+
+
+def _decode_kernel(off_ref, q_ref, k_ref, v_ref, *refs, sm_scale,
+                   block_kv, num_kv, has_bias):
+    """Single-token decode over the fixed-capacity KV cache.
+
+    The live length is DYNAMIC (the decode loop's cache index), so it
+    arrives as a prefetched scalar: blocks wholly past the last valid
+    position are skipped — short prefixes only pay for the cache they
+    have actually filled — and the straddling block is masked. With
+    ``has_bias`` a per-key additive bias tile rides along (the
+    generation loop's left-pad mask).
+    """
+    if has_bias:
+        bias_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        bias_ref = None
+        o_ref, m_scr, l_scr, acc_scr = refs
+    ki = pl.program_id(2)
+    offset = off_ref[0]            # last valid key position
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(ki * block_kv <= offset)
+    def _block():
+        q = q_ref[0, :, 0, :]                      # [8, d]
+        k = k_ref[0, :, 0, :]                      # [bkv, d]
+        v = v_ref[0, :, 0, :]
+        s = _dot(q, k, trans_b=True) * sm_scale    # [8, bkv] f32
+        if has_bias:
+            s = s + bias_ref[0]                    # [8, bkv] additive
+        k_pos = ki * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos <= offset, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + _dot(p.astype(v.dtype), v)
+        m_scr[:] = m_new
+
+    @pl.when(ki == num_kv - 1)
+    def _finish():
+        o_ref[0, :, 0, :] = (
+            acc_scr[:] /
+            jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+
+
+def flash_decode(q, k, v, query_offset, bias=None,
+                 block_kv: int = DEFAULT_BLOCK_KV):
+    """One decode step through the cache: ``q [b, 1, h, d]`` attends to
+    ``k/v [b, S, h, d]`` positions ``<= query_offset`` (a traced
+    scalar — the fixed-capacity cache index of ``models/gpt/model.py``).
+
+    Inference-only (no VJP). Raises NotImplementedError when the
+    shape/backend can't take the kernel; the caller falls back to the
+    XLA path. The kernel indexes the cache in its NATIVE ``[b, S, h,
+    d]`` layout — no per-step relayout of the (large) cache; only the
+    single query token is padded to the 8-row sublane tile, and rows
+    1..7 compute throwaway values that are sliced off.
+    """
+    if jax.default_backend() != "tpu" and not _interpret():
+        raise NotImplementedError("flash kernel targets TPU")
+    b, sq, h, d = q.shape
+    if sq != 1:
+        raise NotImplementedError("flash_decode is single-token only")
+    skv = k.shape[1]
+    block_kv = min(block_kv, skv)
+    if skv % block_kv or block_kv % 128:
+        raise NotImplementedError(
+            f"cache length {skv} not tileable by {block_kv}")
+    if d % 128 and d not in (64,):
+        raise NotImplementedError(f"head_dim {d} unsupported")
+    num_kv = skv // block_kv
+
+    qp = jnp.pad(q, ((0, 0), (0, 7), (0, 0), (0, 0)))  # [b, 8, h, d]
+    off = jnp.reshape(jnp.asarray(query_offset, jnp.int32), (1,))
+
+    in_specs = [
+        pl.BlockSpec((1, 8, 1, d),
+                     lambda bi, hi, ki, off: (bi, 0, hi, 0)),
+        pl.BlockSpec((1, block_kv, 1, d),
+                     lambda bi, hi, ki, off: (bi, ki, hi, 0)),
+        pl.BlockSpec((1, block_kv, 1, d),
+                     lambda bi, hi, ki, off: (bi, ki, hi, 0)),
+    ]
+    operands = [qp, k, v]
+    if bias is not None:
+        # per-key additive bias (the generation loop's left-pad mask),
+        # [b, skv] or broadcastable [b, 1, 1, skv] -> [b, 8, skv] tiles
+        bias = jnp.reshape(bias.astype(jnp.float32), (b, 1, skv))
+        operands.append(jnp.broadcast_to(bias, (b, 8, skv)))
+        in_specs.append(pl.BlockSpec(
+            (1, 8, block_kv), lambda bi, hi, ki, off: (bi, 0, ki)))
+
+    kernel = functools.partial(_decode_kernel, sm_scale=d ** -0.5,
+                               block_kv=block_kv, num_kv=num_kv,
+                               has_bias=bias is not None)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, h, num_kv),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (1, 8, 1, d), lambda bi, hi, ki, off: (bi, 0, hi, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((8, 1), jnp.float32),
+                pltpu.VMEM((8, 1), jnp.float32),
+                pltpu.VMEM((8, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, 8, h, d), q.dtype),
+        interpret=_interpret(),
+    )(off, *operands)
+    return out[:, :1]

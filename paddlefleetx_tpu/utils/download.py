@@ -114,6 +114,20 @@ def download(url: str, path: str, md5sum: Optional[str] = None) -> str:
     if _process_rank() != 0:
         t0 = time.time()
         sentinel = fullname + ".failed"
+        # establish "now" in the FILESYSTEM's clock: sentinel mtimes
+        # come from the file server, which may be skewed from
+        # time.time() on shared storage
+        fs_t0 = t0
+        try:
+            os.makedirs(path, exist_ok=True)
+            probe = os.path.join(
+                path, f".waitprobe.{os.getpid()}.{_process_rank()}")
+            with open(probe, "w"):
+                pass
+            fs_t0 = os.path.getmtime(probe)
+            os.remove(probe)
+        except OSError:
+            pass
         last_stat = last_ok = None
         while True:
             if os.path.exists(fullname):
@@ -140,7 +154,7 @@ def download(url: str, path: str, md5sum: Optional[str] = None) -> str:
             # for the rare rank-0-failed-before-we-started ordering.
             if os.path.exists(sentinel):
                 try:
-                    fresh = os.path.getmtime(sentinel) >= t0 - 5.0
+                    fresh = os.path.getmtime(sentinel) >= fs_t0 - 5.0
                 except OSError:       # rank 0 removed it mid-check
                     fresh = False
                 if fresh:

@@ -184,8 +184,41 @@ def test_download_corrupt_fetch_never_lands_in_cache(tmp_path):
 
 
 def test_download_waiter_sees_rank0_failure(tmp_path, monkeypatch):
+    """A sentinel written MID-WAIT (rank 0 just failed) fails the
+    waiter fast; a pre-existing stale sentinel alone must not."""
     from paddlefleetx_tpu.utils import download
     monkeypatch.setenv("PFX_RANK", "1")
-    (tmp_path / "w.bin.failed").write_text("url")
+
+    def fail_rank0():
+        time.sleep(1.5)
+        (tmp_path / "w.bin.failed").write_text("url")
+
+    t = threading.Thread(target=fail_rank0)
+    t.start()
+    t0 = time.time()
     with pytest.raises(RuntimeError, match="rank 0 failed"):
         download.download("file:///nope/w.bin", str(tmp_path))
+    t.join()
+    assert time.time() - t0 < 30            # fail-fast, not timeout
+
+
+def test_download_waiter_ignores_stale_sentinel(tmp_path, monkeypatch):
+    """A leftover sentinel from a previous run is ignored — the waiter
+    keeps waiting and picks up the file rank 0 lands."""
+    import os as _os
+    from paddlefleetx_tpu.utils import download
+    monkeypatch.setenv("PFX_RANK", "1")
+    sentinel = tmp_path / "w.bin.failed"
+    sentinel.write_text("old run")
+    past = time.time() - 3600
+    _os.utime(sentinel, (past, past))        # stale by an hour
+
+    def rank0_lands_file():
+        time.sleep(1.5)
+        (tmp_path / "w.bin").write_bytes(b"fresh")
+
+    t = threading.Thread(target=rank0_lands_file)
+    t.start()
+    got = download.download("file:///srv/w.bin", str(tmp_path))
+    t.join()
+    assert open(got, "rb").read() == b"fresh"
