@@ -55,6 +55,19 @@ def _dot(a, b, trans_a=False, trans_b=False):
 # -- forward -----------------------------------------------------------
 
 
+def _online_update(s, v, m_scr, l_scr, acc_scr):
+    """One online-softmax accumulator step over a masked score block
+    (shared by the training forward and the decode kernel — the
+    rescale math is numerically delicate and must not fork)."""
+    m_prev = m_scr[:]                              # [bq, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + _dot(p.astype(v.dtype), v)
+    m_scr[:] = m_new
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
                 acc_scr, *, sm_scale, causal, block_q, block_kv, num_kv,
                 query_offset):
@@ -77,13 +90,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
             s = jnp.where(
                 _causal_mask(qi, ki, block_q, block_kv, query_offset),
                 s, NEG_INF)
-        m_prev = m_scr[:]                              # [bq, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + _dot(p.astype(v.dtype), v)
-        m_scr[:] = m_new
+        _online_update(s, v, m_scr, l_scr, acc_scr)
 
     @pl.when(ki == num_kv - 1)
     def _finish():
@@ -336,17 +343,11 @@ def _decode_kernel(off_ref, q_ref, k_ref, v_ref, *refs, sm_scale,
         v = v_ref[0, :, 0, :]
         s = _dot(q, k, trans_b=True) * sm_scale    # [8, bkv] f32
         if has_bias:
-            s = s + bias_ref[0]                    # [8, bkv] additive
+            s = s + bias_ref[0]                    # [1, bkv] broadcasts
         k_pos = ki * block_kv + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
         s = jnp.where(k_pos <= offset, s, NEG_INF)
-        m_prev = m_scr[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + _dot(p.astype(v.dtype), v)
-        m_scr[:] = m_new
+        _online_update(s, v, m_scr, l_scr, acc_scr)
 
     @pl.when(ki == num_kv - 1)
     def _finish():
@@ -385,22 +386,34 @@ def flash_decode(q, k, v, query_offset, bias=None,
     qp = jnp.pad(q, ((0, 0), (0, 7), (0, 0), (0, 0)))  # [b, 8, h, d]
     off = jnp.reshape(jnp.asarray(query_offset, jnp.int32), (1,))
 
+    # clamp the kv block index once past the live length: skipped
+    # iterations re-reference the already-resident block, so the
+    # HBM->VMEM copy is elided and a short prefix pays only for the
+    # cache it has actually filled (the compute skip alone would
+    # still stream the full capacity)
+    def kv_block(ki, off):
+        return jnp.minimum(ki, off[0] // block_kv)
+
     in_specs = [
         pl.BlockSpec((1, 8, 1, d),
                      lambda bi, hi, ki, off: (bi, 0, hi, 0)),
         pl.BlockSpec((1, block_kv, 1, d),
-                     lambda bi, hi, ki, off: (bi, ki, hi, 0)),
+                     lambda bi, hi, ki, off: (bi, kv_block(ki, off),
+                                              hi, 0)),
         pl.BlockSpec((1, block_kv, 1, d),
-                     lambda bi, hi, ki, off: (bi, ki, hi, 0)),
+                     lambda bi, hi, ki, off: (bi, kv_block(ki, off),
+                                              hi, 0)),
     ]
     operands = [qp, k, v]
     if bias is not None:
         # per-key additive bias (the generation loop's left-pad mask),
-        # [b, skv] or broadcastable [b, 1, 1, skv] -> [b, 8, skv] tiles
-        bias = jnp.reshape(bias.astype(jnp.float32), (b, 1, skv))
-        operands.append(jnp.broadcast_to(bias, (b, 8, skv)))
+        # [b, skv] or broadcastable [b, 1, 1, skv]; a [1, bkv] row
+        # broadcasts against the [8, bkv] scores inside the kernel
+        operands.append(jnp.reshape(bias.astype(jnp.float32),
+                                    (b, 1, skv)))
         in_specs.append(pl.BlockSpec(
-            (1, 8, block_kv), lambda bi, hi, ki, off: (bi, 0, ki)))
+            (1, 1, block_kv),
+            lambda bi, hi, ki, off: (bi, 0, kv_block(ki, off))))
 
     kernel = functools.partial(_decode_kernel, sm_scale=d ** -0.5,
                                block_kv=block_kv, num_kv=num_kv,

@@ -1,0 +1,3 @@
+#!/bin/bash
+# pretrain_ernie_345M (reference projects/ernie/pretrain_ernie_345M.sh)
+python ./tools/train.py -c ./configs/nlp/ernie/pretrain_ernie_345M_single_card.yaml "$@"
