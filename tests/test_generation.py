@@ -204,3 +204,36 @@ def test_hamming_diversity_validation():
         hamming_diversity_processor(s, t, 1, 0.5, 1, 2)
     with _pytest.raises(ValueError, match="num_beam_groups"):
         hamming_diversity_processor(s, t, 1, 0.5, 4, 1)
+
+
+def test_generation_tp4_matches_single_device(model_and_params):
+    """Generation with mp-sharded params (vocab-sharded logits — the
+    reference's GPTForGenerationHybrid parallel_matmul story) samples
+    exactly the single-device tokens."""
+    import flax.linen as nn
+    from paddlefleetx_tpu.parallel import (
+        TopologyConfig, build_mesh, make_sharding_rules,
+    )
+
+    model, params = model_and_params
+    prompt = jnp.asarray(
+        np.random.default_rng(7).integers(0, 90, (4, 6)), jnp.int32)
+    gen_cfg = GenerationConfig(
+        max_dec_len=5, decode_strategy="greedy_search",
+        eos_token_id=EOS, pad_token_id=PAD)
+    single = np.asarray(generate(model, params, prompt, None,
+                                 jax.random.key(2), gen_cfg))
+
+    topo = TopologyConfig(mp_degree=4, dp_degree=2)
+    mesh = build_mesh(topo)
+    rules = make_sharding_rules(topo)
+    logical = nn.get_partition_spec(
+        jax.eval_shape(model.init, {"params": jax.random.key(0)},
+                       jnp.zeros((1, 8), jnp.int32)))
+    shardings = nn.logical_to_mesh_sharding(logical, mesh, list(rules))
+    params_s = jax.device_put({"params": params},
+                              nn.meta.unbox(shardings))["params"]
+    with mesh, nn.logical_axis_rules(list(rules)):
+        dist = np.asarray(generate(model, params_s, prompt, None,
+                                   jax.random.key(2), gen_cfg))
+    np.testing.assert_array_equal(dist, single)
