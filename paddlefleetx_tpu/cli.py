@@ -1,0 +1,137 @@
+"""Console entry points (``pfx-train`` etc., pyproject [project.scripts]).
+
+The ``tools/*.py`` scripts (reference layout ``tools/train.py:37-67``,
+``tools/auto.py:37-60``, ``tools/eval.py:33-53``,
+``tools/export.py:32-49``, ``tools/inference.py:37-59``) delegate
+here, so the repo-checkout and pip-installed surfaces run the same
+code.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _maybe_virtual_cpu_mesh() -> None:
+    """PFX_CPU_DEVICES=N: run any topology on an N-device virtual CPU
+    mesh (podless correctness runs). Routed through jax.config — site
+    customization may force another platform before env vars are read.
+    """
+    if os.environ.get("PFX_CPU_DEVICES"):
+        from .parallel.mesh import cpu_mesh_env
+        cpu_mesh_env(int(os.environ["PFX_CPU_DEVICES"]))
+
+
+def train_main(argv=None):
+    _maybe_virtual_cpu_mesh()
+    from .core import Engine
+    from .data import build_dataloader
+    from .models import build_module
+    from .parallel.mesh import process_data_loader_count, \
+        process_data_rank
+    from .utils import env
+    from .utils.config import get_config, parse_args
+    from .utils.log import logger
+
+    args = parse_args(argv)
+    env.init_dist_env()
+    cfg = get_config(args.config, overrides=args.override, show=True)
+
+    module = build_module(cfg)
+    engine = Engine(cfg, module, mode="train")
+
+    data_world = process_data_loader_count(engine.mesh)
+    rank = process_data_rank(engine.mesh)
+    train_loader = build_dataloader(cfg.Data, "Train",
+                                    num_replicas=data_world, rank=rank)
+    valid_loader = build_dataloader(cfg.Data, "Eval",
+                                    num_replicas=data_world, rank=rank)
+    if train_loader is not None:
+        # per-process slice of the global batch
+        train_loader.batch_sampler.batch_size = \
+            cfg.Global.global_batch_size // data_world
+    if valid_loader is not None:
+        valid_loader.batch_sampler.batch_size = \
+            cfg.Global.global_batch_size // data_world
+
+    engine.fit(epoch=cfg.Engine.get("num_train_epochs", 1),
+               train_data_loader=train_loader,
+               valid_data_loader=valid_loader)
+    logger.info("training finished")
+
+
+def auto_main(argv=None):
+    """GSPMD is the auto engine — the auto schema runs the same
+    trainer (SURVEY §7 design stance)."""
+    train_main(argv)
+
+
+def eval_main(argv=None):
+    _maybe_virtual_cpu_mesh()
+    from .core import Engine
+    from .data import build_dataloader
+    from .models import build_module
+    from .utils.config import get_config, parse_args
+
+    args = parse_args(argv)
+    cfg = get_config(args.config, overrides=args.override, show=True)
+    cfg.Model.module = "GPTEvalModule"
+    module = build_module(cfg)
+    engine = Engine(cfg, module, mode="eval")
+    loader = build_dataloader(cfg.Data, "Eval")
+    engine.evaluate(epoch=0, valid_data_loader=loader)
+    return module.metrics
+
+
+def export_main(argv=None):
+    _maybe_virtual_cpu_mesh()
+    from .core import Engine
+    from .models import build_module
+    from .utils import env
+    from .utils.config import get_config, parse_args
+    from .utils.log import logger
+
+    args = parse_args(argv)
+    env.init_dist_env()
+    cfg = get_config(args.config, overrides=args.override, show=True)
+    module = build_module(cfg)
+    engine = Engine(cfg, module, mode="export")
+    if cfg.Engine.save_load.get("ckpt_dir"):
+        engine.load()
+    path = engine.export()
+    logger.info("export finished: %s", path)
+    return path
+
+
+def eval_script(argv=None):
+    """Console wrapper: setuptools runs ``sys.exit(main())``, so the
+    script entry must not return eval_main's metrics dict."""
+    eval_main(argv)
+
+
+def export_script(argv=None):
+    export_main(argv)
+
+
+def inference_main(argv=None):
+    _maybe_virtual_cpu_mesh()
+    import numpy as np
+
+    from .core import Engine
+    from .data import build_dataloader
+    from .models import build_module
+    from .utils import env
+    from .utils.config import get_config, parse_args
+    from .utils.log import logger
+
+    args = parse_args(argv)
+    env.init_dist_env()
+    cfg = get_config(args.config, overrides=args.override, show=False)
+    module = build_module(cfg)
+    engine = Engine(cfg, module, mode="inference")
+
+    loader = build_dataloader(cfg.Data, "Test")
+    for i, batch in enumerate(loader):
+        outs = engine.inference([np.asarray(x) for x in batch])
+        logger.info("batch %d -> %s", i,
+                    {k: v.shape for k, v in outs.items()})

@@ -3,8 +3,8 @@
 Parity: reference ``tools/auto.py:37-60`` drives Paddle's semi-auto
 engine (annotate-then-partition). On TPU, GSPMD *is* that engine —
 one unified code path serves both the reference's eager-hybrid and
-auto configs (SURVEY §7 design stance) — so this entry point runs the
-same trainer; ``GPTModuleAuto`` configs resolve to the same module.
+auto configs (SURVEY §7 design stance); the auto schema
+(``configs/nlp/gpt/auto/*``) parses into the same trainer.
 """
 
 import os
@@ -12,7 +12,7 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from paddlefleetx_tpu.cli import auto_main  # noqa: E402
+
 if __name__ == "__main__":
-    import runpy
-    runpy.run_path(os.path.join(os.path.dirname(__file__), "train.py"),
-                   run_name="__main__")
+    auto_main()

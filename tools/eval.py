@@ -11,22 +11,7 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from paddlefleetx_tpu.core import Engine  # noqa: E402
-from paddlefleetx_tpu.data import build_dataloader  # noqa: E402
-from paddlefleetx_tpu.models import build_module  # noqa: E402
-from paddlefleetx_tpu.utils.config import get_config, parse_args  # noqa: E402
-
-
-def main():
-    args = parse_args()
-    cfg = get_config(args.config, overrides=args.override, show=True)
-    cfg.Model.module = "GPTEvalModule"
-    module = build_module(cfg)
-    engine = Engine(cfg, module, mode="eval")
-    loader = build_dataloader(cfg.Data, "Eval")
-    engine.evaluate(epoch=0, valid_data_loader=loader)
-    return module.metrics
-
+from paddlefleetx_tpu.cli import eval_main  # noqa: E402
 
 if __name__ == "__main__":
-    main()
+    eval_main()

@@ -6,24 +6,7 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from paddlefleetx_tpu.core import Engine  # noqa: E402
-from paddlefleetx_tpu.models import build_module  # noqa: E402
-from paddlefleetx_tpu.utils import env  # noqa: E402
-from paddlefleetx_tpu.utils.config import get_config, parse_args  # noqa: E402
-from paddlefleetx_tpu.utils.log import logger  # noqa: E402
-
-
-def main():
-    args = parse_args()
-    env.init_dist_env()
-    cfg = get_config(args.config, overrides=args.override, show=True)
-    module = build_module(cfg)
-    engine = Engine(cfg, module, mode="export")
-    if cfg.Engine.save_load.get("ckpt_dir"):
-        engine.load()
-    path = engine.export()
-    logger.info("export finished: %s", path)
-
+from paddlefleetx_tpu.cli import export_main  # noqa: E402
 
 if __name__ == "__main__":
-    main()
+    export_main()

@@ -6,29 +6,7 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import numpy as np  # noqa: E402
-
-from paddlefleetx_tpu.core import Engine  # noqa: E402
-from paddlefleetx_tpu.data import build_dataloader  # noqa: E402
-from paddlefleetx_tpu.models import build_module  # noqa: E402
-from paddlefleetx_tpu.utils import env  # noqa: E402
-from paddlefleetx_tpu.utils.config import get_config, parse_args  # noqa: E402
-from paddlefleetx_tpu.utils.log import logger  # noqa: E402
-
-
-def main():
-    args = parse_args()
-    env.init_dist_env()
-    cfg = get_config(args.config, overrides=args.override, show=False)
-    module = build_module(cfg)
-    engine = Engine(cfg, module, mode="inference")
-
-    loader = build_dataloader(cfg.Data, "Test")
-    for i, batch in enumerate(loader):
-        outs = engine.inference([np.asarray(x) for x in batch])
-        logger.info("batch %d -> %s", i,
-                    {k: v.shape for k, v in outs.items()})
-
+from paddlefleetx_tpu.cli import inference_main  # noqa: E402
 
 if __name__ == "__main__":
-    main()
+    inference_main()

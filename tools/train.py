@@ -5,6 +5,9 @@ distributed env, build module/dataloaders/engine, fit. Run as:
 
   python tools/train.py -c configs/nlp/gpt/pretrain_gpt_345M_single_card.yaml \
       -o Engine.max_steps=100
+
+The logic lives in ``paddlefleetx_tpu.cli`` (shared with the
+``pfx-train`` console script).
 """
 
 import os
@@ -12,53 +15,7 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-if os.environ.get("PFX_CPU_DEVICES"):
-    # virtual CPU mesh for podless topology runs (site customization
-    # may force another platform before env vars are read, so this
-    # goes through jax.config, not the environment)
-    from paddlefleetx_tpu.parallel.mesh import cpu_mesh_env
-    cpu_mesh_env(int(os.environ["PFX_CPU_DEVICES"]))
-
-import jax  # noqa: E402
-
-from paddlefleetx_tpu.core import Engine  # noqa: E402
-from paddlefleetx_tpu.data import build_dataloader  # noqa: E402
-from paddlefleetx_tpu.models import build_module  # noqa: E402
-from paddlefleetx_tpu.utils import env  # noqa: E402
-from paddlefleetx_tpu.utils.config import get_config, parse_args  # noqa: E402
-from paddlefleetx_tpu.utils.log import logger  # noqa: E402
-
-
-def main():
-    args = parse_args()
-    env.init_dist_env()
-    cfg = get_config(args.config, overrides=args.override, show=True)
-
-    module = build_module(cfg)
-    engine = Engine(cfg, module, mode="train")
-
-    from paddlefleetx_tpu.parallel.mesh import (
-        process_data_loader_count, process_data_rank,
-    )
-    data_world = process_data_loader_count(engine.mesh)
-    rank = process_data_rank(engine.mesh)
-    train_loader = build_dataloader(cfg.Data, "Train",
-                                    num_replicas=data_world, rank=rank)
-    valid_loader = build_dataloader(cfg.Data, "Eval",
-                                    num_replicas=data_world, rank=rank)
-    if train_loader is not None:
-        # per-process slice of the global batch
-        train_loader.batch_sampler.batch_size = \
-            cfg.Global.global_batch_size // data_world
-    if valid_loader is not None:
-        valid_loader.batch_sampler.batch_size = \
-            cfg.Global.global_batch_size // data_world
-
-    engine.fit(epoch=cfg.Engine.get("num_train_epochs", 1),
-               train_data_loader=train_loader,
-               valid_data_loader=valid_loader)
-    logger.info("training finished")
-
+from paddlefleetx_tpu.cli import train_main  # noqa: E402
 
 if __name__ == "__main__":
-    main()
+    train_main()
