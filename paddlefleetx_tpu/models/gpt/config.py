@@ -35,6 +35,12 @@ class GPTConfig:
     use_flash_attention: bool = False     # Pallas kernel on TPU
     context_parallel: bool = False        # ring attention over the cp
     #                                       mesh axis (long context)
+    #: >1: compute the LM loss over this many sequence chunks inside a
+    #: rematerialized scan — the [b, s, V] logits tensor (the largest
+    #: single activation: bs8 x s1024 x 50304 is 1.6 GB fp32) never
+    #: materializes beyond one chunk. Trades one extra head matmul
+    #: per chunk in backward for O(s/chunks) logits memory.
+    loss_chunks: int = 1
     dtype: str = "float32"                # compute dtype (bf16 for AMP-O2)
     param_dtype: str = "float32"
 

@@ -330,11 +330,19 @@ class GPTForPretraining(nn.Module):
         x = GPTModel(self.config, name="gpt")(
             input_ids, position_ids, attn_bias, use_cache, deterministic,
             position_offset)
-        word_emb = self.variables["params"]["gpt"]["embeddings"][
-            "word_embeddings"]
-        if isinstance(word_emb, nn.Partitioned):
-            word_emb = word_emb.value
+        word_emb = _word_embedding(
+            self.variables["params"]["gpt"]["embeddings"])
         return tied_logits(x, word_emb)
+
+
+def _word_embedding(emb_params) -> jax.Array:
+    """The (possibly Partitioned-boxed) tied embedding table from an
+    embeddings param subtree — single unboxing point for the LM head,
+    the pipelined loss, and the chunked loss."""
+    word_emb = emb_params["word_embeddings"]
+    if isinstance(word_emb, nn.Partitioned):
+        word_emb = word_emb.value
+    return word_emb
 
 
 def tied_logits(x: jax.Array, word_emb: jax.Array) -> jax.Array:
@@ -407,9 +415,7 @@ def pipelined_lm_loss(cfg: GPTConfig, params, input_ids, labels,
 
     ln = _final_norm(cfg)
     fn_params = params["gpt"]["final_norm"]
-    word_emb = emb_params["word_embeddings"]
-    if isinstance(word_emb, nn.Partitioned):
-        word_emb = word_emb.value
+    word_emb = _word_embedding(emb_params)
 
     def head_and_loss(acc, y, ex):
         # per-microbatch masked mean, averaged over microbatches below —
@@ -439,3 +445,49 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
     """
     nll_sum, mask_sum = masked_nll_sums(logits, labels, loss_mask)
     return nll_sum / jnp.maximum(mask_sum, 1.0)
+
+
+def chunked_lm_loss(model: "GPTForPretraining", params, input_ids,
+                    labels, loss_mask, *, chunks: int,
+                    position_ids=None, deterministic: bool = True,
+                    rngs=None) -> jax.Array:
+    """Masked-CE pretraining loss with the LM head + softmax computed
+    over ``chunks`` sequence chunks inside a rematerialized scan.
+
+    Under ``deterministic=True`` this is numerically identical to
+    ``cross_entropy_loss(model.apply(...))`` — the per-token NLL sums
+    are exact, not chunk-mean-of-means. (With dropout the two paths
+    draw different masks: flax folds the module path into dropout
+    keys, and here ``GPTModel`` is the top-level module.) But
+    the ``[b, s, V]`` logits — the largest single activation of
+    GPT-class training (1.6 GB fp32 at bs8/s1024/V50304) — never
+    materialize beyond ``[b, s/chunks, V]``. ``jax.checkpoint`` makes
+    the backward recompute each chunk's logits instead of saving them:
+    one extra head matmul per chunk buys O(s/chunks) logits memory.
+    """
+    cfg = model.config
+    b, s = input_ids.shape
+    if s % chunks:
+        raise ValueError(
+            f"loss_chunks ({chunks}) must divide the sequence length "
+            f"({s})")
+    h = GPTModel(cfg).apply({"params": params["gpt"]}, input_ids,
+                            position_ids, None, False, deterministic,
+                            rngs=rngs)
+    word_emb = _word_embedding(params["gpt"]["embeddings"])
+
+    csz = s // chunks
+    hc = h.reshape(b, chunks, csz, h.shape[-1]).swapaxes(0, 1)
+    lc = labels.reshape(b, chunks, csz).swapaxes(0, 1)
+    mc = loss_mask.reshape(b, chunks, csz).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def body(carry, xs):
+        hh, ll, mm = xs
+        nll, msum = masked_nll_sums(tied_logits(hh, word_emb), ll, mm)
+        return (carry[0] + nll, carry[1] + msum), None
+
+    (nll, msum), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (hc, lc, mc))
+    return nll / jnp.maximum(msum, 1.0)

@@ -60,6 +60,15 @@ class GPTModule(LanguageModule):
             and self.model_config.attention_probs_dropout_prob == 0.0)
         pp = (self.configs.get("Distributed") or {}).get("pp_degree", 1) \
             or 1
+        if self.model_config.loss_chunks > 1 and \
+                (pp > 1 or self.qat_cfg.enable):
+            # a silent dense fallback would defeat the knob's
+            # O(s/chunks) logits-memory purpose (same policy as the
+            # cp guard above)
+            raise ValueError(
+                "loss_chunks > 1 is not supported with pipeline "
+                "parallelism or QAT; the pp path computes per-"
+                "microbatch logits already")
         if pp > 1:
             if self.qat_cfg.enable:
                 raise ValueError("QAT is not supported with pipeline "
@@ -76,6 +85,13 @@ class GPTModule(LanguageModule):
                 pp=pp, num_microbatches=m, rng=rng,
                 position_ids=position_ids, deterministic=deterministic)
         rngs = None if deterministic else {"dropout": rng}
+        if self.model_config.loss_chunks > 1:
+            from .model import chunked_lm_loss
+            return chunked_lm_loss(
+                self.model, params, tokens, labels, loss_mask,
+                chunks=self.model_config.loss_chunks,
+                position_ids=position_ids, deterministic=deterministic,
+                rngs=rngs)
         if self.qat_cfg.enable:
             from ...ops.quantization import qat_apply
             logits = qat_apply(
