@@ -126,6 +126,10 @@ class Engine(BasicEngine):
             self._prof_active = False
             logger.warning("Profiler is enabled, do not enable it in "
                            "production.")
+        #: logged step costs for the post-run summary (reference
+        #: ``_print_summary``, eager_engine.py:684-721 — device-time
+        #: tables live in the XProf trace; this is the host view)
+        self._step_costs = []
         self._init_state()
         self._build_steps()
         if self.ckpt_dir:
@@ -404,6 +408,7 @@ class Engine(BasicEngine):
     def fit(self, epoch: int = 1, train_data_loader=None,
             valid_data_loader=None):
         self._finalize_vit_schedule(train_data_loader)
+        self._step_costs = []   # per-fit summary samples
         start_epoch = self._load_recovery["epoch"]
         consumed = self._load_recovery["consumed_samples"]
         for ep in range(start_epoch, epoch):
@@ -434,11 +439,14 @@ class Engine(BasicEngine):
             jax.block_until_ready(self.state["step"])
             jax.profiler.stop_trace()
             self._prof_active = False
+        if self._prof_window is not None:
+            self._print_summary()
         set_mesh(None)
 
     def _train_one_epoch(self, epoch: int, train_data_loader,
                          valid_data_loader=None):
         step_start = time.time()
+        window_clean = True
         # host-side mirror of state["step"]: reading the device scalar
         # every iteration would sync and kill async dispatch
         step = self._host_step
@@ -462,6 +470,12 @@ class Engine(BasicEngine):
                         "grad_norm": float(metrics["grad_norm"]),
                         "train_cost": cost,
                     })
+                    # summary samples: only clean windows (a mid-window
+                    # eval/save resets step_start, which would skew the
+                    # per-step quotient), only when profiling
+                    if self._prof_window is not None and window_clean:
+                        self._step_costs.append(cost)
+                    window_clean = True
                     step_start = time.time()
                 if self.run_mode == "step" and \
                         step % self.eval_freq == 0 and \
@@ -469,9 +483,43 @@ class Engine(BasicEngine):
                     self._evaluate_impl(epoch, valid_data_loader,
                                         max_iters=self.eval_iters)
                     step_start = time.time()
+                    window_clean = False
                 if step % self.save_steps == 0:
                     self.save(epoch)
                     step_start = time.time()
+                    window_clean = False
+
+    def _print_summary(self) -> None:
+        """Post-run host-time summary (reference ``_print_summary``
+        prints device-time tables; the device view here lives in the
+        XProf trace — this prints the step-time overview)."""
+        costs = self._step_costs
+        if not costs:
+            return
+        # skip the first window: it usually contains the jit compile
+        steady = costs[1:] or costs
+        mean = sum(steady) / len(steady)
+        logger.info("-" * 60)
+        logger.info("Profiler summary (host step times, %d windows of "
+                    "%d steps)", len(costs), self.logging_freq)
+        logger.info("  first window (incl. compile): %.4f s/step",
+                    costs[0])
+        logger.info("  steady state: mean %.4f / min %.4f / max %.4f "
+                    "s/step (%.2f step/s)", mean, min(steady),
+                    max(steady), 1.0 / mean if mean else 0.0)
+        from .module import LanguageModule
+        tokens = self.global_batch_size * self.configs.get(
+            "Data", {}).get("Train", {}).get("dataset", {}).get(
+            "max_seq_len", 0)
+        # tokens/s only means something for language modules (vision/
+        # multimodal step logs already carry images/sec)
+        if tokens and mean > 0 and isinstance(self.module,
+                                              LanguageModule):
+            logger.info("  throughput: %.0f tokens/s (global batch %d)",
+                        tokens / mean, self.global_batch_size)
+        logger.info("  device-time breakdown: open %s with "
+                    "TensorBoard's profile plugin", self._prof_dir)
+        logger.info("-" * 60)
 
     def _profiler_step(self, step: int) -> None:
         """Start/stop the jax.profiler trace at the configured window

@@ -259,3 +259,22 @@ def test_sharding_offload_downgrades_on_cpu(tmp_path, monkeypatch):
     assert any("sharding_offload" in w for w in warnings)  # loudly
     engine.fit(epoch=1, train_data_loader=loader)
     assert int(engine.state["step"]) == 2
+
+
+def test_profiler_summary_printed(tmp_path, monkeypatch):
+    """With the profiler window configured, fit() ends with a host
+    step-time summary (reference _print_summary parity)."""
+    from paddlefleetx_tpu.utils.log import logger as pfx_logger
+    lines = []
+    monkeypatch.setattr(
+        pfx_logger, "info",
+        lambda msg, *a, **k: lines.append(msg % a if a else str(msg)))
+    cfg, engine, loader = _build(tmp_path, **{"Engine.max_steps": 6,
+                                              "Engine.logging_freq": 2})
+    engine._prof_window = (2, 4)
+    engine._prof_dir = str(tmp_path / "prof")
+    engine._prof_active = False
+    engine.fit(epoch=1, train_data_loader=loader)
+    assert any("Profiler summary" in l for l in lines)
+    assert any("steady state" in l for l in lines)
+    assert any("tokens/s" in l for l in lines)
