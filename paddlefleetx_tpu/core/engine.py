@@ -285,7 +285,15 @@ class Engine(BasicEngine):
                 return module.loss_fn(p, mb, rng, train=True)
 
             if acc == 1:
-                loss, grads = jax.value_and_grad(loss_for)(params, batch)
+                # modules may fuse loss+grad into one pass (GPT's 1F1B
+                # pipeline schedule computes both in a single scan);
+                # default is plain autodiff
+                lag = getattr(module, "loss_and_grad", None)
+                if lag is not None:
+                    loss, grads = lag(params, batch, rng)
+                else:
+                    loss, grads = jax.value_and_grad(loss_for)(
+                        params, batch)
             else:
                 micro = jax.tree.map(
                     lambda x: x.reshape(acc, x.shape[0] // acc,

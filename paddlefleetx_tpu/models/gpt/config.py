@@ -30,6 +30,10 @@ class GPTConfig:
     fuse_attn_qkv: bool = True
     sequence_parallel: bool = False
     virtual_pp_degree: int = 1
+    #: pipeline schedule when pp_degree > 1. "1F1B" (reference default,
+    #: bounded activation memory via the explicit fwd/bwd-interleaved
+    #: schedule) or "GPipe" (all-forwards-then-autodiff).
+    pipeline_schedule: str = "1F1B"
     # TPU-specific knobs (absent in reference):
     scan_layers: bool = True              # lax.scan over layers
     use_flash_attention: bool = False     # Pallas kernel on TPU
@@ -56,6 +60,10 @@ class GPTConfig:
             raise ValueError(
                 f"unknown recompute_granularity "
                 f"{self.recompute_granularity!r}")
+        if self.pipeline_schedule not in ("1F1B", "GPipe"):
+            raise ValueError(
+                f"unknown pipeline_schedule {self.pipeline_schedule!r} "
+                f"(expected '1F1B' or 'GPipe')")
 
     @property
     def head_dim(self) -> int:

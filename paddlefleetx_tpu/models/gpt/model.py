@@ -370,9 +370,40 @@ def masked_nll_sums(logits: jax.Array, labels: jax.Array,
     return jnp.sum((logz - label_logits) * mask), jnp.sum(mask)
 
 
+def _pipeline_parts(cfg: GPTConfig, input_ids, position_ids,
+                    deterministic: bool, rng):
+    """Shared setup for the pipelined loss paths: embedding output,
+    the per-layer apply fn (remat-wrapped), final norm + tied head
+    pieces, and the split rngs."""
+    if not cfg.scan_layers:
+        raise ValueError("pipeline parallelism requires scan_layers=True "
+                         "(stacked decoder params)")
+    if position_ids is None:
+        position_ids = jnp.broadcast_to(
+            jnp.arange(input_ids.shape[-1], dtype=jnp.int32)[None, :],
+            input_ids.shape)
+    rng = rng if rng is not None else jax.random.key(0)
+    emb_rng, pipe_rng = jax.random.split(rng)
+
+    def emb_fwd(ep):
+        return GPTEmbeddings(cfg).apply(
+            {"params": ep}, input_ids, position_ids, deterministic,
+            rngs=None if deterministic else {"dropout": emb_rng})
+
+    def layer_apply(lp, h, key):
+        return TransformerDecoderLayer(cfg, scanned=False).apply(
+            {"params": lp}, h, None, False, deterministic,
+            rngs=None if deterministic else {"dropout": key})
+    if cfg.use_recompute:
+        layer_apply = jax.checkpoint(
+            layer_apply, policy=_remat_policy(cfg.recompute_granularity))
+
+    return emb_fwd, layer_apply, pipe_rng
+
+
 def pipelined_lm_loss(cfg: GPTConfig, params, input_ids, labels,
                       loss_mask, *, pp: int, num_microbatches: int,
-                      rng=None, position_ids=None,
+                      vpp: int = 1, rng=None, position_ids=None,
                       deterministic: bool = True) -> jax.Array:
     """Masked-CE pretraining loss with the decoder stack pipelined
     over the ``pp`` mesh axis.
@@ -390,28 +421,10 @@ def pipelined_lm_loss(cfg: GPTConfig, params, input_ids, labels,
     """
     from ...parallel.pipeline import pipeline_forward
 
-    if not cfg.scan_layers:
-        raise ValueError("pipeline parallelism requires scan_layers=True "
-                         "(stacked decoder params)")
-    if position_ids is None:
-        position_ids = jnp.broadcast_to(
-            jnp.arange(input_ids.shape[-1], dtype=jnp.int32)[None, :],
-            input_ids.shape)
-    rng = rng if rng is not None else jax.random.key(0)
-    emb_rng, pipe_rng = jax.random.split(rng)
-
+    emb_fwd, layer_apply, pipe_rng = _pipeline_parts(
+        cfg, input_ids, position_ids, deterministic, rng)
     emb_params = params["gpt"]["embeddings"]
-    x = GPTEmbeddings(cfg).apply(
-        {"params": emb_params}, input_ids, position_ids, deterministic,
-        rngs=None if deterministic else {"dropout": emb_rng})
-
-    def layer_apply(lp, h, key):
-        return TransformerDecoderLayer(cfg, scanned=False).apply(
-            {"params": lp}, h, None, False, deterministic,
-            rngs=None if deterministic else {"dropout": key})
-    if cfg.use_recompute:
-        layer_apply = jax.checkpoint(
-            layer_apply, policy=_remat_policy(cfg.recompute_granularity))
+    x = emb_fwd(emb_params)
 
     ln = _final_norm(cfg)
     fn_params = params["gpt"]["final_norm"]
@@ -430,10 +443,84 @@ def pipelined_lm_loss(cfg: GPTConfig, params, input_ids, labels,
 
     loss_sum = pipeline_forward(
         layer_apply, params["gpt"]["decoder"], x,
-        pp=pp, num_microbatches=num_microbatches,
+        pp=pp, num_microbatches=num_microbatches, vpp=vpp,
         out_fn=head_and_loss, out_init=jnp.zeros((), jnp.float32),
         extras=(labels, loss_mask), rng=pipe_rng)
     return loss_sum / num_microbatches
+
+
+def pipelined_lm_loss_and_grad(
+        cfg: GPTConfig, params, input_ids, labels, loss_mask, *,
+        pp: int, num_microbatches: int, vpp: int = 1, rng=None,
+        position_ids=None, deterministic: bool = True):
+    """Loss AND parameter gradients under the explicit 1F1B schedule.
+
+    ``jax.grad(pipelined_lm_loss)`` differentiates through the GPipe
+    scan, which stashes every microbatch's stage activations before any
+    backward runs; this path drives ``pipeline_value_and_grad`` so the
+    activation ring holds at most ``2*pp*vpp`` microbatch slots — the
+    1F1B memory profile the reference defaults to
+    (``hybrid_model.py:962`` area, ``eager_engine.py:406-415``).
+
+    Returns ``(loss, grads)`` where ``grads`` matches the
+    ``{"gpt": {embeddings, decoder, final_norm}}`` parameter tree and
+    both are per-microbatch-mean averaged — exactly what
+    ``jax.value_and_grad(pipelined_lm_loss)`` would return.
+    """
+    from ...parallel.pipeline import pipeline_value_and_grad
+
+    emb_fwd, layer_apply, pipe_rng = _pipeline_parts(
+        cfg, input_ids, position_ids, deterministic, rng)
+    emb_params = params["gpt"]["embeddings"]
+    extra = set(params["gpt"]) - {"embeddings", "decoder", "final_norm"}
+    if extra:
+        raise ValueError(f"unexpected GPT param subtrees: {extra}")
+    x, emb_pull = jax.vjp(emb_fwd, emb_params)
+
+    ln = _final_norm(cfg)
+    fn_params = params["gpt"]["final_norm"]
+    word_emb = _word_embedding(emb_params)
+
+    def head_loss_and_grad(y, ex):
+        labels_mb, mask_mb = ex
+
+        def head(hp, yy):
+            h = ln.apply({"params": hp["fn"]}, yy)
+            nll, msum = masked_nll_sums(tied_logits(h, hp["we"]),
+                                        labels_mb, mask_mb)
+            return nll / jnp.maximum(msum, 1.0)
+
+        loss_mb, pull = jax.vjp(head, {"fn": fn_params, "we": word_emb}, y)
+        dhp, dy = pull(jnp.ones((), jnp.float32))
+        return loss_mb, dy, dhp
+
+    loss_sum, d_stacked, dhead, dx = pipeline_value_and_grad(
+        layer_apply, params["gpt"]["decoder"], x,
+        pp=pp, num_microbatches=num_microbatches, vpp=vpp,
+        loss_and_grad=head_loss_and_grad,
+        extras=(labels, loss_mask), rng=pipe_rng)
+
+    (demb,) = emb_pull(dx.astype(x.dtype))
+    # fold the tied LM head's word-embedding gradient into the
+    # embedding-table gradient (the reference ties them through
+    # SharedLayerDesc's allreduce; here it is a plain add)
+    we_leaf = demb["word_embeddings"]
+    dwe = dhead["we"]
+    if isinstance(we_leaf, nn.Partitioned):
+        we_leaf = we_leaf.replace(
+            value=we_leaf.value + dwe.astype(we_leaf.value.dtype))
+    else:
+        we_leaf = we_leaf + dwe.astype(we_leaf.dtype)
+    demb = dict(demb)
+    demb["word_embeddings"] = we_leaf
+
+    inv = 1.0 / num_microbatches
+    scale = lambda t: jax.tree.map(  # noqa: E731
+        lambda g: (g * inv).astype(g.dtype), t)
+    grads = {"gpt": {"embeddings": scale(demb),
+                     "decoder": scale(d_stacked),
+                     "final_norm": scale(dhead["fn"])}}
+    return loss_sum * inv, grads
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array,

@@ -53,8 +53,9 @@ class GPTModule(LanguageModule):
                     self.model_config, context_parallel=True)
         return GPTForPretraining(self.model_config)
 
-    def loss_fn(self, params, batch, rng, train: bool = True):
-        tokens, position_ids, labels, loss_mask = batch
+    def _pp_setup(self, tokens, train: bool):
+        """(pp, microbatches, deterministic) plus the pp-composition
+        guards shared by loss_fn and loss_and_grad."""
         deterministic = not train or (
             self.model_config.hidden_dropout_prob == 0.0
             and self.model_config.attention_probs_dropout_prob == 0.0)
@@ -69,20 +70,45 @@ class GPTModule(LanguageModule):
                 "loss_chunks > 1 is not supported with pipeline "
                 "parallelism or QAT; the pp path computes per-"
                 "microbatch logits already")
+        if pp > 1 and self.qat_cfg.enable:
+            raise ValueError("QAT is not supported with pipeline "
+                             "parallelism (reference QAT recipe is "
+                             "mp-only, pretrain_gpt_345M_mp8_qat)")
+        # microbatch count = accumulate_steps (reference
+        # ``utils/config.py:117``); eval batches that don't divide
+        # fall back to a single microbatch
+        acc = self.configs.Engine.get("accumulate_steps", 1) or 1
+        m = acc if tokens.shape[0] % acc == 0 else 1
+        return pp, m, deterministic
+
+    def loss_and_grad(self, params, batch, rng):
+        """One-pass (loss, grads) for the engine's train step.
+
+        With pp>1 under the default ``pipeline_schedule: 1F1B`` this
+        drives the explicit 1F1B schedule (bounded activation memory);
+        otherwise it is plain ``jax.value_and_grad`` of ``loss_fn``.
+        """
+        pp, m, deterministic = self._pp_setup(batch[0], train=True)
+        if pp > 1 and self.model_config.pipeline_schedule == "1F1B":
+            from .model import pipelined_lm_loss_and_grad
+            tokens, position_ids, labels, loss_mask = batch
+            return pipelined_lm_loss_and_grad(
+                self.model_config, params, tokens, labels, loss_mask,
+                pp=pp, num_microbatches=m,
+                vpp=self.model_config.virtual_pp_degree, rng=rng,
+                position_ids=position_ids, deterministic=deterministic)
+        return jax.value_and_grad(
+            lambda p: self.loss_fn(p, batch, rng, train=True))(params)
+
+    def loss_fn(self, params, batch, rng, train: bool = True):
+        tokens, position_ids, labels, loss_mask = batch
+        pp, m, deterministic = self._pp_setup(tokens, train)
         if pp > 1:
-            if self.qat_cfg.enable:
-                raise ValueError("QAT is not supported with pipeline "
-                                 "parallelism (reference QAT recipe is "
-                                 "mp-only, pretrain_gpt_345M_mp8_qat)")
             from .model import pipelined_lm_loss
-            # microbatch count = accumulate_steps (reference
-            # ``utils/config.py:117``); eval batches that don't divide
-            # fall back to a single microbatch
-            acc = self.configs.Engine.get("accumulate_steps", 1) or 1
-            m = acc if tokens.shape[0] % acc == 0 else 1
             return pipelined_lm_loss(
                 self.model_config, params, tokens, labels, loss_mask,
-                pp=pp, num_microbatches=m, rng=rng,
+                pp=pp, num_microbatches=m,
+                vpp=self.model_config.virtual_pp_degree, rng=rng,
                 position_ids=position_ids, deterministic=deterministic)
         rngs = None if deterministic else {"dropout": rng}
         if self.model_config.loss_chunks > 1:

@@ -331,7 +331,10 @@ def pipeline_value_and_grad(
 
     fstate0 = _constrain(jnp.zeros((vpp, S) + mb_shape, x.dtype),
                          P(None, PP_AXIS, DATA_AXES))
-    bstate0 = fstate0
+    # cotangents ride in fp32 regardless of the compute dtype (the
+    # backward wave accumulates them into fp32 param grads)
+    bstate0 = _constrain(jnp.zeros((vpp, S) + mb_shape, jnp.float32),
+                         P(None, PP_AXIS, DATA_AXES))
     stash0 = _constrain(jnp.zeros((vpp, S, D) + mb_shape, x.dtype),
                         P(None, PP_AXIS, None, DATA_AXES))
     dparams0 = jax.tree.map(
