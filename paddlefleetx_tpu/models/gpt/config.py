@@ -25,7 +25,9 @@ class GPTConfig:
     type_vocab_size: int = 16
     initializer_range: float = 0.02
     use_recompute: bool = False
-    recompute_granularity: str = "full"   # full | full_attn | core_attn
+    # full | full_attn | core_attn | save_dots (TPU-only: keep matmul
+    # outputs, recompute elementwise — see _remat_policy)
+    recompute_granularity: str = "full"
     fused_linear: bool = False            # no-op on TPU: XLA fuses bias
     fuse_attn_qkv: bool = True
     sequence_parallel: bool = False
@@ -56,7 +58,7 @@ class GPTConfig:
                 f"num_attention_heads ({self.num_attention_heads}) must "
                 f"divide hidden_size ({self.hidden_size})")
         if self.recompute_granularity not in ("full", "full_attn",
-                                              "core_attn"):
+                                              "core_attn", "save_dots"):
             raise ValueError(
                 f"unknown recompute_granularity "
                 f"{self.recompute_granularity!r}")

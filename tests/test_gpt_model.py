@@ -93,7 +93,7 @@ def test_recompute_granularities_same_loss_and_grads():
         return jax.value_and_grad(f)(variables["params"])
 
     ref_loss, ref_grad = loss_fn(TINY)
-    for gran in ("full", "full_attn", "core_attn"):
+    for gran in ("full", "full_attn", "core_attn", "save_dots"):
         cfg = GPTConfig(**{**vars(TINY), "use_recompute": True,
                            "recompute_granularity": gran})
         loss, grad = loss_fn(cfg)
