@@ -112,26 +112,33 @@ class MultiHeadAttention(nn.Module):
             t, ("batch", None, "act_heads", None)) for t in (q, k, v))
 
         query_offset = 0
+        kv_heads_first = False
         if use_cache:
             # Decode: roll the new keys/values into the preallocated
             # cache. Capacity is max_position_embeddings; the caller
             # (generation loop) must bound prompt+decode length by it —
             # dynamic_update_slice clamps rather than raises on overrun.
+            # Layout [b, h, S, d] (heads-first): (S, d) land in the TPU
+            # minor tile dims, so the Pallas decode kernel can stream
+            # per-(batch, head) KV blocks; a [b, S, h, d] cache would
+            # put h in the sublane dim, which Mosaic cannot block at
+            # size 1.
             cache_k = self.variable(
                 "cache", "cached_key", jnp.zeros,
-                (x.shape[0], cfg.max_position_embeddings, nh, hd), dtype)
+                (x.shape[0], nh, cfg.max_position_embeddings, hd), dtype)
             cache_v = self.variable(
                 "cache", "cached_value", jnp.zeros,
-                (x.shape[0], cfg.max_position_embeddings, nh, hd), dtype)
+                (x.shape[0], nh, cfg.max_position_embeddings, hd), dtype)
             cache_index = self.variable(
                 "cache", "cache_index",
                 lambda: jnp.zeros((), jnp.int32))
             idx = cache_index.value
             cache_k.value = jax.lax.dynamic_update_slice(
-                cache_k.value, k, (0, idx, 0, 0))
+                cache_k.value, k.transpose(0, 2, 1, 3), (0, 0, idx, 0))
             cache_v.value = jax.lax.dynamic_update_slice(
-                cache_v.value, v, (0, idx, 0, 0))
+                cache_v.value, v.transpose(0, 2, 1, 3), (0, 0, idx, 0))
             k, v = cache_k.value, cache_v.value
+            kv_heads_first = True
             query_offset = idx
             cache_index.value = idx + x.shape[1]
 
@@ -166,7 +173,8 @@ class MultiHeadAttention(nn.Module):
                 query_offset=query_offset,
                 dropout_rate=cfg.attention_probs_dropout_prob,
                 dropout_rng=dropout_rng, deterministic=deterministic,
-                use_flash=cfg.use_flash_attention)
+                use_flash=cfg.use_flash_attention,
+                kv_heads_first=kv_heads_first)
         out = checkpoint_name(out, "attn")
 
         out = nn.DenseGeneral(

@@ -9,7 +9,10 @@ kernel (``ops/pallas/flash_attention.py``) replaces it on real TPU
 devices for long sequences, never materializing the score matrix.
 
 Layout: ``q [b, sq, h, d]``, ``k/v [b, skv, h, d]`` (batch-major,
-head-split), output ``[b, sq, h, d]``.
+head-split), output ``[b, sq, h, d]``. With ``kv_heads_first`` the
+keys/values arrive as ``[b, h, skv, d]`` — the decode cache's native
+TPU layout (see ``models/gpt/model.py`` cache comment) — and no
+relayout of the (large) cache happens on this path.
 """
 
 from __future__ import annotations
@@ -25,10 +28,12 @@ NEG_INF = -1e9
 
 
 def _xla_attention(q, k, v, bias, causal, query_offset, dropout_rate,
-                   dropout_rng, deterministic, softmax_in_fp32):
+                   dropout_rng, deterministic, softmax_in_fp32,
+                   kv_heads_first=False):
     head_dim = q.shape[-1]
     scale = head_dim ** -0.5
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
+    k_eq = "bhkd" if kv_heads_first else "bkhd"
+    scores = jnp.einsum(f"bqhd,{k_eq}->bhqk", q * scale, k)
     if softmax_in_fp32:
         scores = scores.astype(jnp.float32)
     if causal:
@@ -47,7 +52,8 @@ def _xla_attention(q, k, v, bias, causal, query_offset, dropout_rate,
                                     weights.shape)
         weights = weights * keep / (1.0 - dropout_rate)
     weights = weights.astype(v.dtype)
-    out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+    v_eq = "bhkd" if kv_heads_first else "bkhd"
+    out = jnp.einsum(f"bhqk,{v_eq}->bqhd", weights, v)
     return checkpoint_name(out, "core_attn")
 
 
@@ -60,12 +66,14 @@ def dot_product_attention(
         dropout_rng: Optional[jax.Array] = None,
         deterministic: bool = True,
         softmax_in_fp32: bool = True,
-        use_flash: bool = False) -> jax.Array:
+        use_flash: bool = False,
+        kv_heads_first: bool = False) -> jax.Array:
     """Causal attention; dispatches to the Pallas flash kernel on TPU.
 
     ``bias`` is an additive mask broadcastable to ``[b, h, sq, sk]``
     (the reference's ``attn_mask`` convention, additive -1e4 style).
     """
+    skv = k.shape[2] if kv_heads_first else k.shape[1]
     if use_flash and dropout_rate == 0.0:
         # the decode kernel takes a per-key additive bias (generation's
         # left-pad mask: [b, 1, 1, skv]); the training kernel does not
@@ -73,18 +81,19 @@ def dot_product_attention(
             bias is None or
             (bias.ndim == 4 and bias.shape[1] == bias.shape[2] == 1
              and bias.shape[0] == q.shape[0]
-             and bias.shape[-1] == k.shape[1]))
+             and bias.shape[-1] == skv))
         try:
             from .pallas import flash_attention as fa
-            if decode_bias_ok:
+            if decode_bias_ok and kv_heads_first:
                 # cached decode: single query token, dynamic cache
                 # index — the kernel skips blocks past the index
                 return fa.flash_decode(q, k, v, query_offset,
                                        bias=bias)
-            if bias is None:
+            if bias is None and not kv_heads_first:
                 return fa.flash_attention(q, k, v, causal=causal,
                                           query_offset=query_offset)
         except (ImportError, NotImplementedError):
             pass
     return _xla_attention(q, k, v, bias, causal, query_offset, dropout_rate,
-                          dropout_rng, deterministic, softmax_in_fp32)
+                          dropout_rng, deterministic, softmax_in_fp32,
+                          kv_heads_first=kv_heads_first)

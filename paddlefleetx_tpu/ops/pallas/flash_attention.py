@@ -83,6 +83,22 @@ def _live_interior(qi, ki, block_q, block_kv, causal, query_offset):
     return live, interior
 
 
+def _masked_dispatch(block_fn, qi, ki, block_q, block_kv, causal,
+                     query_offset):
+    """Run ``block_fn(masked)`` under ``pl.when``: the masked variant
+    on diagonal-crossing blocks, the mask-free variant on fully-live
+    interior blocks, nothing on dead blocks. Single definition so the
+    three kernels cannot diverge."""
+    live, interior = _live_interior(qi, ki, block_q, block_kv, causal,
+                                    query_offset)
+    if causal:
+        pl.when(live & jnp.logical_not(interior))(
+            lambda: block_fn(True))
+        pl.when(interior)(lambda: block_fn(False))
+    else:
+        pl.when(live)(lambda: block_fn(False))
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
                 acc_scr, *, sm_scale, causal, block_q, block_kv, num_kv,
                 query_offset):
@@ -93,9 +109,6 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
-
-    live, interior = _live_interior(qi, ki, block_q, block_kv, causal,
-                                    query_offset)
 
     def _block(masked: bool):
         q, k, v = q_ref[0], k_ref[0], v_ref[0]
@@ -109,12 +122,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
                 s, NEG_INF)
         _online_update(s, v, m_scr, l_scr, acc_scr)
 
-    if causal:
-        pl.when(live & jnp.logical_not(interior))(
-            lambda: _block(True))
-        pl.when(interior)(lambda: _block(False))
-    else:
-        pl.when(live)(lambda: _block(False))
+    _masked_dispatch(_block, qi, ki, block_q, block_kv, causal,
+                     query_offset)
 
     @pl.when(ki == num_kv - 1)
     def _finish():
@@ -169,9 +178,6 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    live, interior = _live_interior(qi, ki, block_q, block_kv, causal,
-                                    query_offset)
-
     def _block(masked: bool):
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         lse = lse_ref[0]                                # [bq, 1]
@@ -190,12 +196,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ds = p * (dp - delta)
         dk_scr[:] += _dot(ds.astype(q.dtype), q_s, trans_a=True)
 
-    if causal:
-        pl.when(live & jnp.logical_not(interior))(
-            lambda: _block(True))
-        pl.when(interior)(lambda: _block(False))
-    else:
-        pl.when(live)(lambda: _block(False))
+    _masked_dispatch(_block, qi, ki, block_q, block_kv, causal,
+                     query_offset)
 
     @pl.when(qi == num_q - 1)
     def _finish():
@@ -211,9 +213,6 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(ki == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
-
-    live, interior = _live_interior(qi, ki, block_q, block_kv, causal,
-                                    query_offset)
 
     def _block(masked: bool):
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
@@ -232,12 +231,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ds = p * (dp - delta)
         dq_scr[:] += _dot(ds.astype(k.dtype), k)
 
-    if causal:
-        pl.when(live & jnp.logical_not(interior))(
-            lambda: _block(True))
-        pl.when(interior)(lambda: _block(False))
-    else:
-        pl.when(live)(lambda: _block(False))
+    _masked_dispatch(_block, qi, ki, block_q, block_kv, causal,
+                     query_offset)
 
     @pl.when(ki == num_kv - 1)
     def _finish():
@@ -381,9 +376,9 @@ def _decode_kernel(off_ref, q_ref, k_ref, v_ref, *refs, sm_scale,
 
     @pl.when(ki * block_kv <= offset)
     def _block():
-        q = q_ref[0, :, 0, :]                      # [8, d]
-        k = k_ref[0, :, 0, :]                      # [bkv, d]
-        v = v_ref[0, :, 0, :]
+        q = q_ref[0, 0]                            # [8, d]
+        k = k_ref[0, 0]                            # [bkv, d]
+        v = v_ref[0, 0]
         s = _dot(q, k, trans_b=True) * sm_scale    # [8, bkv] f32
         if has_bias:
             s = s + bias_ref[0]                    # [1, bkv] broadcasts
@@ -394,7 +389,7 @@ def _decode_kernel(off_ref, q_ref, k_ref, v_ref, *refs, sm_scale,
 
     @pl.when(ki == num_kv - 1)
     def _finish():
-        o_ref[0, :, 0, :] = (
+        o_ref[0, 0] = (
             acc_scr[:] /
             jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
 
@@ -402,22 +397,23 @@ def _decode_kernel(off_ref, q_ref, k_ref, v_ref, *refs, sm_scale,
 def flash_decode(q, k, v, query_offset, bias=None,
                  block_kv: int = DEFAULT_BLOCK_KV):
     """One decode step through the cache: ``q [b, 1, h, d]`` attends to
-    ``k/v [b, S, h, d]`` positions ``<= query_offset`` (a traced
+    ``k/v [b, h, S, d]`` positions ``<= query_offset`` (a traced
     scalar — the fixed-capacity cache index of ``models/gpt/model.py``).
 
     Inference-only (no VJP). Raises NotImplementedError when the
     shape/backend can't take the kernel; the caller falls back to the
-    XLA path. The kernel indexes the cache in its NATIVE ``[b, S, h,
-    d]`` layout — no per-step relayout of the (large) cache; only the
-    single query token is padded to the 8-row sublane tile, and rows
-    1..7 compute throwaway values that are sliced off.
+    XLA path. The cache arrives in its NATIVE heads-first ``[b, h, S,
+    d]`` layout — (S, d) are the TPU minor tile dims, so per-(batch,
+    head) KV blocks stream without any relayout of the (large) cache;
+    only the single query token is padded to the 8-row sublane tile,
+    and rows 1..7 compute throwaway values that are sliced off.
     """
     if jax.default_backend() != "tpu" and not _interpret():
         raise NotImplementedError("flash kernel targets TPU")
     b, sq, h, d = q.shape
     if sq != 1:
         raise NotImplementedError("flash_decode is single-token only")
-    skv = k.shape[1]
+    skv = k.shape[2]
     block_kv = min(block_kv, skv)
     if skv % block_kv or block_kv % 128:
         raise NotImplementedError(
@@ -426,7 +422,10 @@ def flash_decode(q, k, v, query_offset, bias=None,
         raise NotImplementedError(f"head_dim {d} unsupported")
     num_kv = skv // block_kv
 
-    qp = jnp.pad(q, ((0, 0), (0, 7), (0, 0), (0, 0)))  # [b, 8, h, d]
+    # [b, 1, h, d] -> [b, h, 8, d]: pad the query row to the sublane
+    # tile, heads-first like the cache
+    qp = jnp.pad(q, ((0, 0), (0, 7), (0, 0), (0, 0))).transpose(
+        0, 2, 1, 3)
     off = jnp.reshape(jnp.asarray(query_offset, jnp.int32), (1,))
 
     # clamp the kv block index once past the live length: skipped
@@ -438,14 +437,14 @@ def flash_decode(q, k, v, query_offset, bias=None,
         return jnp.minimum(ki, off[0] // block_kv)
 
     in_specs = [
-        pl.BlockSpec((1, 8, 1, d),
-                     lambda bi, hi, ki, off: (bi, 0, hi, 0)),
-        pl.BlockSpec((1, block_kv, 1, d),
-                     lambda bi, hi, ki, off: (bi, kv_block(ki, off),
-                                              hi, 0)),
-        pl.BlockSpec((1, block_kv, 1, d),
-                     lambda bi, hi, ki, off: (bi, kv_block(ki, off),
-                                              hi, 0)),
+        pl.BlockSpec((1, 1, 8, d),
+                     lambda bi, hi, ki, off: (bi, hi, 0, 0)),
+        pl.BlockSpec((1, 1, block_kv, d),
+                     lambda bi, hi, ki, off: (bi, hi,
+                                              kv_block(ki, off), 0)),
+        pl.BlockSpec((1, 1, block_kv, d),
+                     lambda bi, hi, ki, off: (bi, hi,
+                                              kv_block(ki, off), 0)),
     ]
     operands = [qp, k, v]
     if bias is not None:
@@ -468,14 +467,15 @@ def flash_decode(q, k, v, query_offset, bias=None,
             grid=(b, h, num_kv),
             in_specs=in_specs,
             out_specs=pl.BlockSpec(
-                (1, 8, 1, d), lambda bi, hi, ki, off: (bi, 0, hi, 0)),
+                (1, 1, 8, d), lambda bi, hi, ki, off: (bi, hi, 0, 0)),
             scratch_shapes=[
                 pltpu.VMEM((8, 1), jnp.float32),
                 pltpu.VMEM((8, 1), jnp.float32),
                 pltpu.VMEM((8, d), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((b, 8, h, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, h, 8, d), q.dtype),
         interpret=_interpret(),
     )(off, *operands)
-    return out[:, :1]
+    # [b, h, 8, d] -> [b, 1, h, d]
+    return out[:, :, :1, :].transpose(0, 2, 1, 3)
