@@ -15,6 +15,15 @@ Layout: ``[b, s, h, d]`` at the API, ``[b*h, s, d]`` internally; the
 TPU grid is ``(bh, outer_block, inner_block)`` — the innermost axis
 runs sequentially on-core, so VMEM scratch persists across the inner
 loop.
+
+Measured forward throughput on one v5e (b=2, h=16, d=64, causal, r2):
+``s=2048`` 6 TF/s (1.3x the dense XLA path), ``s=4096`` 16 TF/s
+(4.1x dense), ``s=8192`` 23 TF/s (dense materializes [b,h,s,s] and
+stops being viable). Utilization grows with s because the fraction of
+fully-live interior blocks (which skip mask arithmetic) grows and the
+per-program overhead amortizes; at short s the kernel is bound by the
+online-softmax exp passes, not the MXU (see
+projects/gpt/docs/single_card.md for the step-level analysis).
 """
 
 from __future__ import annotations

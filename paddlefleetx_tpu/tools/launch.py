@@ -1,0 +1,163 @@
+"""Multi-process / multi-node launcher.
+
+Parity with the reference's ``paddle.distributed.launch`` (invoked as
+``python -m paddle.distributed.launch --devices 0..7 [--master ip:port
+--nnodes N] tools/train.py ...`` throughout
+``projects/gpt/docs/hybrid_parallel.md`` and the ``projects/*/*.sh``
+recipes; rendezvous env consumed at reference ``utils/env.py:49-69``).
+
+TPU-native differences: JAX runs ONE process per host (the process owns
+all local chips), so there is no per-GPU worker fan-out. What remains
+for a launcher:
+
+  - **multi-node**: run ``pfx-launch --nnodes N --node-rank R
+    --coordinator host:port -- python tools/train.py ...`` on each
+    host; every child gets ``PFX_COORDINATOR / PFX_NUM_PROCESSES /
+    PFX_PROCESS_ID`` and ``utils.env.init_dist_env`` calls
+    ``jax.distributed.initialize`` from them. (On Cloud TPU pods the
+    pod runtime already starts one process per host and
+    ``jax.distributed.initialize()`` auto-discovers — the launcher is
+    for manual clusters and CPU/GPU-style setups.)
+  - **local multi-process testing**: ``--nprocs N`` spawns N local
+    processes against a loopback coordinator — real cross-process
+    collectives (gloo) on the CPU backend, the closest a single
+    machine gets to pod semantics. ``PFX_CPU_DEVICES`` per process
+    composes via the CLI's virtual-mesh hook.
+
+Every child's stdout/stderr passes through with a ``[rank N]`` prefix;
+the launcher exits nonzero if any child fails and terminates the rest
+(the reference launcher's fail-fast behavior).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _stream(proc: subprocess.Popen, rank: int) -> threading.Thread:
+    def pump():
+        for line in proc.stdout:  # type: ignore[union-attr]
+            sys.stdout.write(f"[rank {rank}] {line.decode(errors='replace')}")
+            sys.stdout.flush()
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    return t
+
+
+def launch(cmd: List[str], nprocs: int = 1, nnodes: int = 1,
+           node_rank: int = 0, coordinator: Optional[str] = None,
+           cpu_devices_per_proc: Optional[int] = None) -> int:
+    """Spawn ``nprocs`` local ranks of ``cmd`` with rendezvous env set.
+
+    Returns the first nonzero child exit code, or 0. The global world
+    size is ``nnodes * nprocs``; this node contributes ranks
+    ``node_rank*nprocs .. node_rank*nprocs + nprocs - 1``.
+    """
+    world = nnodes * nprocs
+    if world > 1 and coordinator is None:
+        if nnodes > 1:
+            raise ValueError("--coordinator host:port is required for "
+                             "multi-node launches")
+        coordinator = f"127.0.0.1:{_free_port()}"
+
+    procs: List[subprocess.Popen] = []
+    pumps = []
+    for i in range(nprocs):
+        env = dict(os.environ)
+        if world > 1:
+            env["PFX_COORDINATOR"] = coordinator  # type: ignore[assignment]
+            env["PFX_NUM_PROCESSES"] = str(world)
+            env["PFX_PROCESS_ID"] = str(node_rank * nprocs + i)
+        if cpu_devices_per_proc:
+            env["PFX_CPU_DEVICES"] = str(cpu_devices_per_proc)
+        p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT)
+        procs.append(p)
+        pumps.append(_stream(p, node_rank * nprocs + i))
+
+    rc = 0
+    kill_deadline = None
+    try:
+        remaining = set(procs)
+        while remaining:
+            for p in list(remaining):
+                code = p.poll()
+                if code is None:
+                    continue
+                remaining.discard(p)
+                if code and not rc:
+                    rc = code
+                    # fail fast: a dead rank would hang the others at
+                    # the next collective
+                    for q in procs:
+                        if q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+                    kill_deadline = time.monotonic() + 30.0
+            if remaining:
+                if kill_deadline is not None and \
+                        time.monotonic() > kill_deadline:
+                    # a child stuck in a C-level collective (or with a
+                    # SIGTERM handler it cannot service) never exits —
+                    # escalate so the launcher itself cannot hang
+                    for q in remaining:
+                        q.kill()
+                    kill_deadline = float("inf")
+                time.sleep(0.2)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for t in pumps:
+            t.join(timeout=5)
+    return rc
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="pfx-launch",
+        description="launch distributed training "
+                    "(reference: python -m paddle.distributed.launch)")
+    ap.add_argument("--nprocs", type=int, default=1,
+                    help="processes to spawn on THIS node (TPU: 1 per "
+                         "host; CPU testing: any)")
+    ap.add_argument("--nnodes", type=int, default=1,
+                    help="total nodes (reference --nnodes)")
+    ap.add_argument("--node-rank", type=int, default=0,
+                    help="this node's index")
+    ap.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                    help="rendezvous address (reference --master); "
+                         "defaults to a loopback port for single-node")
+    ap.add_argument("--cpu-devices-per-proc", type=int, default=None,
+                    help="set PFX_CPU_DEVICES for each child (virtual "
+                         "CPU mesh testing)")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="training command (prefix with -- to separate)")
+    args = ap.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no command given")
+    sys.exit(launch(cmd, nprocs=args.nprocs, nnodes=args.nnodes,
+                    node_rank=args.node_rank,
+                    coordinator=args.coordinator,
+                    cpu_devices_per_proc=args.cpu_devices_per_proc))
+
+
+if __name__ == "__main__":
+    main()

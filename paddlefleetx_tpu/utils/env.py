@@ -37,6 +37,10 @@ def init_dist_env(coordinator: Optional[str] = None,
     no rendezvous. On Cloud TPU pods ``jax.distributed.initialize()``
     auto-discovers peers from the metadata server.
     """
+    if num_processes is None and os.environ.get("PFX_NUM_PROCESSES"):
+        num_processes = int(os.environ["PFX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("PFX_PROCESS_ID"):
+        process_id = int(os.environ["PFX_PROCESS_ID"])
     if num_processes is not None and num_processes > 1 or \
             os.environ.get("PFX_COORDINATOR") or coordinator:
         jax.distributed.initialize(

@@ -1,0 +1,73 @@
+"""pfx-launch: multi-process rendezvous with REAL cross-process
+collectives on the CPU backend — the closest a single machine gets to
+pod semantics (reference launches everything through
+``paddle.distributed.launch``; here two OS processes rendezvous via
+``jax.distributed`` and psum across their device sets).
+
+These tests spawn subprocesses and must NOT inherit the session-scoped
+in-process jax config, so everything runs through ``launch()``.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddlefleetx_tpu.tools.launch import launch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, os.environ["PFX_TEST_REPO"])
+    from paddlefleetx_tpu.parallel.mesh import cpu_mesh_env
+    cpu_mesh_env(int(os.environ["PFX_CPU_DEVICES"]))
+    from paddlefleetx_tpu.utils import env
+    env.init_dist_env()
+    import jax, jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()
+    mesh = Mesh(jax.devices(), ("dp",))
+    x = jax.device_put(jnp.ones((4,)), NamedSharding(mesh, P("dp")))
+    total = jax.jit(lambda a: a.sum(),
+                    out_shardings=NamedSharding(mesh, P()))(x)
+    assert float(total) == 4.0, float(total)
+    print("rank", jax.process_index(), "ok")
+""")
+
+
+def test_two_process_rendezvous_and_collective(tmp_path):
+    script = tmp_path / "child.py"
+    script.write_text(CHILD)
+    os.environ["PFX_TEST_REPO"] = REPO
+    try:
+        rc = launch([sys.executable, str(script)], nprocs=2,
+                    cpu_devices_per_proc=2)
+    finally:
+        os.environ.pop("PFX_TEST_REPO", None)
+    assert rc == 0
+
+
+def test_failing_child_propagates_and_terminates_peers(tmp_path):
+    # rank 1 exits 3 immediately; rank 0 would block forever waiting
+    # on rendezvous — fail-fast must kill it and report the failure
+    script = tmp_path / "child.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys, time
+        if os.environ["PFX_PROCESS_ID"] == "1":
+            sys.exit(3)
+        time.sleep(600)
+    """))
+    rc = launch([sys.executable, str(script)], nprocs=2)
+    assert rc == 3
+
+
+def test_cli_requires_command():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py")],
+        capture_output=True, text=True)
+    assert out.returncode != 0
+    assert "no command" in out.stderr

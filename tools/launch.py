@@ -1,0 +1,22 @@
+"""Distributed launcher entry point.
+
+Parity: the reference launches every multi-card recipe through
+``python -m paddle.distributed.launch`` (see
+``projects/gpt/docs/hybrid_parallel.md``). Run as:
+
+  python tools/launch.py --nnodes 2 --node-rank 0 \
+      --coordinator 10.0.0.1:8476 -- python tools/train.py -c <yaml>
+
+The logic lives in ``paddlefleetx_tpu.tools.launch`` (shared with the
+``pfx-launch`` console script).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from paddlefleetx_tpu.tools.launch import main  # noqa: E402
+
+if __name__ == "__main__":
+    main()
