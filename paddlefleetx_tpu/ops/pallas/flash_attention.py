@@ -141,6 +141,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         lse_ref[0] = (m_scr[:] + jnp.log(l))
 
 
+def _vma(x):
+    """Varying-across-mesh axes of a traced value — pallas out_shapes
+    must carry them for shard_map's vma checker to accept the call
+    (outputs vary exactly where q does)."""
+    return getattr(jax.typeof(x), "vma", None)
+
+
 def _flash_forward(q, k, v, sm_scale, causal, query_offset, block_q,
                    block_kv):
     bh, sq, d = q.shape
@@ -162,8 +169,9 @@ def _flash_forward(q, k, v, sm_scale, causal, query_offset, block_q,
             pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=_vma(q)),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32,
+                                 vma=_vma(q)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -249,13 +257,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
-                    block_kv):
+                    block_kv, g_lse=None):
     q, k, v, out, lse = res
     bh, sq, d = q.shape
     skv = k.shape[1]
     num_q, num_kv = sq // block_q, skv // block_kv
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)             # [bh, sq, 1]
+    if g_lse is not None:
+        # cotangent of the returned logsumexp: d lse_i / d s_ij = p_ij,
+        # so it folds into the kernels' existing ds = p * (dp - delta)
+        # as delta' = delta - g_lse — no kernel change needed
+        delta = delta - g_lse.astype(jnp.float32)
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
     r_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
@@ -268,8 +281,10 @@ def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
         grid=(bh, num_kv, num_q),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, r_spec, r_spec],
         out_specs=[kv_spec, kv_spec],
-        out_shape=[jax.ShapeDtypeStruct((bh, skv, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, skv, d), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((bh, skv, d), k.dtype,
+                                        vma=_vma(q)),
+                   jax.ShapeDtypeStruct((bh, skv, d), v.dtype,
+                                        vma=_vma(q))],
         scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32),
                         pltpu.VMEM((block_kv, d), jnp.float32)],
         interpret=_interpret(),
@@ -287,7 +302,8 @@ def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, r_spec2,
                   r_spec2],
         out_specs=q_spec2,
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype,
+                                       vma=_vma(q)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
     )(q, k, v, g, lse, delta)
@@ -297,40 +313,32 @@ def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
 # -- public API --------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, sm_scale, causal, block_q, block_kv):
-    out, _ = _flash_forward(q, k, v, sm_scale, causal, 0, block_q,
-                            block_kv)
-    return out
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, sm_scale, causal, block_q, block_kv):
+    return _flash_forward(q, k, v, sm_scale, causal, 0, block_q,
+                          block_kv)
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_kv):
+def _flash_lse_fwd(q, k, v, sm_scale, causal, block_q, block_kv):
     out, lse = _flash_forward(q, k, v, sm_scale, causal, 0, block_q,
                               block_kv)
-    return out, (q, k, v, out, lse)
+    return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_kv, res, g):
-    return _flash_backward(res, g, sm_scale, causal, 0, block_q,
-                           block_kv)
+def _flash_lse_bwd(sm_scale, causal, block_q, block_kv, res, g):
+    g_out, g_lse = g
+    return _flash_backward(res, g_out, sm_scale, causal, 0, block_q,
+                           block_kv, g_lse=g_lse)
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
-def flash_attention(q, k, v, causal: bool = True, query_offset=0,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_kv: int = DEFAULT_BLOCK_KV):
-    """``[b, s, h, d]`` causal attention; raises NotImplementedError
-    when the shape/backend can't take the kernel (caller falls back to
-    the XLA path in ``ops.attention``)."""
-    if jax.default_backend() != "tpu" and not _interpret():
-        raise NotImplementedError("flash kernel targets TPU")
-    if not isinstance(query_offset, int) or query_offset != 0:
-        raise NotImplementedError("cached decode uses the XLA path")
-    b, sq, h, d = q.shape
-    skv = k.shape[1]
+def check_shapes(sq, skv, d, block_q: int = DEFAULT_BLOCK_Q,
+                 block_kv: int = DEFAULT_BLOCK_KV):
+    """(block_q, block_kv) after clamping, or NotImplementedError —
+    shared by the public wrappers and by callers (ring attention) that
+    must decide statically whether the kernel can take their shapes."""
     block_q = min(block_q, sq)
     block_kv = min(block_kv, skv)
     if sq % block_q or skv % block_kv:
@@ -346,13 +354,55 @@ def flash_attention(q, k, v, causal: bool = True, query_offset=0,
             f"blocks ({block_q}, {block_kv}) not tile-aligned")
     if d % 128 and d not in (64,):
         raise NotImplementedError(f"head_dim {d} unsupported")
+    return block_q, block_kv
 
-    def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
 
-    out = _flash(to_bh(q), to_bh(k), to_bh(v), d ** -0.5, causal,
-                 block_q, block_kv)
+def _to_bh(x):
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def flash_attention(q, k, v, causal: bool = True, query_offset=0,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_kv: int = DEFAULT_BLOCK_KV):
+    """``[b, s, h, d]`` causal attention; raises NotImplementedError
+    when the shape/backend can't take the kernel (caller falls back to
+    the XLA path in ``ops.attention``)."""
+    if jax.default_backend() != "tpu" and not _interpret():
+        raise NotImplementedError("flash kernel targets TPU")
+    if not isinstance(query_offset, int) or query_offset != 0:
+        raise NotImplementedError("cached decode uses the XLA path")
+    b, sq, h, d = q.shape
+    block_q, block_kv = check_shapes(sq, k.shape[1], d, block_q,
+                                     block_kv)
+    # lse discarded: its cotangent is then symbolically zero and the
+    # backward's delta adjustment is a no-op — one custom_vjp serves
+    # both the plain and the with-lse surface
+    out, _ = _flash_lse(_to_bh(q), _to_bh(k), _to_bh(v), d ** -0.5,
+                        causal, block_q, block_kv)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = True,
+                             sm_scale=None,
+                             block_q: int = DEFAULT_BLOCK_Q,
+                             block_kv: int = DEFAULT_BLOCK_KV):
+    """Like :func:`flash_attention` but also returns the per-row
+    logsumexp of the (scaled) scores, ``[b, h, sq]`` fp32 — the
+    streaming-combination state ring attention needs to merge exact
+    softmax results across KV blocks held on other devices. Fully
+    differentiable: the lse cotangent folds into the backward kernels'
+    delta term."""
+    if jax.default_backend() != "tpu" and not _interpret():
+        raise NotImplementedError("flash kernel targets TPU")
+    b, sq, h, d = q.shape
+    block_q, block_kv = check_shapes(sq, k.shape[1], d, block_q,
+                                     block_kv)
+    sm_scale = d ** -0.5 if sm_scale is None else sm_scale
+    out, lse = _flash_lse(_to_bh(q), _to_bh(k), _to_bh(v), sm_scale,
+                          causal, block_q, block_kv)
+    return (out.reshape(b, h, sq, d).transpose(0, 2, 1, 3),
+            lse.reshape(b, h, sq))
 
 
 # -- cached decode -----------------------------------------------------
@@ -483,7 +533,8 @@ def flash_decode(q, k, v, query_offset, bias=None,
                 pltpu.VMEM((8, d), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((b, h, 8, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, h, 8, d), q.dtype,
+                                       vma=_vma(q)),
         interpret=_interpret(),
     )(off, *operands)
     # [b, h, 8, d] -> [b, 1, h, d]

@@ -50,19 +50,104 @@ def _block_attn(q, k, v, scale, causal, q_start, k_start):
     return out, m, l
 
 
-@partial(jax.jit, static_argnames=("axis_name", "causal", "scale"))
+def _ring_flash(q, k, v, axis_name, causal, scale):
+    """Ring with the Pallas flash kernel on each local block.
+
+    Ring blocks are aligned and equal-sized, so every (q-block,
+    kv-block) pair is exactly one of: the diagonal (``src == idx`` —
+    plain causal flash), fully visible (``src < idx`` — non-causal
+    flash), or fully masked (dead). No masked-offset arithmetic ever
+    reaches the kernel. Per-block results merge through the logsumexp
+    the kernel already returns — the same streaming combination the
+    kernel itself performs across its internal KV blocks, lifted one
+    level up the memory hierarchy (VMEM blocks -> ring neighbors).
+    """
+    from .pallas.flash_attention import flash_attention_with_lse
+
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def blk_diag(kv):
+        return flash_attention_with_lse(q, kv[0], kv[1], causal=True,
+                                        sm_scale=scale)
+
+    def blk_full(kv):
+        return flash_attention_with_lse(q, kv[0], kv[1], causal=False,
+                                        sm_scale=scale)
+
+    def blk_dead(kv):
+        # constants must carry q's device-varying type or the cond
+        # branches disagree under shard_map's vma checker
+        zq = jnp.sum(q.astype(jnp.float32)) * 0.0
+        return (jnp.zeros((b, sq, h, d), q.dtype) + zq.astype(q.dtype),
+                jnp.full((b, h, sq), NEG_INF, jnp.float32) + zq)
+
+    def step(carry, i):
+        k_blk, v_blk, out, lse = carry
+        src = (idx - i) % n
+        if causal:
+            blk_out, blk_lse = jax.lax.cond(
+                src == idx, blk_diag,
+                lambda kv: jax.lax.cond(src < idx, blk_full, blk_dead,
+                                        kv),
+                (k_blk, v_blk))
+        else:
+            blk_out, blk_lse = blk_full((k_blk, v_blk))
+        new_lse = jnp.logaddexp(lse, blk_lse)
+        dead = new_lse <= NEG_INF / 2
+        alpha = jnp.where(dead, 0.0, jnp.exp(lse - new_lse))
+        beta = jnp.where(dead, 0.0, jnp.exp(blk_lse - new_lse))
+        out = out * alpha[..., None].swapaxes(1, 2) + \
+            blk_out.astype(jnp.float32) * beta[..., None].swapaxes(1, 2)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, out, new_lse), None
+
+    zero_q = jnp.sum(q.astype(jnp.float32)) * 0.0
+    out0 = jnp.zeros((b, sq, h, d), jnp.float32) + zero_q
+    lse0 = jnp.full((b, h, sq), NEG_INF, jnp.float32) + zero_q
+    (_, _, out, _), _ = jax.lax.scan(step, (k, v, out0, lse0),
+                                     jnp.arange(n))
+    return out.astype(q.dtype)
+
+
+def _flash_block_ok(sq, d) -> bool:
+    from .pallas.flash_attention import check_shapes
+    try:
+        check_shapes(sq, sq, d)
+        return True
+    except NotImplementedError:
+        return False
+
+
+@partial(jax.jit,
+         static_argnames=("axis_name", "causal", "scale", "use_flash"))
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    *, axis_name: str, causal: bool = True,
-                   scale: Optional[float] = None) -> jax.Array:
+                   scale: Optional[float] = None,
+                   use_flash: Optional[bool] = None) -> jax.Array:
     """Exact attention with KV blocks rotating over ``axis_name``.
 
     Call under ``shard_map`` (or use :func:`ring_attention_sharded`):
     arguments are the per-device blocks ``[b, s_local, h, d]``.
+
+    ``use_flash=None`` auto-selects the Pallas per-block kernel on real
+    TPU backends when the block shapes allow (never materializing the
+    ``[b, h, s/N, s/N]`` score blocks the dense path builds); pass
+    ``True``/``False`` to force either path.
     """
-    n = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
     b, sq, h, d = q.shape
     scale = scale if scale is not None else d ** -0.5
+    if use_flash is None:
+        use_flash = (jax.default_backend() == "tpu"
+                     and _flash_block_ok(sq, d))
+    if use_flash:
+        return _ring_flash(q, k, v, axis_name, causal, scale)
+
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
     q_start = idx * sq
 
     perm = [(i, (i + 1) % n) for i in range(n)]  # send KV to the right
@@ -103,7 +188,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                            mesh, *, axis_name: str = None,
                            batch_axes=None, heads_axis: str = None,
-                           causal: bool = True) -> jax.Array:
+                           causal: bool = True,
+                           use_flash: Optional[bool] = None) -> jax.Array:
     """shard_map wrapper: global ``[b, s, h, d]`` -> global attention
     output, with s sharded over ``axis_name`` and the ring running
     inside. Axis defaults come from the mesh convention
@@ -112,7 +198,12 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     axis_name = axis_name or CP_AXIS
     batch_axes = batch_axes or DATA_AXES
     heads_axis = heads_axis or MP_AXIS
+    if use_flash is None:
+        s_local = q.shape[1] // mesh.shape[axis_name]
+        use_flash = (jax.default_backend() == "tpu"
+                     and _flash_block_ok(s_local, q.shape[-1]))
     spec = P(batch_axes, axis_name, heads_axis, None)
-    fn = partial(ring_attention, axis_name=axis_name, causal=causal)
+    fn = partial(ring_attention, axis_name=axis_name, causal=causal,
+                 use_flash=use_flash)
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec)(q, k, v)
