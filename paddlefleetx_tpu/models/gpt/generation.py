@@ -67,6 +67,15 @@ def generate(model, params, input_ids: jax.Array,
     cfg: GPTConfig = model.config
     b, prompt_len = input_ids.shape
     capacity = cfg.max_position_embeddings
+    compute_dtype = jnp.dtype(cfg.dtype)
+    if compute_dtype != jnp.float32:
+        # flax casts fp32 params to the compute dtype inside every op,
+        # so the decode loop would stream fp32 bytes each token; one
+        # up-front cast is numerically identical and halves the
+        # per-token parameter bandwidth (the decode bottleneck)
+        params = jax.tree.map(
+            lambda p: p.astype(compute_dtype)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
     if prompt_len + gen_cfg.max_dec_len > capacity:
         raise ValueError(
             f"prompt ({prompt_len}) + max_dec_len "
@@ -111,7 +120,8 @@ def generate(model, params, input_ids: jax.Array,
             return jnp.argmax(logits, axis=-1)
         logits = logits / jnp.maximum(gen_cfg.temperature, 1e-6)
         logits = top_k_filter(logits, gen_cfg.top_k)
-        logits = top_p_filter(logits, gen_cfg.top_p)
+        logits = top_p_filter(logits, gen_cfg.top_p,
+                              already_top_k=gen_cfg.top_k)
         return jax.random.categorical(step_rng, logits, axis=-1)
 
     def body(carry, step_idx):

@@ -112,33 +112,36 @@ class MultiHeadAttention(nn.Module):
             t, ("batch", None, "act_heads", None)) for t in (q, k, v))
 
         query_offset = 0
-        kv_heads_first = False
+        kv_cache_layout = False
         if use_cache:
             # Decode: roll the new keys/values into the preallocated
             # cache. Capacity is max_position_embeddings; the caller
             # (generation loop) must bound prompt+decode length by it —
             # dynamic_update_slice clamps rather than raises on overrun.
-            # Layout [b, h, S, d] (heads-first): (S, d) land in the TPU
-            # minor tile dims, so the Pallas decode kernel can stream
-            # per-(batch, head) KV blocks; a [b, S, h, d] cache would
-            # put h in the sublane dim, which Mosaic cannot block at
-            # size 1.
+            # Layout [b, h, d, S]: the minor tile dims (d, S) =
+            # (64, capacity) fill TPU (8,128) tiles exactly. The
+            # alternatives both waste 2x HBM to lane padding (any
+            # layout with d=64 minor) — measured: the padded cache
+            # additionally provokes XLA into per-step compress/
+            # uncompress copies of the whole stacked cache, which OOMs
+            # at batch 64. As a bonus k arrives pre-transposed for the
+            # q @ k^T decode matmul.
             cache_k = self.variable(
                 "cache", "cached_key", jnp.zeros,
-                (x.shape[0], nh, cfg.max_position_embeddings, hd), dtype)
+                (x.shape[0], nh, hd, cfg.max_position_embeddings), dtype)
             cache_v = self.variable(
                 "cache", "cached_value", jnp.zeros,
-                (x.shape[0], nh, cfg.max_position_embeddings, hd), dtype)
+                (x.shape[0], nh, hd, cfg.max_position_embeddings), dtype)
             cache_index = self.variable(
                 "cache", "cache_index",
                 lambda: jnp.zeros((), jnp.int32))
             idx = cache_index.value
             cache_k.value = jax.lax.dynamic_update_slice(
-                cache_k.value, k.transpose(0, 2, 1, 3), (0, 0, idx, 0))
+                cache_k.value, k.transpose(0, 2, 3, 1), (0, 0, 0, idx))
             cache_v.value = jax.lax.dynamic_update_slice(
-                cache_v.value, v.transpose(0, 2, 1, 3), (0, 0, idx, 0))
+                cache_v.value, v.transpose(0, 2, 3, 1), (0, 0, 0, idx))
             k, v = cache_k.value, cache_v.value
-            kv_heads_first = True
+            kv_cache_layout = True
             query_offset = idx
             cache_index.value = idx + x.shape[1]
 
@@ -174,7 +177,7 @@ class MultiHeadAttention(nn.Module):
                 dropout_rate=cfg.attention_probs_dropout_prob,
                 dropout_rng=dropout_rng, deterministic=deterministic,
                 use_flash=cfg.use_flash_attention,
-                kv_heads_first=kv_heads_first)
+                kv_cache_layout=kv_cache_layout)
         out = checkpoint_name(out, "attn")
 
         out = nn.DenseGeneral(

@@ -54,14 +54,36 @@ def top_k_filter(logits: jax.Array, top_k: int) -> jax.Array:
     return jnp.where(logits < kth, NEG_INF, logits)
 
 
-def top_p_filter(logits: jax.Array, top_p: float) -> jax.Array:
+def top_p_filter(logits: jax.Array, top_p: float,
+                 already_top_k: int = 0) -> jax.Array:
     """Nucleus filtering (reference ``TopPProcess``,
     ``hybrid_model.py:1163-1187``): keep the smallest set of tokens
-    whose cumulative probability exceeds ``top_p``."""
+    whose cumulative probability exceeds ``top_p``.
+
+    ``already_top_k > 0`` promises the caller has applied
+    :func:`top_k_filter` with that k, so at most k entries are finite
+    — the nucleus threshold is then computed from ``lax.top_k`` over
+    k values instead of a full-vocabulary sort (the sort over 50k
+    logits otherwise dominates the per-token sampling cost).
+    """
     if top_p >= 1.0:
         return logits
-    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    if 0 < already_top_k < logits.shape[-1]:
+        # top_k returns values sorted descending. The probability
+        # denominator must still come from the FULL filtered vector
+        # (one sort-free logsumexp pass): ties at the k-th value keep
+        # extra copies finite beyond the k returned here, and a
+        # denominator over only k values would shift the nucleus
+        # boundary. With the full-mass denominator the kept set is
+        # identical to the full-sort path's (the final `logits <
+        # threshold` compare re-admits every tie copy either way).
+        sorted_logits = jax.lax.top_k(logits, already_top_k)[0]
+        denom = jax.scipy.special.logsumexp(logits, axis=-1,
+                                            keepdims=True)
+        probs = jnp.exp(sorted_logits - denom)
+    else:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     # mask tokens once the cumulative mass *before* them exceeds top_p
     keep_sorted = (cum - probs) < top_p

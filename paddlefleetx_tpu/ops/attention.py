@@ -9,9 +9,9 @@ kernel (``ops/pallas/flash_attention.py``) replaces it on real TPU
 devices for long sequences, never materializing the score matrix.
 
 Layout: ``q [b, sq, h, d]``, ``k/v [b, skv, h, d]`` (batch-major,
-head-split), output ``[b, sq, h, d]``. With ``kv_heads_first`` the
-keys/values arrive as ``[b, h, skv, d]`` — the decode cache's native
-TPU layout (see ``models/gpt/model.py`` cache comment) — and no
+head-split), output ``[b, sq, h, d]``. With ``kv_cache_layout`` the
+keys/values arrive as ``[b, h, d, skv]`` — the decode cache's native
+TPU tiling (see ``models/gpt/model.py`` cache comment) — and no
 relayout of the (large) cache happens on this path.
 """
 
@@ -29,10 +29,10 @@ NEG_INF = -1e9
 
 def _xla_attention(q, k, v, bias, causal, query_offset, dropout_rate,
                    dropout_rng, deterministic, softmax_in_fp32,
-                   kv_heads_first=False):
+                   kv_cache_layout=False):
     head_dim = q.shape[-1]
     scale = head_dim ** -0.5
-    k_eq = "bhkd" if kv_heads_first else "bkhd"
+    k_eq = "bhdk" if kv_cache_layout else "bkhd"
     scores = jnp.einsum(f"bqhd,{k_eq}->bhqk", q * scale, k)
     if softmax_in_fp32:
         scores = scores.astype(jnp.float32)
@@ -52,7 +52,7 @@ def _xla_attention(q, k, v, bias, causal, query_offset, dropout_rate,
                                     weights.shape)
         weights = weights * keep / (1.0 - dropout_rate)
     weights = weights.astype(v.dtype)
-    v_eq = "bhkd" if kv_heads_first else "bkhd"
+    v_eq = "bhdk" if kv_cache_layout else "bkhd"
     out = jnp.einsum(f"bhqk,{v_eq}->bqhd", weights, v)
     return checkpoint_name(out, "core_attn")
 
@@ -67,13 +67,13 @@ def dot_product_attention(
         deterministic: bool = True,
         softmax_in_fp32: bool = True,
         use_flash: bool = False,
-        kv_heads_first: bool = False) -> jax.Array:
+        kv_cache_layout: bool = False) -> jax.Array:
     """Causal attention; dispatches to the Pallas flash kernel on TPU.
 
     ``bias`` is an additive mask broadcastable to ``[b, h, sq, sk]``
     (the reference's ``attn_mask`` convention, additive -1e4 style).
     """
-    skv = k.shape[2] if kv_heads_first else k.shape[1]
+    skv = k.shape[3] if kv_cache_layout else k.shape[1]
     if use_flash and dropout_rate == 0.0:
         # the decode kernel takes a per-key additive bias (generation's
         # left-pad mask: [b, 1, 1, skv]); the training kernel does not
@@ -84,16 +84,16 @@ def dot_product_attention(
              and bias.shape[-1] == skv))
         try:
             from .pallas import flash_attention as fa
-            if decode_bias_ok and kv_heads_first:
+            if decode_bias_ok and kv_cache_layout:
                 # cached decode: single query token, dynamic cache
                 # index — the kernel skips blocks past the index
                 return fa.flash_decode(q, k, v, query_offset,
                                        bias=bias)
-            if bias is None and not kv_heads_first:
+            if bias is None and not kv_cache_layout:
                 return fa.flash_attention(q, k, v, causal=causal,
                                           query_offset=query_offset)
         except (ImportError, NotImplementedError):
             pass
     return _xla_attention(q, k, v, bias, causal, query_offset, dropout_rate,
                           dropout_rng, deterministic, softmax_in_fp32,
-                          kv_heads_first=kv_heads_first)
+                          kv_cache_layout=kv_cache_layout)

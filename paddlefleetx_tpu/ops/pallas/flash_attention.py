@@ -66,8 +66,10 @@ def _dot(a, b, trans_a=False, trans_b=False):
 
 def _online_update(s, v, m_scr, l_scr, acc_scr):
     """One online-softmax accumulator step over a masked score block
-    (shared by the training forward and the decode kernel — the
-    rescale math is numerically delicate and must not fork)."""
+    (the training forward's MXU formulation; the decode kernel
+    vectorizes the same recurrence over heads with VPU reduces —
+    semantic parity between the two is pinned by
+    ``tests/test_flash_attention.py`` decode-vs-XLA cases)."""
     m_prev = m_scr[:]                              # [bq, 1]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
     alpha = jnp.exp(m_prev - m_new)
@@ -412,6 +414,15 @@ def _decode_kernel(off_ref, q_ref, k_ref, v_ref, *refs, sm_scale,
                    block_kv, num_kv, has_bias):
     """Single-token decode over the fixed-capacity KV cache.
 
+    Decode attention is a matvec, not a matmul — per (head, key-block)
+    the scores are ``sum_d q[d] * k[d, S]`` and the output is
+    ``sum_S p[S] * v[d, S]``, both VPU broadcast-multiply-reduces over
+    the cache's native ``[d, S]`` tiles. An MXU formulation pays
+    fixed issue latency per tiny matmul (measured 512 matmuls/call =
+    ~370us); this kernel folds ALL heads into one program per
+    (batch, key-block) so the grid is ``b * num_kv`` programs of pure
+    VPU streaming.
+
     The live length is DYNAMIC (the decode loop's cache index), so it
     arrives as a prefetched scalar: blocks wholly past the last valid
     position are skipped — short prefixes only pay for the cache they
@@ -424,7 +435,7 @@ def _decode_kernel(off_ref, q_ref, k_ref, v_ref, *refs, sm_scale,
     else:
         bias_ref = None
         o_ref, m_scr, l_scr, acc_scr = refs
-    ki = pl.program_id(2)
+    ki = pl.program_id(1)
     offset = off_ref[0]            # last valid key position
 
     @pl.when(ki == 0)
@@ -435,56 +446,76 @@ def _decode_kernel(off_ref, q_ref, k_ref, v_ref, *refs, sm_scale,
 
     @pl.when(ki * block_kv <= offset)
     def _block():
-        q = q_ref[0, 0]                            # [8, d]
-        k = k_ref[0, 0]                            # [bkv, d]
-        v = v_ref[0, 0]
-        s = _dot(q, k, trans_b=True) * sm_scale    # [8, bkv] f32
+        k_pos = ki * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_kv), 1)
+        live = k_pos <= offset                     # [1, bkv]
+        q = q_ref[0].astype(jnp.float32)           # [h, d, 1]
+        k = k_ref[0].astype(jnp.float32)           # [h, d, bkv]
+        v = v_ref[0].astype(jnp.float32)
+        # every head in one vectorized pass — a per-head loop would
+        # issue ~6x num_heads small VPU ops and dominate the call
+        s = jnp.sum(q * k, axis=1) * sm_scale      # [h, bkv] f32
         if has_bias:
             s = s + bias_ref[0]                    # [1, bkv] broadcasts
-        k_pos = ki * block_kv + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1)
-        s = jnp.where(k_pos <= offset, s, NEG_INF)
-        _online_update(s, v, m_scr, l_scr, acc_scr)
+        s = jnp.where(live, s, NEG_INF)
+        m_prev = m_scr[:]                          # [h, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                     # [h, bkv]
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        # output: broadcast p over d, reduce over the key lanes
+        acc_scr[:] = acc_scr[:] * alpha + jnp.sum(p[:, None, :] * v,
+                                                  axis=2)
+        m_scr[:] = m_new
 
     @pl.when(ki == num_kv - 1)
     def _finish():
-        o_ref[0, 0] = (
-            acc_scr[:] /
-            jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+        o_ref[0] = (acc_scr[:] /
+                    jnp.maximum(l_scr[:], 1e-30))[..., None].astype(
+            o_ref.dtype)
 
 
 def flash_decode(q, k, v, query_offset, bias=None,
                  block_kv: int = DEFAULT_BLOCK_KV):
     """One decode step through the cache: ``q [b, 1, h, d]`` attends to
-    ``k/v [b, h, S, d]`` positions ``<= query_offset`` (a traced
+    ``k/v [b, h, d, S]`` positions ``<= query_offset`` (a traced
     scalar — the fixed-capacity cache index of ``models/gpt/model.py``).
 
     Inference-only (no VJP). Raises NotImplementedError when the
     shape/backend can't take the kernel; the caller falls back to the
-    XLA path. The cache arrives in its NATIVE heads-first ``[b, h, S,
-    d]`` layout — (S, d) are the TPU minor tile dims, so per-(batch,
-    head) KV blocks stream without any relayout of the (large) cache;
-    only the single query token is padded to the 8-row sublane tile,
-    and rows 1..7 compute throwaway values that are sliced off.
+    XLA path. The cache arrives in its NATIVE ``[b, h, d, S]`` layout
+    — minor tile dims (d, S) fill TPU (8,128) tiles exactly (zero
+    padding; any d=64-minor layout wastes 2x HBM). One program per
+    (batch, key-block) streams every head's ``[d, bkv]`` tiles and
+    runs the matvec attention on the VPU (see ``_decode_kernel``).
     """
     if jax.default_backend() != "tpu" and not _interpret():
         raise NotImplementedError("flash kernel targets TPU")
     b, sq, h, d = q.shape
     if sq != 1:
         raise NotImplementedError("flash_decode is single-token only")
-    skv = k.shape[2]
+    skv = k.shape[3]
     block_kv = min(block_kv, skv)
-    if skv % block_kv or block_kv % 128:
+    # all heads ride in one block, so k/v blocks are h-times larger
+    # than a per-head grid's: shrink block_kv until double-buffered
+    # k+v blocks fit comfortably in the ~16M VMEM (a Mosaic
+    # allocation failure would crash instead of falling back)
+    budget = 8 * 1024 * 1024
+    while block_kv > 128 and \
+            4 * h * d * block_kv * k.dtype.itemsize > budget:
+        block_kv //= 2
+    if skv % block_kv or block_kv % 128 or \
+            4 * h * d * block_kv * k.dtype.itemsize > budget:
         raise NotImplementedError(
-            f"cache length {skv} not tileable by {block_kv}")
-    if d % 128 and d not in (64,):
+            f"cache length {skv} not tileable by {block_kv} "
+            f"within VMEM budget (h={h}, d={d})")
+    if d % 8:
         raise NotImplementedError(f"head_dim {d} unsupported")
     num_kv = skv // block_kv
 
-    # [b, 1, h, d] -> [b, h, 8, d]: pad the query row to the sublane
-    # tile, heads-first like the cache
-    qp = jnp.pad(q, ((0, 0), (0, 7), (0, 0), (0, 0))).transpose(
-        0, 2, 1, 3)
+    # [b, 1, h, d] -> [b, h, d, 1]: the query token as a lane-1
+    # column per head, matching the cache's d-major tiles
+    qp = q.transpose(0, 2, 3, 1)
     off = jnp.reshape(jnp.asarray(query_offset, jnp.int32), (1,))
 
     # clamp the kv block index once past the live length: skipped
@@ -496,25 +527,24 @@ def flash_decode(q, k, v, query_offset, bias=None,
         return jnp.minimum(ki, off[0] // block_kv)
 
     in_specs = [
-        pl.BlockSpec((1, 1, 8, d),
-                     lambda bi, hi, ki, off: (bi, hi, 0, 0)),
-        pl.BlockSpec((1, 1, block_kv, d),
-                     lambda bi, hi, ki, off: (bi, hi,
-                                              kv_block(ki, off), 0)),
-        pl.BlockSpec((1, 1, block_kv, d),
-                     lambda bi, hi, ki, off: (bi, hi,
-                                              kv_block(ki, off), 0)),
+        pl.BlockSpec((1, h, d, 1), lambda bi, ki, off: (bi, 0, 0, 0)),
+        pl.BlockSpec((1, h, d, block_kv),
+                     lambda bi, ki, off: (bi, 0, 0,
+                                          kv_block(ki, off))),
+        pl.BlockSpec((1, h, d, block_kv),
+                     lambda bi, ki, off: (bi, 0, 0,
+                                          kv_block(ki, off))),
     ]
     operands = [qp, k, v]
     if bias is not None:
         # per-key additive bias (the generation loop's left-pad mask),
         # [b, skv] or broadcastable [b, 1, 1, skv]; a [1, bkv] row
-        # broadcasts against the [8, bkv] scores inside the kernel
+        # broadcasts against each head's [1, bkv] scores
         operands.append(jnp.reshape(bias.astype(jnp.float32),
                                     (b, 1, skv)))
         in_specs.append(pl.BlockSpec(
             (1, 1, block_kv),
-            lambda bi, hi, ki, off: (bi, 0, kv_block(ki, off))))
+            lambda bi, ki, off: (bi, 0, kv_block(ki, off))))
 
     kernel = functools.partial(_decode_kernel, sm_scale=d ** -0.5,
                                block_kv=block_kv, num_kv=num_kv,
@@ -523,19 +553,19 @@ def flash_decode(q, k, v, query_offset, bias=None,
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(b, h, num_kv),
+            grid=(b, num_kv),
             in_specs=in_specs,
             out_specs=pl.BlockSpec(
-                (1, 1, 8, d), lambda bi, hi, ki, off: (bi, hi, 0, 0)),
+                (1, h, d, 1), lambda bi, ki, off: (bi, 0, 0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((8, 1), jnp.float32),
-                pltpu.VMEM((8, 1), jnp.float32),
-                pltpu.VMEM((8, d), jnp.float32),
+                pltpu.VMEM((h, 1), jnp.float32),
+                pltpu.VMEM((h, 1), jnp.float32),
+                pltpu.VMEM((h, d), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((b, h, 8, d), q.dtype,
+        out_shape=jax.ShapeDtypeStruct((b, h, d, 1), q.dtype,
                                        vma=_vma(q)),
         interpret=_interpret(),
     )(off, *operands)
-    # [b, h, 8, d] -> [b, 1, h, d]
-    return out[:, :, :1, :].transpose(0, 2, 1, 3)
+    # [b, h, d, 1] -> [b, 1, h, d]
+    return out.transpose(0, 3, 1, 2)

@@ -152,18 +152,18 @@ def test_decode_matches_xla_and_ignores_garbage():
     rng = np.random.default_rng(3)
     b, S, h, d = 2, 256, 2, 64
     q = jnp.asarray(rng.normal(size=(b, 1, h, d)), jnp.float32)
-    # heads-first cache layout [b, h, S, d]
-    k = jnp.asarray(rng.normal(size=(b, h, S, d)), jnp.float32)
-    v = jnp.asarray(rng.normal(size=(b, h, S, d)), jnp.float32)
+    # tile-exact cache layout [b, h, d, S]
+    k = jnp.asarray(rng.normal(size=(b, h, d, S)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h, d, S)), jnp.float32)
     for off in (0, 5, 130, 255):
         ref = _xla_attention(q, k, v, None, True, off, 0.0, None, True,
-                             True, kv_heads_first=True)
+                             True, kv_cache_layout=True)
         got = flash_decode(q, k, v, jnp.int32(off), block_kv=128)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    atol=2e-6, rtol=2e-6)
         # garbage independence: mutate the cache beyond the offset
-        k2 = k.at[:, :, off + 1:].set(1e3)
-        v2 = v.at[:, :, off + 1:].set(-1e3)
+        k2 = k.at[..., off + 1:].set(1e3)
+        v2 = v.at[..., off + 1:].set(-1e3)
         got2 = flash_decode(q, k2, v2, jnp.int32(off), block_kv=128)
         np.testing.assert_allclose(np.asarray(got2), np.asarray(got),
                                    atol=2e-6, rtol=2e-6)
@@ -174,8 +174,8 @@ def test_decode_works_under_jit_with_traced_offset():
     rng = np.random.default_rng(4)
     b, S, h, d = 1, 128, 2, 64
     q = jnp.asarray(rng.normal(size=(b, 1, h, d)), jnp.float32)
-    k = jnp.asarray(rng.normal(size=(b, h, S, d)), jnp.float32)
-    v = jnp.asarray(rng.normal(size=(b, h, S, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h, d, S)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h, d, S)), jnp.float32)
 
     @jax.jit
     def step(off):
@@ -184,9 +184,9 @@ def test_decode_works_under_jit_with_traced_offset():
     a = step(jnp.int32(7))
     bb = step(jnp.int32(100))          # same trace, new offset
     ref_a = _xla_attention(q, k, v, None, True, 7, 0.0, None, True, True,
-                           kv_heads_first=True)
+                           kv_cache_layout=True)
     ref_b = _xla_attention(q, k, v, None, True, 100, 0.0, None, True,
-                           True, kv_heads_first=True)
+                           True, kv_cache_layout=True)
     np.testing.assert_allclose(np.asarray(a), np.asarray(ref_a),
                                atol=2e-6, rtol=2e-6)
     np.testing.assert_allclose(np.asarray(bb), np.asarray(ref_b),
@@ -200,22 +200,25 @@ def test_decode_dispatch_from_dot_product_attention():
     rng = np.random.default_rng(5)
     b, S, h, d = 1, 256, 2, 64
     q = jnp.asarray(rng.normal(size=(b, 1, h, d)), jnp.float32)
-    k = jnp.asarray(rng.normal(size=(b, h, S, d)), jnp.float32)
-    v = jnp.asarray(rng.normal(size=(b, h, S, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h, d, S)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h, d, S)), jnp.float32)
     out = dot_product_attention(q, k, v, causal=True,
                                 query_offset=jnp.int32(17),
-                                use_flash=True, kv_heads_first=True)
+                                use_flash=True, kv_cache_layout=True)
     ref = _xla_attention(q, k, v, None, True, 17, 0.0, None, True, True,
-                         kv_heads_first=True)
+                         kv_cache_layout=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-6, rtol=2e-6)
-    # head_dim the kernel rejects -> XLA fallback, still correct
-    q2 = q[..., :48]; k2 = k[..., :48]; v2 = v[..., :48]
+    # head_dim the kernel rejects (not a sublane multiple) -> XLA
+    # fallback, still correct
+    q2 = q[..., :44]
+    k2 = k[:, :, :44, :]
+    v2 = v[:, :, :44, :]
     out2 = dot_product_attention(q2, k2, v2, causal=True,
                                  query_offset=jnp.int32(3),
-                                 use_flash=True, kv_heads_first=True)
+                                 use_flash=True, kv_cache_layout=True)
     ref2 = _xla_attention(q2, k2, v2, None, True, 3, 0.0, None, True,
-                          True, kv_heads_first=True)
+                          True, kv_cache_layout=True)
     np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2),
                                atol=2e-6, rtol=2e-6)
 
@@ -227,8 +230,8 @@ def test_decode_with_leftpad_bias_matches_xla():
     rng = np.random.default_rng(6)
     b, S, h, d = 2, 256, 2, 64
     q = jnp.asarray(rng.normal(size=(b, 1, h, d)), jnp.float32)
-    k = jnp.asarray(rng.normal(size=(b, h, S, d)), jnp.float32)
-    v = jnp.asarray(rng.normal(size=(b, h, S, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h, d, S)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h, d, S)), jnp.float32)
     # row 0 pads the first 3 slots, row 1 the first 120
     valid = np.ones((b, S), bool)
     valid[0, :3] = False
@@ -237,8 +240,8 @@ def test_decode_with_leftpad_bias_matches_xla():
     off = jnp.int32(130)
     out = dot_product_attention(q, k, v, bias=bias, causal=True,
                                 query_offset=off, use_flash=True,
-                                kv_heads_first=True)
+                                kv_cache_layout=True)
     ref = _xla_attention(q, k, v, bias, True, off, 0.0, None, True, True,
-                         kv_heads_first=True)
+                         kv_cache_layout=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-6, rtol=2e-6)

@@ -103,6 +103,31 @@ def test_top_p_filter_keeps_nucleus():
     assert out[0, 2] < -1e8 and out[0, 3] < -1e8
 
 
+def test_top_p_fast_path_matches_full_sort():
+    """The already_top_k fast path (lax.top_k + full-mass denominator)
+    must produce the identical kept set as the full-sort path after
+    top_k filtering — including exact ties at the k-th value, where a
+    naive k-value softmax would shift the nucleus boundary."""
+    rng = np.random.default_rng(11)
+    cases = [
+        jnp.asarray(rng.normal(size=(4, 997)), jnp.float32),
+        # exact ties straddling the k-th position
+        jnp.asarray([[1.0] + [0.0] * 5 + [-2.0] * 10], jnp.float32),
+        jnp.asarray([[3.0, 3.0, 3.0, 1.0, 1.0, 1.0, 0.0]], jnp.float32),
+    ]
+    for logits in cases:
+        for k in (2, 5):
+            for p in (0.3, 0.5, 0.75, 0.95):
+                filtered = top_k_filter(logits, k)
+                slow = np.asarray(top_p_filter(filtered, p))
+                fast = np.asarray(top_p_filter(filtered, p,
+                                               already_top_k=k))
+                np.testing.assert_array_equal(
+                    np.isfinite(slow) & (slow > -1e8),
+                    np.isfinite(fast) & (fast > -1e8),
+                    err_msg=f"k={k} p={p}")
+
+
 def test_repetition_penalty_direction():
     logits = jnp.asarray([[2.0, -2.0, 1.0]])
     appeared = jnp.asarray([[True, True, False]])
