@@ -21,8 +21,8 @@ import jax.numpy as jnp
 
 from .config import GPTConfig
 from .processors import (
-    min_length_processor, repetition_penalty_processor, top_k_filter,
-    top_p_filter, NEG_INF,
+    min_length_processor, repetition_penalty_processor,
+    top_k_top_p_filter, NEG_INF,
 )
 
 
@@ -119,9 +119,8 @@ def generate(model, params, input_ids: jax.Array,
         if gen_cfg.decode_strategy == "greedy_search":
             return jnp.argmax(logits, axis=-1)
         logits = logits / jnp.maximum(gen_cfg.temperature, 1e-6)
-        logits = top_k_filter(logits, gen_cfg.top_k)
-        logits = top_p_filter(logits, gen_cfg.top_p,
-                              already_top_k=gen_cfg.top_k)
+        logits = top_k_top_p_filter(logits, gen_cfg.top_k,
+                                    gen_cfg.top_p)
         return jax.random.categorical(step_rng, logits, axis=-1)
 
     def body(carry, step_idx):

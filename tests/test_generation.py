@@ -115,6 +115,9 @@ def test_top_p_fast_path_matches_full_sort():
         jnp.asarray([[1.0] + [0.0] * 5 + [-2.0] * 10], jnp.float32),
         jnp.asarray([[3.0, 3.0, 3.0, 1.0, 1.0, 1.0, 0.0]], jnp.float32),
     ]
+    from paddlefleetx_tpu.models.gpt.processors import (
+        top_k_top_p_filter,
+    )
     for logits in cases:
         for k in (2, 5):
             for p in (0.3, 0.5, 0.75, 0.95):
@@ -122,10 +125,14 @@ def test_top_p_fast_path_matches_full_sort():
                 slow = np.asarray(top_p_filter(filtered, p))
                 fast = np.asarray(top_p_filter(filtered, p,
                                                already_top_k=k))
+                fused = np.asarray(top_k_top_p_filter(logits, k, p))
+                kept = np.isfinite(slow) & (slow > -1e8)
                 np.testing.assert_array_equal(
-                    np.isfinite(slow) & (slow > -1e8),
-                    np.isfinite(fast) & (fast > -1e8),
+                    kept, np.isfinite(fast) & (fast > -1e8),
                     err_msg=f"k={k} p={p}")
+                np.testing.assert_array_equal(
+                    kept, np.isfinite(fused) & (fused > -1e8),
+                    err_msg=f"fused k={k} p={p}")
 
 
 def test_repetition_penalty_direction():
