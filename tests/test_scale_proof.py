@@ -2,8 +2,8 @@
 
 The reference's TIPC harness validates large configs by shrinking the
 model (num_layers=4, run_benchmark.sh) and running the real topology.
-Same trick here: the REAL 6.7B sharding16 YAML runs its 16-way ZeRO-2
-topology on a 16-device virtual CPU mesh through the TIPC driver
+Same trick here: the REAL big-model YAMLs run their full device
+topologies on virtual CPU meshes through the TIPC driver
 (reference ``benchmarks/test_tipc/gpt/hybrid_parallel/N*``).
 """
 
@@ -19,42 +19,41 @@ from test_data import make_corpus
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_6_7B_sharding16_topology_on_16_device_mesh(tmp_path):
+def _run_scale_proof(tmp_path, model_item, config, devices, max_steps,
+                     shrink_overrides, seq_len=64):
+    """TIPC-shrink a real recipe and run its full topology on a
+    virtual CPU mesh; returns the driver's parsed result line."""
     make_corpus(tmp_path, n_docs=60, doc_len_range=(20, 60), vocab=128,
                 eos=127)
+    data_overrides = []
+    for mode, samples in (("Train", 64), ("Eval", 16)):
+        data_overrides += [
+            f"Data.{mode}.dataset.input_dir={tmp_path}",
+            f"Data.{mode}.dataset.split=[3,1,0]",
+            f"Data.{mode}.dataset.num_samples={samples}",
+            f"Data.{mode}.dataset.mode={mode}",
+            f"Data.{mode}.dataset.eos_id=127",
+            f"Data.{mode}.dataset.max_seq_len={seq_len}",
+            f"Data.{mode}.dataset.build_data_file=True",
+        ]
     cmd = [
-        sys.executable, os.path.join(REPO, "benchmarks",
-                                     "run_benchmark.py"),
-        "--model_item", "gpt_6.7B_sharding16_scaled",
-        "--config",
-        os.path.join(REPO, "configs/nlp/gpt/"
-                           "pretrain_gpt_6.7B_sharding16.yaml"),
-        "--max_steps", "3", "--cpu-devices", "16", "--skip_steps", "0",
+        sys.executable,
+        os.path.join(REPO, "benchmarks", "run_benchmark.py"),
+        "--model_item", model_item,
+        "--config", os.path.join(REPO, config),
+        "--max_steps", str(max_steps), "--cpu-devices", str(devices),
+        "--skip_steps", "0",
         "--overrides",
-        # TIPC shrink (reference run_benchmark.sh: 4 layers) — the
-        # sharding16/stage-2 topology is what's under test
-        "Model.num_layers=4", "Model.hidden_size=128",
-        "Model.num_attention_heads=4", "Model.ffn_hidden_size=256",
+        # TIPC shrink (reference run_benchmark.sh shrinks the model;
+        # the full device topology is what's under test)
         "Model.vocab_size=128", "Model.max_position_embeddings=64",
         "Model.hidden_dropout_prob=0.0",
         "Model.attention_probs_dropout_prob=0.0",
         "Model.use_flash_attention=False",
-        "Global.local_batch_size=1", "Global.micro_batch_size=1",
         "Engine.logging_freq=1", "Engine.eval_freq=100000",
         f"Engine.save_load.output_dir={tmp_path / 'out'}",
         "Engine.save_load.save_steps=100000",
-        f"Data.Train.dataset.input_dir={tmp_path}",
-        "Data.Train.dataset.split=[3,1,0]",
-        "Data.Train.dataset.num_samples=64",
-        "Data.Train.dataset.mode=Train", "Data.Train.dataset.eos_id=127",
-        "Data.Train.dataset.max_seq_len=64",
-        "Data.Train.dataset.build_data_file=True",
-        f"Data.Eval.dataset.input_dir={tmp_path}",
-        "Data.Eval.dataset.split=[3,1,0]",
-        "Data.Eval.dataset.num_samples=16",
-        "Data.Eval.dataset.mode=Eval", "Data.Eval.dataset.eos_id=127",
-        "Data.Eval.dataset.max_seq_len=64",
-        "Data.Eval.dataset.build_data_file=True",
+        *shrink_overrides, *data_overrides,
     ]
     proc = subprocess.run(cmd, capture_output=True, text=True,
                           timeout=900, cwd=REPO)
@@ -63,3 +62,33 @@ def test_6_7B_sharding16_topology_on_16_device_mesh(tmp_path):
     assert result["ok"], result
     assert result["ips"] > 0                      # throughput parsed
     assert np.isfinite(result["last_loss"])       # topology executes
+    return result
+
+
+def test_6_7B_sharding16_topology_on_16_device_mesh(tmp_path):
+    _run_scale_proof(
+        tmp_path, "gpt_6.7B_sharding16_scaled",
+        "configs/nlp/gpt/pretrain_gpt_6.7B_sharding16.yaml",
+        devices=16, max_steps=3,
+        shrink_overrides=[
+            "Model.num_layers=4", "Model.hidden_size=128",
+            "Model.num_attention_heads=4", "Model.ffn_hidden_size=256",
+            "Global.local_batch_size=1", "Global.micro_batch_size=1",
+        ])
+
+
+def test_175B_mp8_pp16_topology_on_128_device_mesh(tmp_path):
+    """The flagship 175B recipe's REAL mp8 x pp16 topology (128-way)
+    executes end to end — layers/widths TIPC-shrunk, the 1F1B pipeline
+    schedule and the 8-way tensor sharding are what's under test.
+    Measured ~90s wall on the CI host."""
+    _run_scale_proof(
+        tmp_path, "gpt_175B_mp8_pp16_scaled",
+        "configs/nlp/gpt/pretrain_gpt_175B_mp8_pp16.yaml",
+        devices=128, max_steps=2, seq_len=32,
+        shrink_overrides=[
+            "Model.num_layers=16", "Model.hidden_size=128",
+            "Model.num_attention_heads=8", "Model.ffn_hidden_size=256",
+            "Global.global_batch_size=16", "Global.local_batch_size=16",
+            "Global.micro_batch_size=1",
+        ])
