@@ -58,6 +58,11 @@ def model_flops_per_token(cfg: GPTConfig, seq: int) -> float:
 def bench_train():
     on_tpu = jax.devices()[0].platform == "tpu"
     batch, seq = (8, 1024) if on_tpu else (2, 256)
+    # gradient accumulation amortizes the ~24 ms memory-bound optimizer
+    # update over more tokens (engine semantics: one jitted step with a
+    # lax.scan over microbatches). Measured r2 at bs8/save_dots:
+    # acc=1 0.420 MFU, acc=2 0.430, acc=4 0.441 (global batch 32).
+    acc = 4 if on_tpu else 1
     # Operating point for the 16G v5e (measured r2, tokens/s at bs8):
     #   recompute=full                 32.6k  (mfu 0.401; ~33% FLOP
     #                                        overhead from full remat)
@@ -79,12 +84,14 @@ def bench_train():
     model = GPTForPretraining(cfg)
 
     rng = np.random.default_rng(0)
-    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+    gbs = batch * acc
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (gbs, seq)),
                       jnp.int32)
     labels = jnp.roll(ids, -1, axis=1)
-    mask = jnp.ones((batch, seq), jnp.float32)
+    mask = jnp.ones((gbs, seq), jnp.float32)
 
-    variables = jax.jit(model.init)({"params": jax.random.key(0)}, ids)
+    variables = jax.jit(model.init)({"params": jax.random.key(0)},
+                                    ids[:1])
     params = variables["params"]
     tx = optax.chain(optax.clip_by_global_norm(1.0),
                      optax.adamw(2e-4, weight_decay=0.01,
@@ -92,21 +99,46 @@ def bench_train():
                                  else None))
     opt_state = tx.init(params)
 
+    def loss_fn(p, ids, labels, mask):
+        if cfg.loss_chunks > 1:
+            from paddlefleetx_tpu.models.gpt.model import (
+                chunked_lm_loss,
+            )
+            return chunked_lm_loss(model, p, ids, labels, mask,
+                                   chunks=cfg.loss_chunks,
+                                   deterministic=True)
+        return cross_entropy_loss(
+            model.apply({"params": p}, ids), labels, mask)
+
     # donate params/opt_state — the engine's real train step does
-    # (engine.py donate_argnums), and undonated copies waste ~4.2G HBM
+    # (engine.py donate_argnums), and undonated copies waste ~4.2G HBM.
+    # The accumulation scan deliberately mirrors Engine._build_steps
+    # (core/engine.py train_step) without importing it: the bench must
+    # stay a standalone minimal step. If the engine's accumulation
+    # semantics change, update this mirror (the engine side is pinned
+    # by tests/test_engine.py::test_grad_accumulation_matches_single_batch).
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, ids, labels, mask):
-        def loss_fn(p):
-            if cfg.loss_chunks > 1:
-                from paddlefleetx_tpu.models.gpt.model import (
-                    chunked_lm_loss,
-                )
-                return chunked_lm_loss(model, p, ids, labels, mask,
-                                       chunks=cfg.loss_chunks,
-                                       deterministic=True)
-            return cross_entropy_loss(
-                model.apply({"params": p}, ids), labels, mask)
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+        if acc == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, ids, labels, mask)
+        else:
+            micro = jax.tree.map(
+                lambda x: x.reshape(acc, batch, *x.shape[1:]),
+                (ids, labels, mask))
+
+            def body(carry, mb):
+                loss_sum, grad_sum = carry
+                loss, grads = jax.value_and_grad(loss_fn)(params, *mb)
+                return (loss_sum + loss,
+                        jax.tree.map(jnp.add, grad_sum, grads)), None
+
+            zero = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            (loss, grads), _ = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32), zero), micro)
+            loss = loss / acc
+            grads = jax.tree.map(lambda g: g / acc, grads)
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
@@ -123,7 +155,7 @@ def bench_train():
                                        mask)
     float(loss)  # the param chain serializes all n_steps behind this
     dt = time.perf_counter() - t0
-    tokens_per_sec = batch * seq * n_steps / dt
+    tokens_per_sec = gbs * seq * n_steps / dt
 
     peak = PEAK_FLOPS.get(jax.devices()[0].platform)
     mfu = (tokens_per_sec * model_flops_per_token(cfg, seq) / peak) \
