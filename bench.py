@@ -61,8 +61,10 @@ def bench_train():
     # gradient accumulation amortizes the ~24 ms memory-bound optimizer
     # update over more tokens (engine semantics: one jitted step with a
     # lax.scan over microbatches). Measured r2 at bs8/save_dots:
-    # acc=1 0.420 MFU, acc=2 0.430, acc=4 0.441 (global batch 32).
-    acc = 4 if on_tpu else 1
+    # acc=1 0.420 MFU, acc=2 0.430, acc=4 0.441, acc=16 0.449.
+    # gbs 128 = 131k tokens/batch — conservative next to GPT-3's 0.5M
+    # token batches for the 350M class, so a legitimate operating point.
+    acc = 16 if on_tpu else 1
     # Operating point for the 16G v5e (measured r2, tokens/s at bs8):
     #   recompute=full                 32.6k  (mfu 0.401; ~33% FLOP
     #                                        overhead from full remat)
