@@ -36,8 +36,18 @@ class GenerationConfig:
     top_k: int = 0
     top_p: float = 1.0
     repetition_penalty: float = 1.0
+    #: tile each prompt this many times before sampling — every copy
+    #: samples an independent continuation (reference
+    #: ``expand_inputs_for_generation``, ``hybrid_model.py:1422-1426``)
+    num_return_sequences: int = 1
     eos_token_id: int = 50256
     pad_token_id: int = 50256
+
+    def __post_init__(self):
+        if self.num_return_sequences < 1:
+            raise ValueError(
+                f"num_return_sequences must be >= 1, got "
+                f"{self.num_return_sequences}")
 
     @classmethod
     def from_config(cls, section) -> "GenerationConfig":
@@ -58,13 +68,27 @@ def _decode_bias(valid_keys: jax.Array, dtype=jnp.float32) -> jax.Array:
 def generate(model, params, input_ids: jax.Array,
              attention_mask: Optional[jax.Array], rng: jax.Array,
              gen_cfg: GenerationConfig) -> jax.Array:
-    """Returns generated token ids ``[b, max_dec_len]``.
+    """Returns generated token ids ``[b * num_return_sequences,
+    max_dec_len]`` — prompt-major when ``num_return_sequences > 1``
+    (rows ``i*n .. i*n + n - 1`` are prompt ``i``'s copies).
 
     ``input_ids`` is left-padded ``[b, prompt_len]``;
     ``attention_mask`` marks real tokens (1) vs pads (0), or None for
     unpadded prompts.
     """
     cfg: GPTConfig = model.config
+    if gen_cfg.num_return_sequences > 1:
+        # reference expand_inputs_for_generation
+        # (hybrid_model.py:1422-1426): tile the batch BEFORE prefill so
+        # each prompt samples N independent continuations. The N copies
+        # prefill redundantly — same cost profile as the reference;
+        # tiling the cache after one prefill would be cheaper for long
+        # prompts but the scan-stacked cache puts batch at axis 1,
+        # making that transform fragile for no current need.
+        n = gen_cfg.num_return_sequences
+        input_ids = jnp.repeat(input_ids, n, axis=0)
+        if attention_mask is not None:
+            attention_mask = jnp.repeat(attention_mask, n, axis=0)
     b, prompt_len = input_ids.shape
     capacity = cfg.max_position_embeddings
     compute_dtype = jnp.dtype(cfg.dtype)

@@ -202,7 +202,11 @@ class GPTGenerationModule(GPTModule):
                     # prefill reads logits from the last slot)
                     "pad_sides": ["left", "left"],
                     "max_dec_len": gen_cfg.max_dec_len,
-                    "eos_token_id": gen_cfg.eos_token_id}
+                    "eos_token_id": gen_cfg.eos_token_id,
+                    # output rows = batch * num_return_sequences,
+                    # prompt-major — consumers must de-tile with this
+                    "num_return_sequences":
+                        gen_cfg.num_return_sequences}
         return fn, spec, metadata
 
     def generate(self, params, texts, rng=None):

@@ -46,6 +46,40 @@ def test_greedy_matches_argmax_unrolled(model_and_params):
     np.testing.assert_array_equal(got, np.stack(expect, 1))
 
 
+def test_num_return_sequences_tiles_prompts(model_and_params):
+    """num_return_sequences=N: N rows per prompt (prompt-major, the
+    reference's expand_inputs_for_generation), each an independent
+    sample; under greedy decoding all copies are identical."""
+    model, params = model_and_params
+    prompt = jnp.asarray(
+        np.random.default_rng(2).integers(0, 90, (2, 7)), jnp.int32)
+    greedy = GenerationConfig(max_dec_len=5,
+                              decode_strategy="greedy_search",
+                              num_return_sequences=3,
+                              eos_token_id=EOS, pad_token_id=PAD)
+    out = np.asarray(generate(model, params, prompt, None,
+                              jax.random.key(1), greedy))
+    assert out.shape == (6, 5)
+    base = GenerationConfig(max_dec_len=5,
+                            decode_strategy="greedy_search",
+                            eos_token_id=EOS, pad_token_id=PAD)
+    single = np.asarray(generate(model, params, prompt, None,
+                                 jax.random.key(1), base))
+    for i in range(2):
+        for j in range(3):
+            np.testing.assert_array_equal(out[i * 3 + j], single[i])
+
+    sampling = GenerationConfig(max_dec_len=8,
+                                decode_strategy="sampling", top_k=50,
+                                num_return_sequences=4,
+                                eos_token_id=EOS, pad_token_id=PAD)
+    s = np.asarray(generate(model, params, prompt, None,
+                            jax.random.key(3), sampling))
+    assert s.shape == (8, 8)
+    # the copies explore different continuations
+    assert any(not np.array_equal(s[0], s[j]) for j in range(1, 4))
+
+
 def test_left_padded_prompt_matches_unpadded(model_and_params):
     """Generation from a left-padded prompt == the unpadded prompt."""
     model, params = model_and_params
