@@ -515,6 +515,11 @@ class Engine(BasicEngine):
         logger.info("  steady state: mean %.4f / min %.4f / max %.4f "
                     "s/step (%.2f step/s)", mean, min(steady),
                     max(steady), 1.0 / mean if mean else 0.0)
+        if (self.configs.get("Profiler", {}) or {}).get("detailed"):
+            # reference Profiler.detailed prints the full table views;
+            # the host-side analogue is every window's timing
+            for i, c in enumerate(costs):
+                logger.info("    window %3d: %.4f s/step", i, c)
         from .module import LanguageModule
         tokens = self.global_batch_size * self.configs.get(
             "Data", {}).get("Train", {}).get("dataset", {}).get(

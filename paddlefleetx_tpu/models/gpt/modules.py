@@ -174,10 +174,12 @@ class GPTGenerationModule(GPTModule):
         here the jitted ``generate`` itself is the artifact).
 
         Exported signature: ``(params, input_ids[b, prompt], mask[b,
-        prompt]) -> ids[b, max_dec_len]``; prompt capacity is
-        ``max_position_embeddings - max_dec_len``. Sampling randomness
-        is derived from the config seed and the prompt so the artifact
-        stays a pure function of its inputs.
+        prompt]) -> ids[b * num_return_sequences, max_dec_len]``
+        (prompt-major rows; the metadata carries
+        ``num_return_sequences`` so consumers can de-tile); prompt
+        capacity is ``max_position_embeddings - max_dec_len``.
+        Sampling randomness is derived from the config seed and the
+        prompt so the artifact stays a pure function of its inputs.
         """
         import jax
         import jax.numpy as jnp
