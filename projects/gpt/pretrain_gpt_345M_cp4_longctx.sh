@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Long-context GPT-345M pretraining: sequence sharded 4 ways over the
+# cp (ring attention) mesh axis. Beyond the reference's capability
+# surface (SURVEY.md §5.7: no ring/context parallelism there).
+set -eux
+
+python tools/train.py \
+    -c configs/nlp/gpt/pretrain_gpt_345M_cp4_longctx.yaml "$@"

@@ -110,6 +110,33 @@ def test_engine_defaults(cfg_tree):
     assert cfg.Engine.accumulate_steps == 1
 
 
+def test_every_shipped_yaml_parses():
+    """Each configs/**/*.yaml passes get_config at its own world size
+    — a config that ships but cannot parse is dead surface."""
+    import glob
+    import os
+
+    from paddlefleetx_tpu.utils.config import get_config, parse_config
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for path in sorted(glob.glob(os.path.join(repo, "configs", "**",
+                                              "*.yaml"),
+                                 recursive=True)):
+        if os.path.basename(path).endswith("base.yaml"):
+            continue  # bases are abstract (merged into children)
+        # world size from the MERGED tree (_base_ resolved) — a child
+        # may inherit its whole Distributed section
+        raw = parse_config(path)
+        dist = raw.get("Distributed", {}) or {}
+        nranks = 1
+        for k in ("dp_degree", "mp_degree", "pp_degree", "cp_degree"):
+            nranks *= dist.get(k) or 1
+        nranks *= (dist.get("sharding") or {}).get(
+            "sharding_degree") or 1
+        cfg = get_config(path, show=False, nranks=max(nranks, 1))
+        assert cfg.Global.global_batch_size, path
+
+
 def test_get_config_end_to_end(cfg_tree):
     cfg = get_config(str(cfg_tree / "child.yaml"),
                      overrides=["Model.num_layers=4"], nranks=8)
