@@ -25,7 +25,7 @@ layers, fp32 softmax/criterion under bf16 compute.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax

@@ -27,7 +27,7 @@ recompute granularities full / full_attn / core_attn
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax

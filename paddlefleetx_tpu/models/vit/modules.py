@@ -8,7 +8,6 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-import jax.numpy as jnp
 import numpy as np
 
 from .. import register_module

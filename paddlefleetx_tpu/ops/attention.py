@@ -17,7 +17,6 @@ relayout of the (large) cache happens on this path.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
