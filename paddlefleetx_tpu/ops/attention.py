@@ -73,7 +73,9 @@ def dot_product_attention(
     (the reference's ``attn_mask`` convention, additive -1e4 style).
     """
     skv = k.shape[3] if kv_cache_layout else k.shape[1]
-    if use_flash and dropout_rate == 0.0:
+    # deterministic makes a configured dropout_rate inert, so eval and
+    # generation may take the kernel even when training cannot
+    if use_flash and (deterministic or dropout_rate == 0.0):
         # the decode kernel takes a per-key additive bias (generation's
         # left-pad mask: [b, 1, 1, skv]); the training kernel does not
         decode_bias_ok = causal and q.shape[1] == 1 and (
