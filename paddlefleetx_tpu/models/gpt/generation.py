@@ -31,14 +31,22 @@ class GenerationConfig:
     """Knobs named as in the reference YAML ``Generation`` section."""
     max_dec_len: int = 20
     min_dec_len: int = 0
-    decode_strategy: str = "sampling"   # sampling | greedy_search
+    #: sampling | greedy_search | beam_search — beam search goes
+    #: BEYOND the reference, whose generation raises for any strategy
+    #: but sampling (``hybrid_model.py:1432``)
+    decode_strategy: str = "sampling"
     temperature: float = 1.0
     top_k: int = 0
     top_p: float = 1.0
+    num_beams: int = 1
+    #: GNMT length penalty exponent (0 = pure log-prob)
+    length_penalty: float = 0.0
     repetition_penalty: float = 1.0
-    #: tile each prompt this many times before sampling — every copy
-    #: samples an independent continuation (reference
-    #: ``expand_inputs_for_generation``, ``hybrid_model.py:1422-1426``)
+    #: sampling/greedy: tile each prompt this many times before
+    #: sampling — every copy samples an independent continuation
+    #: (reference ``expand_inputs_for_generation``,
+    #: ``hybrid_model.py:1422-1426``). beam_search: return this many
+    #: best beams per prompt (must be <= num_beams).
     num_return_sequences: int = 1
     eos_token_id: int = 50256
     pad_token_id: int = 50256
@@ -48,6 +56,17 @@ class GenerationConfig:
             raise ValueError(
                 f"num_return_sequences must be >= 1, got "
                 f"{self.num_return_sequences}")
+        if self.decode_strategy not in ("sampling", "greedy_search",
+                                        "beam_search"):
+            raise ValueError(
+                f"unknown decode_strategy {self.decode_strategy!r}")
+        if self.decode_strategy == "beam_search":
+            if self.num_beams < 1:
+                raise ValueError("num_beams must be >= 1")
+            if self.num_return_sequences > self.num_beams:
+                raise ValueError(
+                    f"num_return_sequences ({self.num_return_sequences})"
+                    f" cannot exceed num_beams ({self.num_beams})")
 
     @classmethod
     def from_config(cls, section) -> "GenerationConfig":
@@ -77,18 +96,17 @@ def generate(model, params, input_ids: jax.Array,
     unpadded prompts.
     """
     cfg: GPTConfig = model.config
-    if gen_cfg.num_return_sequences > 1:
-        # reference expand_inputs_for_generation
-        # (hybrid_model.py:1422-1426): tile the batch BEFORE prefill so
-        # each prompt samples N independent continuations. The N copies
-        # prefill redundantly — same cost profile as the reference;
-        # tiling the cache after one prefill would be cheaper for long
-        # prompts but the scan-stacked cache puts batch at axis 1,
-        # making that transform fragile for no current need.
-        n = gen_cfg.num_return_sequences
-        input_ids = jnp.repeat(input_ids, n, axis=0)
+    beam = gen_cfg.decode_strategy == "beam_search"
+    # beam search keeps num_beams rows per prompt live; sampling tiles
+    # by num_return_sequences (reference expand_inputs_for_generation,
+    # hybrid_model.py:1422-1426 — tile BEFORE prefill: the copies
+    # prefill redundantly, the reference's cost profile; re-tiling the
+    # scan-stacked cache after one prefill would be fragile)
+    tile = gen_cfg.num_beams if beam else gen_cfg.num_return_sequences
+    if tile > 1:
+        input_ids = jnp.repeat(input_ids, tile, axis=0)
         if attention_mask is not None:
-            attention_mask = jnp.repeat(attention_mask, n, axis=0)
+            attention_mask = jnp.repeat(attention_mask, tile, axis=0)
     b, prompt_len = input_ids.shape
     capacity = cfg.max_position_embeddings
     compute_dtype = jnp.dtype(cfg.dtype)
@@ -168,11 +186,142 @@ def generate(model, params, input_ids: jax.Array,
         next_logits = logits[:, -1, :].astype(jnp.float32)
         return (cache, next_logits, appeared, finished, valid), token
 
+    if beam:
+        return _beam_search(model, params, cache, last_logits,
+                            base_valid, lengths, prompt_len, gen_cfg,
+                            appeared0)
+
     finished0 = jnp.zeros((b,), bool)
     (_, _, _, _, _), tokens = jax.lax.scan(
         body, (cache, last_logits, appeared0, finished0, base_valid),
         jnp.arange(gen_cfg.max_dec_len))
     return tokens.T  # [b, max_dec_len]
+
+
+def _gather_cache(cache, gidx):
+    """Reorder the decode cache's batch axis to beam assignments.
+
+    The KV leaves are ``[b, h, d, S]`` (or ``[L, b, h, d, S]`` under
+    the layer scan) — the batch axis is always ``ndim - 4``;
+    ``cache_index`` is batch-free and passes through."""
+    def g(path, leaf):
+        name = getattr(path[-1], "key", "")
+        if name in ("cached_key", "cached_value"):
+            return jnp.take(leaf, gidx, axis=leaf.ndim - 4)
+        return leaf
+    return jax.tree_util.tree_map_with_path(g, cache)
+
+
+def _length_penalty(length, alpha):
+    """GNMT: ``((5 + len) / 6) ** alpha`` (alpha 0 = pure log-prob)."""
+    return ((5.0 + length.astype(jnp.float32)) / 6.0) ** alpha
+
+
+def _beam_search(model, params, cache, last_logits, base_valid,
+                 lengths, prompt_len, gen_cfg, appeared0):
+    """Beam search over the tiled ``b0 * k`` batch (beyond the
+    reference, which supports sampling only — its processor file
+    carries beam machinery the model never drives).
+
+    Two-pool fixed-width search inside one ``lax.scan`` (the t5x
+    shape): per step the top ``2k`` of the ``k * V`` candidates per
+    prompt split into EOS hypotheses — inserted, length-penalized,
+    into a separate finished pool they can never be evicted from by
+    live beams — and the ``k`` best non-EOS continuations, which the
+    KV cache is reordered to follow. The final ranking merges the
+    finished pool with the length-penalized live beams and returns the
+    ``num_return_sequences`` best per prompt, prompt-major. Applies
+    min-length and repetition-penalty processing like the sampling
+    path.
+    """
+    k = gen_cfg.num_beams
+    V = last_logits.shape[-1]
+    b = last_logits.shape[0]
+    b0 = b // k
+    eos, pad = gen_cfg.eos_token_id, gen_cfg.pad_token_id
+    dec = gen_cfg.max_dec_len
+
+    # only beam 0 is live at step 0 (all k rows are prompt copies)
+    alive0 = jnp.tile(
+        jnp.asarray([0.0] + [NEG_INF] * (k - 1), jnp.float32), (b0, 1))
+    seqs0 = jnp.full((b, dec), pad, jnp.int32)
+    fin_scores0 = jnp.full((b0, k), NEG_INF, jnp.float32)
+    fin_seqs0 = jnp.full((b0, k, dec), pad, jnp.int32)
+    # appeared0 carries the prompt tokens (same repetition-penalty
+    # seeding as the sampling path)
+
+    def body(carry, step_idx):
+        (cache, logits, alive, seqs, appeared, fin_scores,
+         fin_seqs, valid) = carry
+        logits = repetition_penalty_processor(
+            logits.astype(jnp.float32), appeared,
+            gen_cfg.repetition_penalty)
+        logits = min_length_processor(logits, step_idx,
+                                      gen_cfg.min_dec_len, eos)
+        logp = jax.nn.log_softmax(logits, -1)
+        cand = alive[..., None] + logp.reshape(b0, k, V)
+        n_top = min(2 * k, k * V)
+        top_scores, top_idx = jax.lax.top_k(cand.reshape(b0, k * V),
+                                            n_top)
+        src_beam = top_idx // V                        # [b0, 2k]
+        token = (top_idx % V).astype(jnp.int32)
+        is_eos = token == eos
+
+        # finished pool: EOS candidates enter length-penalized and
+        # compete only against other finished hypotheses
+        cand_fin = jnp.where(
+            is_eos,
+            top_scores / _length_penalty(
+                jnp.full_like(top_scores, step_idx + 1.0),
+                gen_cfg.length_penalty),
+            NEG_INF)
+        # materialize each candidate's sequence (prefix + eos)
+        cand_rows = (jnp.arange(b0)[:, None] * k + src_beam)  # [b0,2k]
+        cand_seqs = seqs[cand_rows.reshape(-1)].reshape(b0, n_top, dec)
+        cand_seqs = cand_seqs.at[:, :, step_idx].set(token)
+        merged_scores = jnp.concatenate([fin_scores, cand_fin], axis=1)
+        merged_seqs = jnp.concatenate([fin_seqs, cand_seqs], axis=1)
+        fin_scores, keep = jax.lax.top_k(merged_scores, k)
+        fin_seqs = jnp.take_along_axis(
+            merged_seqs, keep[..., None], axis=1)
+
+        # alive pool: best k non-EOS continuations
+        alive_cand = jnp.where(is_eos, NEG_INF, top_scores)
+        alive, pick = jax.lax.top_k(alive_cand, k)     # [b0, k]
+        token_k = jnp.take_along_axis(token, pick, axis=1)
+        src_k = jnp.take_along_axis(src_beam, pick, axis=1)
+        gidx = (jnp.arange(b0)[:, None] * k + src_k).reshape(-1)
+
+        seqs = seqs[gidx].at[:, step_idx].set(token_k.reshape(-1))
+        appeared = appeared[gidx].at[
+            jnp.arange(b), token_k.reshape(-1)].set(True)
+        cache = _gather_cache(cache, gidx)
+        valid = valid[gidx].at[:, prompt_len + step_idx].set(1)
+        step_pos = (lengths + step_idx)[:, None]     # equal per group
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache},
+            token_k.reshape(-1)[:, None], position_ids=step_pos,
+            attn_bias=_decode_bias(valid.astype(bool)),
+            use_cache=True, deterministic=True, mutable=["cache"])
+        return (mutated["cache"], logits[:, -1].astype(jnp.float32),
+                alive, seqs, appeared, fin_scores, fin_seqs,
+                valid), None
+
+    (_, _, alive, seqs, _, fin_scores, fin_seqs, _), _ = jax.lax.scan(
+        body, (cache, last_logits, alive0, seqs0, appeared0,
+               fin_scores0, fin_seqs0, base_valid), jnp.arange(dec))
+
+    # merge live beams (length-penalized at full length) with the
+    # finished pool and pick the n best per prompt
+    alive_final = alive / _length_penalty(
+        jnp.full_like(alive, float(dec)), gen_cfg.length_penalty)
+    all_scores = jnp.concatenate([fin_scores, alive_final], axis=1)
+    all_seqs = jnp.concatenate(
+        [fin_seqs, seqs.reshape(b0, k, dec)], axis=1)
+    _, best = jax.lax.top_k(all_scores,
+                            gen_cfg.num_return_sequences)
+    out = jnp.take_along_axis(all_seqs, best[..., None], axis=1)
+    return out.reshape(b0 * gen_cfg.num_return_sequences, dec)
 
 
 def left_pad_batch(sequences, pad_id: int):
