@@ -62,6 +62,9 @@ class ImagenConfig:
     #: math stays fp32; unet inputs are cast at the call boundary so
     #: promotion doesn't silently drag the net back to fp32.
     dtype: str = "float32"
+    #: spatial self-attention through the flash kernel on TPU — the SR
+    #: U-Nets' deepest stages attend over 16K tokens (see UnetConfig)
+    use_flash_attention: bool = False
     p2_loss_weight_gamma: float = 0.5
     dynamic_thresholding: bool = True
     dynamic_thresholding_percentile: float = 0.95
@@ -106,6 +109,8 @@ class ImagenModel(nn.Module):
             kw.update(overrides)
             kw["channels"] = cfg.in_chans
             kw["text_embed_dim"] = cfg.text_embed_dim
+            kw.setdefault("use_flash_attention",
+                          cfg.use_flash_attention)
             if i > 0:
                 kw["lowres_cond"] = True  # cascade stages condition on
                 #                           the previous resolution

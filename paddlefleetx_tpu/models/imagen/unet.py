@@ -25,6 +25,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ...ops.attention import dot_product_attention
+
 
 def _t(v, n: int) -> Tuple:
     """cast_tuple: scalar-or-seq -> length-n tuple."""
@@ -53,6 +55,11 @@ class UnetConfig:
     cross_embed_kernel_sizes: Sequence[int] = (3, 7, 15)
     lowres_cond: bool = False      # cascade upsampler conditioning
     memory_efficient: bool = False
+    #: route spatial self-attention through ops.dot_product_attention
+    #: (Pallas flash kernel on TPU for 2048+ tokens). The SR U-Nets'
+    #: deepest stages attend over 128x128 = 16K tokens, where dense
+    #: [b, h, s, s] scores are not materializable.
+    use_flash_attention: bool = False
     dtype: str = "float32"
     param_dtype: str = "float32"
 
@@ -185,10 +192,9 @@ class SelfAttention(nn.Module):
         q = nn.DenseGeneral((h, dh), use_bias=False, name="to_q")(xn)
         k = nn.DenseGeneral((h, dh), use_bias=False, name="to_k")(xn)
         v = nn.DenseGeneral((h, dh), use_bias=False, name="to_v")(xn)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * dh ** -0.5
-        attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1) \
-            .astype(scores.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+        out = dot_product_attention(
+            q, k, v, causal=False,
+            use_flash=cfg.use_flash_attention)
         return nn.DenseGeneral(self.dim, axis=(-2, -1), use_bias=False,
                                name="to_out")(out)
 
