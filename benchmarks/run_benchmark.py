@@ -33,7 +33,12 @@ def get_args(argv=None):
     p.add_argument("--model_item", default="gpt_345M")
     p.add_argument("--config", required=True)
     p.add_argument("--overrides", nargs="*", default=[],
-                   help="-o style dotted overrides")
+                   action="extend",
+                   help="-o style dotted overrides; repeatable — the "
+                        "TIPC scripts pass their topology overrides "
+                        "and forward \"$@\" so callers can APPEND "
+                        "more (a second flag must not replace the "
+                        "first)")
     p.add_argument("--max_steps", type=int, default=100)
     p.add_argument("--skip_steps", type=int, default=2,
                    help="warmup log lines excluded from the ips average")

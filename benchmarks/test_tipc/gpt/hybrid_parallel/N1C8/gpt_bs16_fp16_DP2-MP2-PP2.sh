@@ -3,6 +3,10 @@
 # (reference N1C8/gpt_bs16_fp16_DP2-MP2-PP2.sh). Without 8 real chips,
 # CPU_DEVICES=8 runs the same topology on the virtual CPU mesh.
 cd "$(dirname "$0")/../../../../.."
+# NOTE: full-vocab steps are minutes-slow on a virtual CPU mesh — for a
+# fast correctness pass append vocab/width shrink overrides the way
+# tests/test_scale_proof.py does; this script's unshrunk form targets
+# real chips.
 python benchmarks/run_benchmark.py \
   --model_item gpt_bs16_fp16_DP2-MP2-PP2 \
   --config configs/nlp/gpt/pretrain_gpt_345M_single_card.yaml \

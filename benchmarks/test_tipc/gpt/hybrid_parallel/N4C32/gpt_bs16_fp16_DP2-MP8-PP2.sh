@@ -5,6 +5,10 @@
 # — the multi-node axes (dp over DCN, mp/pp over ICI) are exercised by
 # GSPMD identically.
 cd "$(dirname "$0")/../../../../.."
+# NOTE: full-vocab steps are minutes-slow on a virtual CPU mesh — for a
+# fast correctness pass append vocab/width shrink overrides the way
+# tests/test_scale_proof.py does; this script's unshrunk form targets
+# real chips.
 python benchmarks/run_benchmark.py \
   --model_item gpt_bs16_fp16_DP2-MP8-PP2 \
   --config configs/nlp/gpt/pretrain_gpt_345M_single_card.yaml \

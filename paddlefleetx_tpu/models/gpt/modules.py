@@ -61,15 +61,15 @@ class GPTModule(LanguageModule):
             and self.model_config.attention_probs_dropout_prob == 0.0)
         pp = (self.configs.get("Distributed") or {}).get("pp_degree", 1) \
             or 1
-        if self.model_config.loss_chunks > 1 and \
-                (pp > 1 or self.qat_cfg.enable):
+        # pp > 1 never reaches here with loss_chunks > 1:
+        # process_model_configs subsumes the knob (the pipeline already
+        # computes per-microbatch logits) and resets it to 1
+        if self.model_config.loss_chunks > 1 and self.qat_cfg.enable:
             # a silent dense fallback would defeat the knob's
             # O(s/chunks) logits-memory purpose (same policy as the
             # cp guard above)
             raise ValueError(
-                "loss_chunks > 1 is not supported with pipeline "
-                "parallelism or QAT; the pp path computes per-"
-                "microbatch logits already")
+                "loss_chunks > 1 is not supported with QAT")
         if pp > 1 and self.qat_cfg.enable:
             raise ValueError("QAT is not supported with pipeline "
                              "parallelism (reference QAT recipe is "

@@ -29,6 +29,16 @@ def process_model_configs(config) -> None:
             raise ValueError(
                 "pipeline parallelism requires scan_layers (stacked "
                 "decoder params sharded over the pp axis)")
+        if (model.get("loss_chunks") or 1) > 1:
+            # the pipeline computes the loss per microbatch, which IS
+            # the logits-memory property loss_chunks exists for — the
+            # knob is subsumed, not silently dropped (a base config
+            # default must not make every pp override fatal)
+            from ..utils.log import logger
+            logger.info("pp_degree > 1 computes per-microbatch logits; "
+                        "loss_chunks=%s is subsumed and reset to 1",
+                        model["loss_chunks"])
+            model["loss_chunks"] = 1
     if vpp > 1:
         local_batch_size = config.Global.local_batch_size
         micro_batch_size = config.Global.micro_batch_size

@@ -137,6 +137,33 @@ def test_every_shipped_yaml_parses():
         assert cfg.Global.global_batch_size, path
 
 
+def test_pp_subsumes_loss_chunks():
+    """A base config that defaults loss_chunks > 1 must not make pp
+    overrides fatal: the pipeline computes per-microbatch logits (the
+    knob's memory property), so process_model_configs resets it to 1."""
+    import os
+
+    from paddlefleetx_tpu.utils.config import get_config
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = get_config(
+        os.path.join(repo, "configs/nlp/gpt/"
+                           "pretrain_gpt_345M_single_card.yaml"),
+        overrides=["Distributed.pp_degree=2",
+                   "Distributed.dp_degree=4",
+                   # shrink so module construction stays instant
+                   "Model.num_layers=2", "Model.hidden_size=64",
+                   "Model.num_attention_heads=4",
+                   "Model.ffn_hidden_size=128", "Model.vocab_size=128",
+                   "Model.max_position_embeddings=64"],
+        show=False, nranks=8)
+    assert cfg.Model.loss_chunks == 8      # raw parse keeps the knob
+    from paddlefleetx_tpu.models import build_module
+    module = build_module(cfg)             # module-level processing
+    assert cfg.Model.loss_chunks == 1      # ...subsumes it under pp
+    assert module.model_config.loss_chunks == 1
+
+
 def test_get_config_end_to_end(cfg_tree):
     cfg = get_config(str(cfg_tree / "child.yaml"),
                      overrides=["Model.num_layers=4"], nranks=8)
