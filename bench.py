@@ -1,10 +1,12 @@
 """Headline benchmark: GPT-345M pretraining throughput on one chip.
 
 Prints ONE JSON line ``{"metric", "value", "unit", "vs_baseline",
-"mfu"}``. Baseline: the reference's published single-card number —
-~16,200 tokens/s on V100-32G (reference
+"mfu", "mfu_6p7b_decoder_geometry"}``. Baseline: the reference's
+published single-card number — ~16,200 tokens/s on V100-32G (reference
 ``projects/gpt/docs/single_card.md:41-49``, recorded in BASELINE.md).
-``vs_baseline`` = ours / 16200.
+``vs_baseline`` = ours / 16200. ``mfu_6p7b_decoder_geometry`` is the
+decoder-stack MFU at 6.7B shapes (h=4096/s=2048/d=128; see
+``decoder_geometry_mfu``).
 
 ``mfu`` is model-FLOPs utilization against the chip's bf16 peak
 (Megatron formula: 72*L*h^2*(1 + s/6h + V/12Lh) FLOPs/token, counting
@@ -36,8 +38,31 @@ from paddlefleetx_tpu.models.gpt import (  # noqa: E402
 )
 
 BASELINE_TOKENS_PER_SEC = 16200.0
-# bf16 peak of the bench chip (v5e). v5p would be 459e12.
-PEAK_FLOPS = {"tpu": 197e12}
+# bf16 dense peak by device kind (jax Device.device_kind) — platform
+# alone can't distinguish TPU generations and would silently mis-scale
+# MFU on anything but the calibrated chip.
+PEAK_FLOPS_BY_KIND = {
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops() -> float:
+    d = jax.devices()[0]
+    if d.platform != "tpu":
+        return None
+    peak = PEAK_FLOPS_BY_KIND.get(d.device_kind)
+    if peak is None:
+        sys.stderr.write(
+            f"warning: unknown TPU device_kind {d.device_kind!r}; "
+            f"MFU not reported (add it to PEAK_FLOPS_BY_KIND)\n")
+    return peak
 
 
 def _gpt345m(on_tpu: bool, **kw):
@@ -55,34 +80,9 @@ def model_flops_per_token(cfg: GPTConfig, seq: int) -> float:
     return 72.0 * L * h * h * (1 + seq / (6.0 * h) + V / (12.0 * L * h))
 
 
-def bench_train():
-    on_tpu = jax.devices()[0].platform == "tpu"
-    batch, seq = (8, 1024) if on_tpu else (2, 256)
-    # gradient accumulation amortizes the ~24 ms memory-bound optimizer
-    # update over more tokens (engine semantics: one jitted step with a
-    # lax.scan over microbatches). Measured r2 at bs8/save_dots:
-    # acc=1 0.420 MFU, acc=2 0.430, acc=4 0.441, acc=16 0.449.
-    # gbs 128 = 131k tokens/batch — conservative next to GPT-3's 0.5M
-    # token batches for the 350M class, so a legitimate operating point.
-    acc = 16 if on_tpu else 1
-    # Operating point for the 16G v5e (measured r2, tokens/s at bs8):
-    #   recompute=full                 32.6k  (mfu 0.401; ~33% FLOP
-    #                                        overhead from full remat)
-    #   recompute=save_dots + chunked  34.3k  (mfu 0.422; keeps matmul
-    #     loss (loss_chunks=8) + bf16        outputs, recomputes only
-    #     first moments                      elementwise in backward)
-    #   core_attn / full_attn / none   OOM at bs>=6 — the fp32 master
-    #     params + moments (~4.2G) plus those policies' residuals
-    #     exceed 16G (reference ran fp16 on a 32G V100).
-    # Remaining gap to peak is shape-bound, not policy-bound: the
-    # h=1024 GEMMs reach 0.73-0.85 util chained, but d=64 attention is
-    # VPU-bound in any implementation (our Pallas kernel runs 2.3x
-    # JAX's reference flash kernel at these shapes and is exp-pass
-    # limited), and the optimizer update is a ~24ms memory-bound floor.
-    cfg = _gpt345m(on_tpu, use_recompute=on_tpu,
-                   recompute_granularity="save_dots" if on_tpu
-                   else "full",
-                   loss_chunks=8 if on_tpu else 1)
+def _measure_train(cfg, batch, seq, acc, n_steps, on_tpu):
+    """tokens/s of the standalone accumulation train step for ``cfg``
+    at ``batch``x``seq`` per microbatch, ``acc`` microbatches."""
     model = GPTForPretraining(cfg)
 
     rng = np.random.default_rng(0)
@@ -150,24 +150,84 @@ def bench_train():
     params, opt_state, loss = step(params, opt_state, ids, labels, mask)
     float(loss)
 
-    n_steps = 10 if on_tpu else 3
     t0 = time.perf_counter()
     for _ in range(n_steps):
         params, opt_state, loss = step(params, opt_state, ids, labels,
                                        mask)
     float(loss)  # the param chain serializes all n_steps behind this
     dt = time.perf_counter() - t0
-    tokens_per_sec = gbs * seq * n_steps / dt
+    return gbs * seq * n_steps / dt
 
-    peak = PEAK_FLOPS.get(jax.devices()[0].platform)
+
+def decoder_geometry_mfu(peak) -> float:
+    """Decoder-stack MFU at the 6.7B geometry (reference
+    ``pretrain_gpt_6.7B_sharding16.yaml``: h=4096, nh=32 (d=128),
+    ffn=16384, s=2048). The full 32-layer 6.7B model cannot fit one
+    16G v5e, so this measures a real fwd+bwd+adamw train step over 3
+    of the 32 layers (fp32 master + moments for even 4 layers of
+    h=4096 exceed 15.75G with the gradient tree in flight) and
+    reports MFU against the decoder-only FLOPs
+    ``72*L*h^2*(1 + s/6h)`` — per-layer work is depth-independent
+    under ``nn.scan``, so the 3-layer stack's per-layer MFU transfers.
+    The tiny-vocab (8192) embedding/LM-head work it does on top is
+    NOT counted: the reported number slightly undercounts true
+    utilization."""
+    L, h, s, b, acc = 3, 4096, 2048, 2, 1
+    cfg = GPTConfig(
+        vocab_size=8192, hidden_size=h, num_layers=L,
+        num_attention_heads=32, ffn_hidden_size=4 * h,
+        max_position_embeddings=s, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, dtype="bfloat16",
+        use_flash_attention=True, use_recompute=True,
+        recompute_granularity="save_dots", loss_chunks=4)
+    tps = _measure_train(cfg, b, s, acc, 6, True)
+    decoder_flops_per_token = 72.0 * L * h * h * (1 + s / (6.0 * h))
+    return tps * decoder_flops_per_token / peak
+
+
+def bench_train():
+    on_tpu = jax.devices()[0].platform == "tpu"
+    batch, seq = (8, 1024) if on_tpu else (2, 256)
+    # gradient accumulation amortizes the ~24 ms memory-bound optimizer
+    # update over more tokens (engine semantics: one jitted step with a
+    # lax.scan over microbatches). Measured r2 at bs8/save_dots:
+    # acc=1 0.420 MFU, acc=2 0.430, acc=4 0.441, acc=16 0.449.
+    # gbs 128 = 131k tokens/batch — conservative next to GPT-3's 0.5M
+    # token batches for the 350M class, so a legitimate operating point.
+    acc = 16 if on_tpu else 1
+    # Operating point for the 16G v5e (measured r2, tokens/s at bs8):
+    #   recompute=full                 32.6k  (mfu 0.401; ~33% FLOP
+    #                                        overhead from full remat)
+    #   recompute=save_dots + chunked  34.3k  (mfu 0.422; keeps matmul
+    #     loss (loss_chunks=8) + bf16        outputs, recomputes only
+    #     first moments                      elementwise in backward)
+    #   core_attn / full_attn / none   OOM at bs>=6 — the fp32 master
+    #     params + moments (~4.2G) plus those policies' residuals
+    #     exceed 16G (reference ran fp16 on a 32G V100).
+    # Remaining gap to peak is shape-bound, not policy-bound: the
+    # h=1024 GEMMs reach 0.73-0.85 util chained, but d=64 attention is
+    # VPU-bound in any implementation (our Pallas kernel runs 2.3x
+    # JAX's reference flash kernel at these shapes and is exp-pass
+    # limited), and the optimizer update is a ~24ms memory-bound floor.
+    cfg = _gpt345m(on_tpu, use_recompute=on_tpu,
+                   recompute_granularity="save_dots" if on_tpu
+                   else "full",
+                   loss_chunks=8 if on_tpu else 1)
+    tokens_per_sec = _measure_train(cfg, batch, seq, acc,
+                                    10 if on_tpu else 3, on_tpu)
+
+    peak = peak_flops() if on_tpu else None
     mfu = (tokens_per_sec * model_flops_per_token(cfg, seq) / peak) \
         if peak else None
+    mfu_67b = decoder_geometry_mfu(peak) if peak else None
     print(json.dumps({
         "metric": "gpt345m_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 3),
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "mfu_6p7b_decoder_geometry":
+            round(mfu_67b, 4) if mfu_67b is not None else None,
     }))
 
 

@@ -21,8 +21,8 @@ import jax.numpy as jnp
 
 from .config import GPTConfig
 from .processors import (
-    min_length_processor, repetition_penalty_processor,
-    top_k_top_p_filter, NEG_INF,
+    hamming_diversity_processor, min_length_processor,
+    repetition_penalty_processor, top_k_top_p_filter, NEG_INF,
 )
 
 
@@ -39,6 +39,16 @@ class GenerationConfig:
     top_k: int = 0
     top_p: float = 1.0
     num_beams: int = 1
+    #: diverse (group) beam search: beams split into this many groups,
+    #: decoded group-by-group within each step; later groups pay a
+    #: Hamming penalty on tokens earlier groups just chose (drives
+    #: ``hamming_diversity_processor``; the reference carries the
+    #: processor, ``gpt/dygraph/processor.py:106-155``, but nothing
+    #: invokes it). 1 = vanilla beam search.
+    num_beam_groups: int = 1
+    #: Hamming penalty strength for ``num_beam_groups > 1`` (the
+    #: reference processor's ``diversity_rate``)
+    diversity_rate: float = 0.0
     #: GNMT length penalty exponent (0 = pure log-prob)
     length_penalty: float = 0.0
     repetition_penalty: float = 1.0
@@ -67,6 +77,16 @@ class GenerationConfig:
                 raise ValueError(
                     f"num_return_sequences ({self.num_return_sequences})"
                     f" cannot exceed num_beams ({self.num_beams})")
+            if self.num_beam_groups < 1:
+                raise ValueError("num_beam_groups must be >= 1")
+            if self.num_beams % self.num_beam_groups:
+                raise ValueError(
+                    f"num_beams ({self.num_beams}) must be divisible "
+                    f"by num_beam_groups ({self.num_beam_groups})")
+            if self.num_beam_groups > 1 and self.diversity_rate <= 0.0:
+                raise ValueError(
+                    "num_beam_groups > 1 requires diversity_rate > 0 "
+                    "(otherwise the groups search identically)")
 
     @classmethod
     def from_config(cls, section) -> "GenerationConfig":
@@ -233,20 +253,39 @@ def _beam_search(model, params, cache, last_logits, base_valid,
     ``num_return_sequences`` best per prompt, prompt-major. Applies
     min-length and repetition-penalty processing like the sampling
     path.
+
+    NOTE: beam scores accumulate the PROCESSED log-probs (after
+    repetition-penalty / min-length / Hamming shaping), matching the
+    reference's and HF's beam semantics — so with
+    ``repetition_penalty != 1.0`` the ranking deviates from raw model
+    likelihood by design (pinned by
+    ``tests/test_generation.py::test_beam_search_repetition_penalty``).
+
+    With ``num_beam_groups > 1`` this becomes diverse (group) beam
+    search: each group of ``k/G`` beams runs the same two-pool update,
+    but groups are scored sequentially within a step and every group
+    after the first pays ``hamming_diversity_processor``'s penalty on
+    the tokens earlier groups just chose. One ``model.apply`` still
+    serves all ``k`` beams per step — only the selection loop is
+    per-group.
     """
     k = gen_cfg.num_beams
+    G = gen_cfg.num_beam_groups
+    kg = k // G
     V = last_logits.shape[-1]
     b = last_logits.shape[0]
     b0 = b // k
     eos, pad = gen_cfg.eos_token_id, gen_cfg.pad_token_id
     dec = gen_cfg.max_dec_len
 
-    # only beam 0 is live at step 0 (all k rows are prompt copies)
+    # only the first beam OF EACH GROUP is live at step 0 (all k rows
+    # are prompt copies; a dead group would never start)
     alive0 = jnp.tile(
-        jnp.asarray([0.0] + [NEG_INF] * (k - 1), jnp.float32), (b0, 1))
+        jnp.asarray(([0.0] + [NEG_INF] * (kg - 1)) * G, jnp.float32),
+        (b0, 1))
     seqs0 = jnp.full((b, dec), pad, jnp.int32)
-    fin_scores0 = jnp.full((b0, k), NEG_INF, jnp.float32)
-    fin_seqs0 = jnp.full((b0, k, dec), pad, jnp.int32)
+    fin_scores0 = jnp.full((b0, G, kg), NEG_INF, jnp.float32)
+    fin_seqs0 = jnp.full((b0, G, kg, dec), pad, jnp.int32)
     # appeared0 carries the prompt tokens (same repetition-penalty
     # seeding as the sampling path)
 
@@ -258,38 +297,65 @@ def _beam_search(model, params, cache, last_logits, base_valid,
             gen_cfg.repetition_penalty)
         logits = min_length_processor(logits, step_idx,
                                       gen_cfg.min_dec_len, eos)
-        logp = jax.nn.log_softmax(logits, -1)
-        cand = alive[..., None] + logp.reshape(b0, k, V)
-        n_top = min(2 * k, k * V)
-        top_scores, top_idx = jax.lax.top_k(cand.reshape(b0, k * V),
-                                            n_top)
-        src_beam = top_idx // V                        # [b0, 2k]
-        token = (top_idx % V).astype(jnp.int32)
-        is_eos = token == eos
+        logp = jax.nn.log_softmax(logits, -1).reshape(b0, k, V)
 
-        # finished pool: EOS candidates enter length-penalized and
-        # compete only against other finished hypotheses
-        cand_fin = jnp.where(
-            is_eos,
-            top_scores / _length_penalty(
-                jnp.full_like(top_scores, step_idx + 1.0),
-                gen_cfg.length_penalty),
-            NEG_INF)
-        # materialize each candidate's sequence (prefix + eos)
-        cand_rows = (jnp.arange(b0)[:, None] * k + src_beam)  # [b0,2k]
-        cand_seqs = seqs[cand_rows.reshape(-1)].reshape(b0, n_top, dec)
-        cand_seqs = cand_seqs.at[:, :, step_idx].set(token)
-        merged_scores = jnp.concatenate([fin_scores, cand_fin], axis=1)
-        merged_seqs = jnp.concatenate([fin_seqs, cand_seqs], axis=1)
-        fin_scores, keep = jax.lax.top_k(merged_scores, k)
-        fin_seqs = jnp.take_along_axis(
-            merged_seqs, keep[..., None], axis=1)
+        cur_tokens = jnp.zeros((b0, k), jnp.int32)
+        galive, gtokens, gsrc = [], [], []
+        gfin_scores, gfin_seqs = [], []
+        for g in range(G):
+            sl = slice(g * kg, (g + 1) * kg)
+            glogp = logp[:, sl]                        # [b0, kg, V]
+            if g > 0 and gen_cfg.diversity_rate > 0.0:
+                shaped = hamming_diversity_processor(
+                    glogp.reshape(b0 * kg, V),
+                    cur_tokens.reshape(-1), g,
+                    gen_cfg.diversity_rate, k, G)
+                glogp = shaped.reshape(b0, kg, V)
+            cand = alive[:, sl][..., None] + glogp
+            n_top = min(2 * kg, kg * V)
+            top_scores, top_idx = jax.lax.top_k(
+                cand.reshape(b0, kg * V), n_top)
+            src_beam = top_idx // V + g * kg           # absolute beam
+            token = (top_idx % V).astype(jnp.int32)
+            is_eos = token == eos
 
-        # alive pool: best k non-EOS continuations
-        alive_cand = jnp.where(is_eos, NEG_INF, top_scores)
-        alive, pick = jax.lax.top_k(alive_cand, k)     # [b0, k]
-        token_k = jnp.take_along_axis(token, pick, axis=1)
-        src_k = jnp.take_along_axis(src_beam, pick, axis=1)
+            # group finished pool: EOS candidates enter
+            # length-penalized and compete only against other finished
+            # hypotheses of the same group
+            cand_fin = jnp.where(
+                is_eos,
+                top_scores / _length_penalty(
+                    jnp.full_like(top_scores, step_idx + 1.0),
+                    gen_cfg.length_penalty),
+                NEG_INF)
+            # materialize each candidate's sequence (prefix + eos)
+            cand_rows = jnp.arange(b0)[:, None] * k + src_beam
+            cand_seqs = seqs[cand_rows.reshape(-1)].reshape(
+                b0, n_top, dec)
+            cand_seqs = cand_seqs.at[:, :, step_idx].set(token)
+            merged_scores = jnp.concatenate(
+                [fin_scores[:, g], cand_fin], axis=1)
+            merged_seqs = jnp.concatenate(
+                [fin_seqs[:, g], cand_seqs], axis=1)
+            fs, keep = jax.lax.top_k(merged_scores, kg)
+            gfin_scores.append(fs)
+            gfin_seqs.append(jnp.take_along_axis(
+                merged_seqs, keep[..., None], axis=1))
+
+            # group alive pool: best kg non-EOS continuations
+            alive_cand = jnp.where(is_eos, NEG_INF, top_scores)
+            al, pick = jax.lax.top_k(alive_cand, kg)   # [b0, kg]
+            tok = jnp.take_along_axis(token, pick, axis=1)
+            galive.append(al)
+            gtokens.append(tok)
+            gsrc.append(jnp.take_along_axis(src_beam, pick, axis=1))
+            cur_tokens = cur_tokens.at[:, sl].set(tok)
+
+        alive = jnp.concatenate(galive, axis=1)        # [b0, k]
+        token_k = jnp.concatenate(gtokens, axis=1)
+        src_k = jnp.concatenate(gsrc, axis=1)
+        fin_scores = jnp.stack(gfin_scores, axis=1)    # [b0, G, kg]
+        fin_seqs = jnp.stack(gfin_seqs, axis=1)
         gidx = (jnp.arange(b0)[:, None] * k + src_k).reshape(-1)
 
         seqs = seqs[gidx].at[:, step_idx].set(token_k.reshape(-1))
@@ -310,6 +376,8 @@ def _beam_search(model, params, cache, last_logits, base_valid,
     (_, _, alive, seqs, _, fin_scores, fin_seqs, _), _ = jax.lax.scan(
         body, (cache, last_logits, alive0, seqs0, appeared0,
                fin_scores0, fin_seqs0, base_valid), jnp.arange(dec))
+    fin_scores = fin_scores.reshape(b0, k)
+    fin_seqs = fin_seqs.reshape(b0, k, dec)
 
     # merge live beams (length-penalized at full length) with the
     # finished pool and pick the n best per prompt

@@ -33,6 +33,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -43,8 +44,21 @@ def _interpret() -> bool:
     """Interpreter mode lets CPU tests validate kernel semantics
     (``PFX_PALLAS_INTERPRET=1``)."""
     return os.environ.get("PFX_PALLAS_INTERPRET") == "1"
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_KV = 512
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_KV = 1024
+
+
+def _auto_block(s: int, target: int, align: int) -> int:
+    """Largest power-of-two-shrunk block <= target that divides s.
+    1024 blocks measure fastest on v5e at training shapes (b=8/h=16/
+    s=1024/d=64: fwd+bwd 1.89 ms vs 2.42 ms with 512 blocks — fewer
+    program launches and mask-free interior work amortize better);
+    halving keeps odd lengths (1536, 2560, ...) on the kernel instead
+    of falling back to the dense path."""
+    b = min(target, s)
+    while b > align and (s % b or b % align):
+        b //= 2
+    return b
 
 
 def _causal_mask(qi, ki, block_q, block_kv, offset):
@@ -324,6 +338,17 @@ def _flash_lse(q, k, v, sm_scale, causal, block_q, block_kv):
 def _flash_lse_fwd(q, k, v, sm_scale, causal, block_q, block_kv):
     out, lse = _flash_forward(q, k, v, sm_scale, causal, 0, block_q,
                               block_kv)
+    # Tag the residuals that only this kernel can produce with the same
+    # checkpoint name the model puts on q/k/v ("attn"): under a remat
+    # policy that saves "attn" (save_dots, core_attn) the backward can
+    # then reconstruct ALL residuals without re-running the forward
+    # kernel — without the tag the untagged lse forces a full forward
+    # re-run just to regenerate it (measured 19 ms of the 224 ms 345M
+    # microbatch, ~8%). lse is [bh, sq, 1] fp32 = 0.5 MB per 345M
+    # layer. Policies that exclude "attn" (full_attn) recompute
+    # exactly as before.
+    out = checkpoint_name(out, "attn")
+    lse = checkpoint_name(lse, "attn")
     return (out, lse), (q, k, v, out, lse)
 
 
@@ -336,13 +361,16 @@ def _flash_lse_bwd(sm_scale, causal, block_q, block_kv, res, g):
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
-def check_shapes(sq, skv, d, block_q: int = DEFAULT_BLOCK_Q,
-                 block_kv: int = DEFAULT_BLOCK_KV):
+def check_shapes(sq, skv, d, block_q: int = None,
+                 block_kv: int = None):
     """(block_q, block_kv) after clamping, or NotImplementedError —
     shared by the public wrappers and by callers (ring attention) that
-    must decide statically whether the kernel can take their shapes."""
-    block_q = min(block_q, sq)
-    block_kv = min(block_kv, skv)
+    must decide statically whether the kernel can take their shapes.
+    ``None`` blocks auto-pick the largest aligned divisor <= 1024."""
+    block_q = _auto_block(sq, DEFAULT_BLOCK_Q, 8) if block_q is None \
+        else min(block_q, sq)
+    block_kv = _auto_block(skv, DEFAULT_BLOCK_KV, 128) \
+        if block_kv is None else min(block_kv, skv)
     if sq % block_q or skv % block_kv:
         raise NotImplementedError(
             f"sequence ({sq}, {skv}) not divisible by blocks "
@@ -365,8 +393,7 @@ def _to_bh(x):
 
 
 def flash_attention(q, k, v, causal: bool = True, query_offset=0,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_kv: int = DEFAULT_BLOCK_KV):
+                    block_q: int = None, block_kv: int = None):
     """``[b, s, h, d]`` causal attention; raises NotImplementedError
     when the shape/backend can't take the kernel (caller falls back to
     the XLA path in ``ops.attention``)."""
@@ -387,8 +414,7 @@ def flash_attention(q, k, v, causal: bool = True, query_offset=0,
 
 def flash_attention_with_lse(q, k, v, causal: bool = True,
                              sm_scale=None,
-                             block_q: int = DEFAULT_BLOCK_Q,
-                             block_kv: int = DEFAULT_BLOCK_KV):
+                             block_q: int = None, block_kv: int = None):
     """Like :func:`flash_attention` but also returns the per-row
     logsumexp of the (scaled) scores, ``[b, h, sq]`` fp32 — the
     streaming-combination state ring attention needs to merge exact

@@ -1,0 +1,51 @@
+"""Capture an XLA op-level profile of one training microbatch and
+print the top ops by self time. Ad hoc: python scripts/trace_step.py
+"""
+
+import collections
+import glob
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scripts.profile_mfu import _model_and_batch, _sync
+from paddlefleetx_tpu.models.gpt.model import chunked_lm_loss
+
+cfg, model, params, ids, labels, mask = _model_and_batch()
+
+
+def loss_fn(p, ids, labels, mask):
+    return chunked_lm_loss(model, p, ids, labels, mask,
+                           chunks=cfg.loss_chunks, deterministic=True)
+
+
+step = jax.jit(jax.value_and_grad(loss_fn))
+out = step(params, ids, labels, mask)
+_sync(out)
+
+logdir = "/tmp/pfx_trace"
+with jax.profiler.trace(logdir):
+    for _ in range(3):
+        out = step(params, ids, labels, mask)
+    _sync(out)
+
+path = sorted(glob.glob(logdir + "/**/*.xplane.pb", recursive=True))[-1]
+pd = jax.profiler.ProfileData.from_file(path)
+events = collections.Counter()
+for plane in pd.planes:
+    if "TPU" not in plane.name and "tpu" not in plane.name.lower():
+        continue
+    for line in plane.lines:
+        for ev in line.events:
+            dur = ev.duration_ns
+            name = ev.name
+            events[name] += dur
+
+total = sum(events.values())
+print(f"plane total: {total/1e6:.2f} ms over 3 steps")
+for name, dur in events.most_common(40):
+    print(f"{dur/3/1e6:9.3f} ms  {100*dur/total:5.1f}%  {name[:110]}")

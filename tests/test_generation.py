@@ -159,6 +159,85 @@ def test_beam_config_validation():
                          num_return_sequences=3)
     with pytest.raises(ValueError):
         GenerationConfig(decode_strategy="nope")
+    with pytest.raises(ValueError):  # groups must divide beams
+        GenerationConfig(decode_strategy="beam_search", num_beams=4,
+                         num_beam_groups=3, diversity_rate=1.0)
+    with pytest.raises(ValueError):  # grouped search needs a penalty
+        GenerationConfig(decode_strategy="beam_search", num_beams=4,
+                         num_beam_groups=2, diversity_rate=0.0)
+
+
+def test_beam_search_repetition_penalty_k1_equals_greedy(
+        model_and_params):
+    """Beam scores accumulate PROCESSED log-probs (reference/HF
+    semantics): with repetition_penalty != 1.0 a width-1 beam must
+    still reproduce greedy decoding under the same penalty — both
+    argmax the same processed distribution each step."""
+    model, params = model_and_params
+    prompt = jnp.asarray(
+        np.random.default_rng(11).integers(0, 90, (2, 7)), jnp.int32)
+    kw = dict(max_dec_len=6, repetition_penalty=1.5,
+              eos_token_id=EOS, pad_token_id=PAD)
+    g = np.asarray(generate(
+        model, params, prompt, None, jax.random.key(0),
+        GenerationConfig(decode_strategy="greedy_search", **kw)))
+    bm = np.asarray(generate(
+        model, params, prompt, None, jax.random.key(0),
+        GenerationConfig(decode_strategy="beam_search", num_beams=1,
+                         **kw)))
+    np.testing.assert_array_equal(g, bm)
+
+
+def test_group_beam_search_diversifies_first_token(model_and_params):
+    """Diverse (group) beam search: with a strong Hamming penalty the
+    two groups must pick DIFFERENT first tokens, while vanilla beam
+    search's two best hypotheses share the greedy first token when its
+    continuation dominates; group 0 must be unaffected by grouping
+    (it pays no penalty) and equal the greedy sequence."""
+    model, params = model_and_params
+    prompt = jnp.asarray(
+        np.random.default_rng(12).integers(0, 90, (3, 6)), jnp.int32)
+    dec = 5
+    kw = dict(max_dec_len=dec, eos_token_id=EOS, pad_token_id=PAD)
+    greedy = np.asarray(generate(
+        model, params, prompt, None, jax.random.key(0),
+        GenerationConfig(decode_strategy="greedy_search", **kw)))
+    grouped = np.asarray(generate(
+        model, params, prompt, None, jax.random.key(0),
+        GenerationConfig(decode_strategy="beam_search", num_beams=2,
+                         num_beam_groups=2, diversity_rate=100.0,
+                         num_return_sequences=2, **kw)))
+    assert grouped.shape == (6, dec)
+    for p in range(3):
+        a, b = grouped[2 * p], grouped[2 * p + 1]
+        assert a[0] != b[0], (p, a, b)
+        # the unpenalized group's best hypothesis == greedy
+        assert (a == greedy[p]).all() or (b == greedy[p]).all(), \
+            (p, a, b, greedy[p])
+
+
+def test_group_beam_search_negligible_rate_groups_agree(
+        model_and_params):
+    """With kg=1 per group and a negligible diversity rate every group
+    runs an independent width-1 (greedy) search from the same prompt —
+    all returned rows must agree (and equal greedy). Pins that the
+    group plumbing itself doesn't perturb scores."""
+    model, params = model_and_params
+    prompt = jnp.asarray(
+        np.random.default_rng(13).integers(0, 90, (2, 7)), jnp.int32)
+    dec = 5
+    kw = dict(max_dec_len=dec, eos_token_id=EOS, pad_token_id=PAD)
+    greedy = np.asarray(generate(
+        model, params, prompt, None, jax.random.key(0),
+        GenerationConfig(decode_strategy="greedy_search", **kw)))
+    grouped = np.asarray(generate(
+        model, params, prompt, None, jax.random.key(0),
+        GenerationConfig(decode_strategy="beam_search", num_beams=2,
+                         num_beam_groups=2, diversity_rate=1e-9,
+                         num_return_sequences=2, **kw)))
+    for p in range(2):
+        np.testing.assert_array_equal(grouped[2 * p], greedy[p])
+        np.testing.assert_array_equal(grouped[2 * p + 1], greedy[p])
 
 
 def test_left_padded_prompt_matches_unpadded(model_and_params):
