@@ -87,6 +87,10 @@ class GenerationConfig:
                 raise ValueError(
                     "num_beam_groups > 1 requires diversity_rate > 0 "
                     "(otherwise the groups search identically)")
+            # YAML integers ("diversity_rate: 1") must not crash the
+            # processor's strict float check at trace time
+            object.__setattr__(self, "diversity_rate",
+                               float(self.diversity_rate))
 
     @classmethod
     def from_config(cls, section) -> "GenerationConfig":
