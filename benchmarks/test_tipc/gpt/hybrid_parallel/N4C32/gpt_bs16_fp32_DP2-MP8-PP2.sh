@@ -1,16 +1,16 @@
 #!/bin/bash
-# 32-device (4-node) hybrid topology DP2xMP8xPP2
-# (reference N4C32/gpt_bs16_fp16_DP2-MP8-PP2.sh). Without 32 real
-# chips, CPU_DEVICES=32 runs the same topology on the virtual CPU mesh
-# — the multi-node axes (dp over DCN, mp/pp over ICI) are exercised by
-# GSPMD identically.
+# 32-device (4-node) hybrid topology DP2xMP8xPP2, fp32
+# (reference N4C32/gpt_bs16_fp32_DP2-MP8-PP2.sh). Without
+# 32 real chips, CPU_DEVICES=32 runs the same topology on the virtual
+# CPU mesh — the multi-node axes (dp over DCN, mp/pp over ICI) are
+# exercised by GSPMD identically.
 cd "$(dirname "$0")/../../../../.."
 # NOTE: full-vocab steps are minutes-slow on a virtual CPU mesh — for a
 # fast correctness pass append vocab/width shrink overrides the way
 # tests/test_scale_proof.py does; this script's unshrunk form targets
 # real chips.
 python benchmarks/run_benchmark.py \
-  --model_item gpt_bs16_fp16_DP2-MP8-PP2 \
+  --model_item gpt_bs16_fp32_DP2-MP8-PP2 \
   --config configs/nlp/gpt/pretrain_gpt_345M_single_card.yaml \
   --max_steps "${MAX_STEPS:-100}" \
   ${CPU_DEVICES:+--cpu-devices "$CPU_DEVICES"} \
@@ -19,7 +19,6 @@ python benchmarks/run_benchmark.py \
     Model.num_layers=4 Model.hidden_size=1024 \
     Distributed.dp_degree=2 Distributed.mp_degree=8 \
     Distributed.pp_degree=2 \
-    Engine.mix_precision.use_pure_fp16=True \
     Engine.logging_freq=10 Engine.eval_freq=100000 \
     "Data.Train.dataset.input_dir=${DATA_DIR:?set DATA_DIR}" \
     "Data.Eval.dataset.input_dir=${DATA_DIR}" \

@@ -1,0 +1,73 @@
+"""Every TIPC topology script executes, tiny, on its virtual mesh.
+
+The reference's TIPC matrix (``benchmarks/test_tipc/gpt/
+hybrid_parallel/N*``) is its perf CI; these tests run the ACTUAL shell
+scripts — not reconstructions — with the model shrunk via appended
+overrides (the scripts forward ``"$@"`` to the driver precisely for
+this) and the device count from the script's N*C* directory on the
+virtual CPU mesh, asserting each topology reaches a finite loss.
+"""
+
+import glob
+import json
+import os
+import re
+import subprocess
+
+import numpy as np
+import pytest
+
+from test_data import make_corpus
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = sorted(glob.glob(os.path.join(
+    REPO, "benchmarks", "test_tipc", "gpt", "hybrid_parallel",
+    "N*", "*.sh")))
+
+assert len(SCRIPTS) >= 10, SCRIPTS  # 2 N1C1 + 2 N1C8 + 6 N4C32
+
+
+def _devices_of(script: str) -> int:
+    m = re.search(r"N(\d+)C(\d+)", os.path.dirname(script))
+    return int(m.group(2))
+
+
+@pytest.mark.parametrize(
+    "script", SCRIPTS, ids=[os.path.relpath(
+        s, os.path.join(REPO, "benchmarks", "test_tipc", "gpt",
+                        "hybrid_parallel")) for s in SCRIPTS])
+def test_tipc_script_topology_executes(script, tmp_path):
+    make_corpus(tmp_path, n_docs=40, doc_len_range=(20, 40), vocab=128,
+                eos=127)
+    shrink = [
+        "Model.vocab_size=128", "Model.max_position_embeddings=32",
+        "Model.hidden_size=64", "Model.num_attention_heads=8",
+        "Model.ffn_hidden_size=128", "Model.num_layers=4",
+        "Model.hidden_dropout_prob=0.0",
+        "Model.attention_probs_dropout_prob=0.0",
+        "Model.use_flash_attention=False",
+        "Global.local_batch_size=8", "Global.micro_batch_size=2",
+        "Engine.logging_freq=1",
+        f"Engine.save_load.output_dir={tmp_path / 'out'}",
+        "Engine.save_load.save_steps=100000",
+    ]
+    for mode, samples in (("Train", 32), ("Eval", 8)):
+        shrink += [
+            f"Data.{mode}.dataset.split=[3,1,0]",
+            f"Data.{mode}.dataset.num_samples={samples}",
+            f"Data.{mode}.dataset.mode={mode}",
+            f"Data.{mode}.dataset.eos_id=127",
+            "Data.%s.dataset.max_seq_len=32" % mode,
+            f"Data.{mode}.dataset.build_data_file=True",
+        ]
+    env = dict(os.environ)
+    env.update(CPU_DEVICES=str(_devices_of(script)), MAX_STEPS="2",
+               DATA_DIR=str(tmp_path))
+    proc = subprocess.run(
+        ["bash", script, "--skip_steps", "0", "--overrides", *shrink],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert proc.returncode == 0, \
+        proc.stdout[-2000:] + proc.stderr[-2000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["ok"], result
+    assert np.isfinite(result["last_loss"]), result
