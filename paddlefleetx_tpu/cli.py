@@ -42,10 +42,13 @@ def train_main(argv=None):
 
     data_world = process_data_loader_count(engine.mesh)
     rank = process_data_rank(engine.mesh)
+    seed = cfg.Global.get("seed")
     train_loader = build_dataloader(cfg.Data, "Train",
-                                    num_replicas=data_world, rank=rank)
+                                    num_replicas=data_world, rank=rank,
+                                    seed=seed)
     valid_loader = build_dataloader(cfg.Data, "Eval",
-                                    num_replicas=data_world, rank=rank)
+                                    num_replicas=data_world, rank=rank,
+                                    seed=seed)
     if train_loader is not None:
         # per-process slice of the global batch
         train_loader.batch_sampler.batch_size = \

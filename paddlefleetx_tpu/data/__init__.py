@@ -76,12 +76,14 @@ def build_dataset(config, mode: str):
 
 
 def build_dataloader(config, mode: str, num_replicas: int = 1,
-                     rank: int = 0):
+                     rank: int = 0, seed=None):
     """Build dataset + rank-sliced sampler + prefetching loader.
 
     ``num_replicas``/``rank`` are the dataflow (dp x sharding) world
     size and this process's dataflow rank (reference wires these from
     the HCG inside the sampler; here the engine passes them in).
+    ``seed`` (Global.seed) makes worker-process augmentation streams
+    reproducible; rank-offset so dp ranks augment differently.
     """
     dataset = build_dataset(config, mode)
     if dataset is None:
@@ -104,4 +106,6 @@ def build_dataloader(config, mode: str, num_replicas: int = 1,
         config[mode].get("collate_fn")
     # unnamed -> field-stacking default (vision configs name none)
     collate = COLLATE_FNS[collate_name or "default_collate_fn"]
+    if seed is not None:
+        loader_cfg.setdefault("seed", int(seed) + 1009 * rank)
     return DataLoader(dataset, sampler, collate, **loader_cfg)

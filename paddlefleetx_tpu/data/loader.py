@@ -1,27 +1,88 @@
-"""Minimal dataloader: sampler-driven batch fetch + thread prefetch.
+"""Minimal dataloader: sampler-driven batch fetch + prefetch.
 
 Replaces ``paddle.io.DataLoader`` (reference ``data/__init__.py:59-90``).
-TPU input pipelines are host-CPU-bound, so a background thread keeps a
-small queue of collated numpy batches ready while the device runs the
-previous step; the engine overlaps the host->HBM transfer with compute
-via ``jax.device_put`` on the next batch.
+TPU input pipelines are host-CPU-bound; two regimes:
+
+- ``num_workers <= 1``: one background THREAD keeps a small queue of
+  collated numpy batches ready while the device runs the previous step
+  (ample for mmap'd token datasets, whose "fetch" is a memcpy).
+- ``num_workers > 1``: a pool of WORKER PROCESSES decodes and collates
+  batches in parallel — the reference's subprocess-worker semantics,
+  needed where per-sample work is real CPU (ViT/Imagen image decode +
+  augmentation) that one GIL-bound thread cannot overlap. Batch ORDER
+  stays deterministic (results are yielded in sampler order regardless
+  of worker completion order), worker exceptions re-raise in the
+  consumer, and an early consumer break shuts the pool down without
+  hanging. Workers come from a ``forkserver`` context — plain fork
+  from a JAX-initialized (multithreaded) trainer risks forked-lock
+  deadlocks, while the forkserver's clean single-threaded server
+  process forks safely; the cost is that ``(dataset, collate_fn)``
+  must be picklable (true of the vision datasets this path exists
+  for — unpicklable ones fall back to the thread loader with a
+  warning; mmap'd token datasets should stay at ``num_workers <= 1``
+  anyway, where fetch is a memcpy).
+
+The engine overlaps the host->HBM transfer with compute via
+``jax.device_put`` on the next batch either way.
 """
 
 from __future__ import annotations
 
+import collections
+import multiprocessing
+import pickle
 import queue
 import threading
+from concurrent.futures import ProcessPoolExecutor
 from typing import Callable, Iterator, Optional
+
+from ..utils.log import logger
+
+
+def _identity_collate(batch):
+    # module-level (picklable): a lambda default would silently knock
+    # every explicit-collate-free loader off the process-pool path
+    return batch
+
+
+def _worker_init(state_blob):
+    # per-pool state travels through the initializer, so concurrent
+    # loaders (train + mid-epoch eval) cannot cross-feed each other
+    global _INHERITED
+    _INHERITED = pickle.loads(state_blob)
+
+
+def _worker_fetch(seed, indices):
+    """Fetch one batch in a worker, seeding the host RNGs the sample
+    transforms draw from (``random`` / ``np.random``, see
+    ``transforms/preprocess.py``) per TASK — deterministic whichever
+    worker runs it, so a seeded run reproduces its augmentation
+    stream just like the threaded path (which inherits the trainer's
+    ``env.set_seed`` state, a different but equally fixed stream)."""
+    import random
+
+    import numpy as np
+
+    dataset, collate_fn = _INHERITED
+    random.seed(seed)
+    np.random.seed(seed % (2 ** 32))
+    return collate_fn([dataset[i] for i in indices])
 
 
 class DataLoader:
     def __init__(self, dataset, batch_sampler,
                  collate_fn: Optional[Callable] = None,
-                 num_workers: int = 1, prefetch_depth: int = 2, **_):
+                 num_workers: int = 1, prefetch_depth: int = 2,
+                 seed: Optional[int] = None, **_):
         self.dataset = dataset
         self.batch_sampler = batch_sampler
-        self.collate_fn = collate_fn or (lambda b: b)
+        self.collate_fn = collate_fn or _identity_collate
+        self.num_workers = max(0, int(num_workers))
         self.prefetch_depth = max(1, prefetch_depth if num_workers else 1)
+        self.seed = seed
+        self._epoch = 0
+
+    # -- single-producer thread path (num_workers <= 1) ----------------
 
     def _put(self, q: "queue.Queue", stop: threading.Event, item) -> bool:
         """Put with stop-polling so an abandoned consumer (early break
@@ -48,7 +109,7 @@ class DataLoader:
         finally:
             self._put(q, stop, ("done", None))
 
-    def __iter__(self) -> Iterator:
+    def _iter_threaded(self) -> Iterator:
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_depth)
         stop = threading.Event()
         worker = threading.Thread(target=self._produce, args=(q, stop),
@@ -65,6 +126,71 @@ class DataLoader:
                     break
         finally:
             stop.set()
+
+    # -- process-pool path (num_workers > 1) ---------------------------
+
+    def _iter_processes(self) -> Iterator:
+        try:
+            ctx = multiprocessing.get_context("forkserver")
+        except ValueError as e:  # platform without forkserver
+            logger.warning("num_workers=%d needs a forkserver context; "
+                           "falling back to the threaded loader (%s)",
+                           self.num_workers, e)
+            yield from self._iter_threaded()
+            return
+        try:
+            blob = pickle.dumps((self.dataset, self.collate_fn))
+        except (pickle.PicklingError, TypeError, AttributeError) as e:
+            logger.warning(
+                "num_workers=%d needs a picklable (dataset, "
+                "collate_fn); falling back to the threaded loader "
+                "(%s)", self.num_workers, e)
+            yield from self._iter_threaded()
+            return
+
+        pool = ProcessPoolExecutor(max_workers=self.num_workers,
+                                   mp_context=ctx,
+                                   initializer=_worker_init,
+                                   initargs=(blob,))
+        # per-task seeds: derived from the configured seed (else the
+        # trainer's seeded np.random stream) and the batch ordinal, so
+        # seeded runs reproduce augmentations; epoch-offset so epochs
+        # differ
+        import numpy as np
+        base = self.seed if self.seed is not None else \
+            int(np.random.randint(0, 2 ** 31))
+        base = base + 100003 * self._epoch
+        self._epoch += 1
+        window = self.prefetch_depth * self.num_workers
+        pending: "collections.deque" = collections.deque()
+        sampler_iter = iter(self.batch_sampler)
+        try:
+            exhausted = False
+            ordinal = 0
+            while True:
+                while not exhausted and len(pending) < window:
+                    try:
+                        indices = next(sampler_iter)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    pending.append(
+                        pool.submit(_worker_fetch, base + ordinal,
+                                    list(indices)))
+                    ordinal += 1
+                if not pending:
+                    break
+                # strict sampler order: the OLDEST future is the next
+                # batch, whatever finished first; .result() re-raises
+                # worker exceptions in the consumer
+                yield pending.popleft().result()
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    def __iter__(self) -> Iterator:
+        if self.num_workers > 1:
+            return self._iter_processes()
+        return self._iter_threaded()
 
     def __len__(self) -> int:
         return len(self.batch_sampler)

@@ -1,0 +1,78 @@
+"""ViT input-pipeline microbench: images/sec through the full
+train transform chain (decode -> random crop -> flip -> normalize ->
+CHW) at num_workers in {1, 4, 8}.
+
+Ad hoc: python scripts/bench_loader.py. Results recorded in
+projects/vit/README.md.
+"""
+
+import io
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+from paddlefleetx_tpu.data.loader import DataLoader
+from paddlefleetx_tpu.data.transforms.preprocess import build_transforms
+
+N_IMAGES = 512
+BATCH = 32
+
+
+class JpegDataset:
+    """In-memory JPEG blobs -> full ViT train transform per sample."""
+
+    def __init__(self):
+        from PIL import Image
+        rng = np.random.default_rng(0)
+        img = Image.fromarray(
+            rng.integers(0, 255, (512, 384, 3), np.uint8).astype(np.uint8))
+        buf = io.BytesIO()
+        img.save(buf, format="JPEG", quality=90)
+        self.blob = buf.getvalue()
+        self.transform = build_transforms([
+            {"DecodeImage": {"to_rgb": True, "channel_first": False}},
+            {"RandCropImage": {"size": 224, "interpolation": "bilinear"}},
+            {"RandFlipImage": {"flip_code": 1}},
+            {"NormalizeImage": {
+                "scale": 1.0 / 255.0,
+                "mean": [0.485, 0.456, 0.406],
+                "std": [0.229, 0.224, 0.225], "order": ""}},
+            {"ToCHWImage": {}},
+        ])
+
+    def __len__(self):
+        return N_IMAGES
+
+    def __getitem__(self, i):
+        return self.transform(self.blob), i % 1000
+
+
+def collate(batch):
+    xs, ys = zip(*batch)
+    return np.stack(xs), np.asarray(ys)
+
+
+def main():
+    ds = JpegDataset()
+    batches = [list(range(i, i + BATCH))
+               for i in range(0, N_IMAGES, BATCH)]
+    print(f"{N_IMAGES} images, batch {BATCH}, 512x384 JPEG -> 224x224")
+    base = None
+    for workers in (1, 4, 8):
+        loader = DataLoader(ds, batches, collate_fn=collate,
+                            num_workers=workers)
+        n = sum(b[0].shape[0] for b in loader)  # warm pool/page cache
+        t0 = time.perf_counter()
+        n = sum(b[0].shape[0] for b in loader)
+        dt = time.perf_counter() - t0
+        ips = n / dt
+        base = base or ips
+        print(f"num_workers={workers}: {ips:7.1f} images/s "
+              f"({ips / base:.2f}x)")
+
+
+if __name__ == "__main__":
+    main()
