@@ -662,7 +662,47 @@ class Engine(BasicEngine):
             spec = self.module.input_spec()[:1]
             metadata = {}
         out_dir = os.path.join(self.output_dir, "export")
-        with self.mesh, nn.logical_axis_rules(self.rules):
+        param_shardings = self.state_shardings["params"]
+
+        def _really_split(entry):
+            # a spec entry only partitions if its mesh axis size > 1
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            return any(a is not None and self.mesh.shape[a] > 1
+                       for a in axes)
+
+        partitioned = any(
+            any(_really_split(e) for e in s.spec)
+            for s in jax.tree.leaves(param_shardings))
+        export_mesh = self.mesh
+        if self.mesh.devices.size > 1 and not partitioned:
+            # dp/replicated-only training (mp=pp=fsdp=1): every rank
+            # holds the full model, so export a SINGLE-device artifact
+            # — exporting under the dp mesh would bake its device
+            # count into the StableHLO and a 1-chip serving box could
+            # never load it (the dp inference mode is one such
+            # artifact per rank). Same axis names, all sizes 1, so the
+            # model's logical constraints still resolve.
+            export_mesh = jax.sharding.Mesh(
+                np.asarray([self.mesh.devices.flat[0]]).reshape(
+                    (1,) * len(self.mesh.axis_names)),
+                self.mesh.axis_names)
+        elif partitioned:
+            # record how to re-partition the artifact: the exported
+            # StableHLO bakes the mesh SIZE (jax.export nr_devices) but
+            # not parameter placement — the loader rebuilds
+            # NamedShardings from these specs on ITS mesh, which must
+            # have the same axis names/sizes (the TPU-native analogue
+            # of the reference's per-rank model dirs,
+            # ``core/engine/inference_engine.py:60-131``)
+            from ..utils.export import serialize_param_specs
+            metadata = dict(metadata or {})
+            metadata["num_export_devices"] = int(self.mesh.devices.size)
+            metadata["mesh_axes"] = {
+                name: int(size) for name, size in
+                zip(self.mesh.axis_names, self.mesh.devices.shape)}
+            metadata["param_specs"] = serialize_param_specs(
+                param_shardings)
+        with export_mesh, nn.logical_axis_rules(self.rules):
             return export_inference_model(
                 fn, self.state["params"], spec, out_dir,
                 metadata=metadata)

@@ -72,16 +72,73 @@ def export_inference_model(fn: Callable, params,
     return output_dir
 
 
-def load_inference_model(model_dir: str):
+def serialize_param_specs(shardings) -> Dict[str, list]:
+    """Flatten a params-tree of ``NamedSharding``s (or
+    ``PartitionSpec``s) to ``{"a/b/c": [None, "mp", ["dp", "fsdp"]]}``
+    — JSON-able, mesh-free; :func:`deserialize_param_specs` rebuilds
+    ``NamedSharding``s against the *loader's* mesh."""
+    import jax.sharding as js
+
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(shardings)[0]:
+        key = "/".join(str(getattr(p, "key", p)) for p in path)
+        spec = leaf.spec if isinstance(leaf, js.NamedSharding) else leaf
+        flat[key] = [list(e) if isinstance(e, tuple) else e
+                     for e in tuple(spec)]
+    return flat
+
+
+def deserialize_param_specs(flat: Dict[str, list], params, mesh):
+    """``{"a/b/c": serialized spec}`` -> params-shaped tree of
+    ``NamedSharding`` on ``mesh`` (replicated for paths the artifact
+    does not list)."""
+    import jax.sharding as js
+    P = js.PartitionSpec
+
+    def build(path, _leaf):
+        key = "/".join(str(getattr(p, "key", p)) for p in path)
+        entries = flat.get(key)
+        if entries is None:
+            return js.NamedSharding(mesh, P())
+        return js.NamedSharding(mesh, P(*[
+            tuple(e) if isinstance(e, list) else e for e in entries]))
+
+    return jax.tree_util.tree_map_with_path(build, params)
+
+
+def load_spec(model_dir: str) -> Dict[str, Any]:
+    """The artifact's ``spec.json`` (input shapes + metadata) alone —
+    cheap; callers use it to resolve a mesh BEFORE loading weights."""
+    with open(os.path.join(model_dir, _SPEC_FILE)) as f:
+        return json.load(f)
+
+
+def load_inference_model(model_dir: str, mesh=None):
     """Returns ``(call, params, spec)``; ``call(params, *inputs)``
-    executes the deserialized computation on the current backend."""
+    executes the deserialized computation on the current backend.
+
+    With ``mesh`` and a spec that records ``param_specs``, each
+    parameter is restored DIRECTLY into its ``NamedSharding`` (Orbax
+    sharded read) — a model that only fits partitioned must never
+    materialize whole in host RAM just to be re-sharded."""
     with open(os.path.join(model_dir, _MODEL_FILE), "rb") as f:
         exported = jax.export.deserialize(f.read())
+    spec = load_spec(model_dir)
     params_path = os.path.abspath(os.path.join(model_dir, _PARAMS_DIR))
+    flat_specs = spec["metadata"].get("param_specs")
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
-        params = ckptr.restore(params_path)
-    with open(os.path.join(model_dir, _SPEC_FILE)) as f:
-        spec = json.load(f)
+        if mesh is not None and flat_specs:
+            meta_tree = ckptr.metadata(params_path).item_metadata.tree
+            shardings = deserialize_param_specs(flat_specs, meta_tree,
+                                                mesh)
+            abstract = jax.tree.map(
+                lambda m, s: jax.ShapeDtypeStruct(m.shape, m.dtype,
+                                                  sharding=s),
+                meta_tree, shardings)
+            params = ckptr.restore(
+                params_path, args=ocp.args.StandardRestore(abstract))
+        else:
+            params = ckptr.restore(params_path)
 
     def call(p, *inputs):
         return exported.call(p, *inputs)

@@ -54,11 +54,8 @@ def test_pad_to_spec():
                     [0])
 
 
-def test_engine_export_and_inference(tmp_path):
-    """Engine.export -> Engine.inference round trip on the generation
-    module: the exported artifact reproduces module.generate greedily."""
-    from paddlefleetx_tpu.core import Engine
-    from paddlefleetx_tpu.models import build_module
+def _generation_cfg(tmp_path, mp_degree=1, nranks=1, max_pos=32):
+    """Tiny GPTGenerationModule engine config for export tests."""
     from paddlefleetx_tpu.utils.config import AttrDict, process_configs
 
     cfg = AttrDict({
@@ -74,7 +71,8 @@ def test_engine_export_and_inference(tmp_path):
         "Model": AttrDict({
             "module": "GPTGenerationModule", "name": "GPT",
             "vocab_size": 64, "hidden_size": 32, "num_layers": 2,
-            "num_attention_heads": 4, "max_position_embeddings": 32,
+            "num_attention_heads": 4,
+            "max_position_embeddings": max_pos,
             "ffn_hidden_size": 64,
             "hidden_dropout_prob": 0.0,
             "attention_probs_dropout_prob": 0.0,
@@ -84,7 +82,8 @@ def test_engine_export_and_inference(tmp_path):
             "eos_token_id": 63, "pad_token_id": 0, "top_k": 1,
             "vocab_dir": "test-local",
         }),
-        "Distributed": AttrDict({"dp_degree": 1, "mp_degree": 1,
+        "Distributed": AttrDict({"dp_degree": 1,
+                                 "mp_degree": mp_degree,
                                  "pp_degree": 1,
                                  "sharding": AttrDict({})}),
         "Optimizer": AttrDict({"name": "FusedAdamW",
@@ -98,7 +97,17 @@ def test_engine_export_and_inference(tmp_path):
         "Inference": AttrDict({
             "model_dir": str(tmp_path / "out")}),
     })
-    process_configs(cfg, nranks=1)
+    process_configs(cfg, nranks=nranks)
+    return cfg
+
+
+def test_engine_export_and_inference(tmp_path):
+    """Engine.export -> Engine.inference round trip on the generation
+    module: the exported artifact reproduces module.generate greedily."""
+    from paddlefleetx_tpu.core import Engine
+    from paddlefleetx_tpu.models import build_module
+
+    cfg = _generation_cfg(tmp_path)
     module = build_module(cfg)
     engine = Engine(cfg, module, mode="export",
                     devices=jax.devices()[:1])
@@ -133,3 +142,149 @@ def test_engine_export_and_inference(tmp_path):
                              jax.random.key(0), module.generation_cfg)
     np.testing.assert_array_equal(np.asarray(exported_ids),
                                   np.asarray(want_unpadded))
+
+
+def test_export_tp4_reload_matches_single_device(tmp_path):
+    """Distributed inference, model-parallel: export under an mp=4
+    mesh, reload the ONE artifact under a DIFFERENT 4-device mesh
+    (reference ships per-rank model dirs instead,
+    ``core/engine/inference_engine.py:60-131``), and the re-partitioned
+    computation must reproduce single-device generation token-exact."""
+    from paddlefleetx_tpu.core import Engine
+    from paddlefleetx_tpu.core.inference_engine import InferenceEngine
+    from paddlefleetx_tpu.models import build_module
+    from paddlefleetx_tpu.models.gpt.generation import generate
+    from paddlefleetx_tpu.parallel.mesh import (
+        build_mesh, get_mesh, set_mesh,
+    )
+
+    cfg = _generation_cfg(tmp_path, mp_degree=4, nranks=4)
+    module = build_module(cfg)
+    engine = Engine(cfg, module, mode="export",
+                    devices=jax.devices()[:4])
+    out_dir = engine.export()
+    spec = __import__("json").load(
+        open(str(tmp_path / "out" / "export" / "spec.json")))
+    assert spec["metadata"]["num_export_devices"] == 4
+    assert spec["metadata"]["mesh_axes"]["mp"] == 4
+
+    prev_mesh = get_mesh()
+    try:
+        # the loader's mesh: same axis names/sizes, the OTHER devices
+        set_mesh(build_mesh(engine.topo, devices=jax.devices()[4:8]))
+        infer = InferenceEngine(out_dir)
+        prompt = np.asarray([[5, 9, 2, 11]], np.int32)
+        mask = np.ones_like(prompt)
+        got = list(infer.predict([prompt, mask]).values())[0]
+    finally:
+        set_mesh(prev_mesh)
+
+    want = generate(module.model, jax.device_get(engine.state["params"]),
+                    jnp.asarray(prompt), jnp.asarray(mask),
+                    jax.random.key(0), module.generation_cfg)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_export_multi_device_mesh_validation_and_autobuild(tmp_path):
+    """A partitioned artifact refuses a mesh with the wrong axis
+    SIZES (a dp4 mesh also has 4 devices — loading an mp4 artifact on
+    it would silently replicate what the export partitioned), and with
+    NO active mesh it rebuilds one from its own metadata so plain
+    serving entry points need no topology plumbing."""
+    from paddlefleetx_tpu.core import Engine
+    from paddlefleetx_tpu.core.inference_engine import InferenceEngine
+    from paddlefleetx_tpu.models import build_module
+    from paddlefleetx_tpu.models.gpt.generation import generate
+    from paddlefleetx_tpu.parallel.mesh import get_mesh, set_mesh
+    from jax.sharding import Mesh
+
+    cfg = _generation_cfg(tmp_path, mp_degree=4, nranks=4)
+    module = build_module(cfg)
+    engine = Engine(cfg, module, mode="export",
+                    devices=jax.devices()[:4])
+    out_dir = engine.export()
+    prev_mesh = get_mesh()
+    prompt = np.asarray([[5, 9, 2, 11]], np.int32)
+    mask = np.ones_like(prompt)
+    try:
+        # wrong-shaped mesh: 4 devices but dp-shaped, mp stays 1
+        set_mesh(Mesh(
+            np.asarray(jax.devices()[:4]).reshape(1, 4, 1, 1, 1),
+            ("pp", "dp", "cp", "fsdp", "mp")))
+        with pytest.raises(ValueError, match="differs on"):
+            InferenceEngine(out_dir)
+
+        # no mesh at all: rebuilt from artifact metadata
+        set_mesh(None)
+        infer = InferenceEngine(out_dir)
+        got = list(infer.predict([prompt, mask]).values())[0]
+    finally:
+        set_mesh(prev_mesh)
+    want = generate(module.model, jax.device_get(engine.state["params"]),
+                    jnp.asarray(prompt), jnp.asarray(mask),
+                    jax.random.key(0), module.generation_cfg)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_export_dp_only_training_yields_single_device_artifact(
+        tmp_path):
+    """dp-only (replicated-parameter) training must export a
+    SINGLE-device artifact — every rank holds the whole model, and a
+    1-chip serving box (the dp inference mode) must be able to load
+    it — rather than baking the training mesh's device count in."""
+    import json
+    from paddlefleetx_tpu.core import Engine
+    from paddlefleetx_tpu.core.inference_engine import InferenceEngine
+    from paddlefleetx_tpu.models import build_module
+    from paddlefleetx_tpu.utils.config import AttrDict
+
+    cfg = _generation_cfg(tmp_path, nranks=8)
+    cfg.Distributed = AttrDict({
+        "dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+        "sharding": AttrDict({})})
+    engine = Engine(cfg, build_module(cfg), mode="export",
+                    devices=jax.devices()[:8])
+    out_dir = engine.export()
+    spec = json.load(open(str(tmp_path / "out" / "export" /
+                              "spec.json")))
+    assert "num_export_devices" not in spec["metadata"]
+    infer = InferenceEngine(out_dir)   # no mesh needed
+    prompt = np.asarray([[5, 9, 2, 11]], np.int32)
+    out = list(infer.predict([prompt,
+                              np.ones_like(prompt)]).values())[0]
+    assert out.shape == (1, 8)
+
+
+def test_export_dp8_rank_serving_matches_single_device(tmp_path):
+    """Distributed inference, data-parallel (the
+    ``inference_gpt_345M_dp8.yaml`` mode): every rank serves the SAME
+    single-device artifact on its shard of the prompts — 8 simulated
+    ranks' outputs must equal one full-batch single-device generation
+    row for row."""
+    from paddlefleetx_tpu.core import Engine
+    from paddlefleetx_tpu.core.inference_engine import InferenceEngine
+    from paddlefleetx_tpu.models import build_module
+    from paddlefleetx_tpu.models.gpt.generation import generate
+
+    cfg = _generation_cfg(tmp_path)
+    module = build_module(cfg)
+    engine = Engine(cfg, module, mode="export",
+                    devices=jax.devices()[:1])
+    out_dir = engine.export()
+
+    rng = np.random.default_rng(3)
+    prompts = rng.integers(1, 60, (8, 4)).astype(np.int32)
+    mask = np.ones((8, 4), np.int32)
+
+    per_rank = []
+    for rank in range(8):
+        infer = InferenceEngine(out_dir)   # each rank loads its own
+        outs = infer.predict([prompts[rank:rank + 1],
+                              mask[rank:rank + 1]])
+        per_rank.append(list(outs.values())[0])
+    got = np.concatenate(per_rank, axis=0)
+
+    want = generate(module.model, engine.state["params"],
+                    jnp.asarray(prompts), jnp.asarray(mask),
+                    jax.random.key(0), module.generation_cfg)
+    np.testing.assert_array_equal(got, np.asarray(want))

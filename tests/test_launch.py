@@ -65,6 +65,56 @@ def test_failing_child_propagates_and_terminates_peers(tmp_path):
     assert rc == 3
 
 
+CHILD_DP_INFERENCE = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, os.environ["PFX_TEST_REPO"])
+    from paddlefleetx_tpu.parallel.mesh import cpu_mesh_env
+    cpu_mesh_env(1)
+    sys.argv = [
+        "inference.py", "-c",
+        os.path.join(os.environ["PFX_TEST_REPO"],
+                     "configs/nlp/gpt/inference_gpt_345M_dp8.yaml"),
+        "-o", "Inference.model_dir=" + os.environ["PFX_INF_MODEL_DIR"],
+        "-o", "Generation.vocab_dir=test-local",
+    ]
+    import runpy
+    runpy.run_path(os.path.join(os.environ["PFX_TEST_REPO"], "tasks",
+                                "gpt", "inference.py"),
+                   run_name="__main__")
+""")
+
+
+def test_dp_inference_config_under_launch(tmp_path):
+    """The dp multi-rank inference recipe end to end: export a tiny
+    generation artifact, then pfx-launch TWO processes each running
+    ``tasks/gpt/inference.py`` with ``inference_gpt_345M_dp8.yaml`` —
+    every dp rank serves the shared artifact (the reference's
+    ``InferenceEngine`` runs one predictor per rank the same way)."""
+    import jax
+    from test_export import _generation_cfg
+    from paddlefleetx_tpu.core import Engine
+    from paddlefleetx_tpu.models import build_module
+
+    # prompt capacity must hold the task's built-in prompt (33 bytes
+    # through the byte-fallback tokenizer)
+    cfg = _generation_cfg(tmp_path, max_pos=64)
+    engine = Engine(cfg, build_module(cfg), mode="export",
+                    devices=jax.devices()[:1])
+    engine.export()
+
+    script = tmp_path / "child.py"
+    script.write_text(CHILD_DP_INFERENCE)
+    os.environ["PFX_TEST_REPO"] = REPO
+    os.environ["PFX_INF_MODEL_DIR"] = str(tmp_path / "out")
+    try:
+        rc = launch([sys.executable, str(script)], nprocs=2,
+                    cpu_devices_per_proc=1)
+    finally:
+        os.environ.pop("PFX_TEST_REPO", None)
+        os.environ.pop("PFX_INF_MODEL_DIR", None)
+    assert rc == 0
+
+
 def test_cli_requires_command():
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "launch.py")],
