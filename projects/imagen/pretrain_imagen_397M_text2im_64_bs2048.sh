@@ -1,0 +1,13 @@
+#!/bin/bash
+# pretrain_imagen_397M_text2im_64, multi-card dp, global batch 2048
+# (reference projects/imagen/run_text2im_397M_64x64_bs2048.sh: the
+# same base yaml under an 8-way data-parallel launch with 8 loader
+# workers and 68 epochs). 2048 = dp8 x local 256; parallel JPEG decode
+# (num_workers, see projects/vit/README.md) keeps the base U-Net fed.
+python ./tools/train.py -c ./configs/mm/imagen/imagen_397M_text2im_64.yaml \
+  -o Distributed.dp_degree=8 \
+  -o Global.local_batch_size=256 \
+  -o Global.micro_batch_size=32 \
+  -o Data.Train.loader.num_workers=8 \
+  -o Engine.num_train_epochs=68 \
+  "$@"

@@ -1,0 +1,111 @@
+"""Real-chip step-time smoke for the ViT and Imagen families.
+
+Ad hoc: python scripts/smoke_family_tpu.py [vit|imagen] — measures a
+bf16 train step (fwd+bwd+adamw) at a production-shaped operating point
+on the attached chip. Numbers are recorded in projects/{vit,imagen}/
+README.md.
+"""
+
+import functools
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def _sync(x):
+    float(jnp.ravel(jax.tree.leaves(x)[0])[0].astype(jnp.float32))
+
+
+def _step_time(step, state, *batch, n=10):
+    state = step(state, *batch)
+    _sync(state)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state = step(state, *batch)
+    _sync(state)
+    return (time.perf_counter() - t0) / n
+
+
+def smoke_vit(batch=128):
+    from paddlefleetx_tpu.models.vit.vit import VISION_MODELS
+    from paddlefleetx_tpu.models.vit.loss import ViTCELoss
+
+    model = VISION_MODELS["ViT_base_patch16_224"](dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.uniform(-1, 1, (batch, 224, 224, 3)),
+                         jnp.bfloat16)
+    labels = jnp.asarray(rng.integers(0, 1000, (batch,)), jnp.int32)
+    params = jax.jit(model.init)(jax.random.key(0), images[:1])["params"]
+    tx = optax.adamw(1e-3, weight_decay=0.05, mu_dtype=jnp.bfloat16)
+    opt = tx.init(params)
+    criterion = ViTCELoss(epsilon=0.1)
+
+    def loss_fn(p, x, y):
+        return criterion(model.apply({"params": p}, x,
+                                     deterministic=True), y)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state, x, y):
+        p, o = state
+        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+        up, o = tx.update(g, o, p)
+        return optax.apply_updates(p, up), o
+
+    dt = _step_time(step, (params, opt), images, labels)
+    print(f"ViT-base/16 224 bf16 train step, bs={batch}: "
+          f"{dt * 1e3:.1f} ms = {batch / dt:.0f} images/s")
+
+
+def smoke_imagen(batch=16):
+    from paddlefleetx_tpu.models.imagen.modeling import (
+        build_imagen_model, imagen_criterion,
+    )
+
+    model = build_imagen_model("imagen_397M_text2im_64",
+                               dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.uniform(0, 1, (batch, 3, 64, 64)),
+                         jnp.float32)
+    emb = jnp.asarray(rng.normal(size=(batch, 77, model.config.text_embed_dim)),
+                      jnp.bfloat16)
+    mask = jnp.ones((batch, 77), jnp.int32)
+    variables = jax.jit(functools.partial(
+        model.init))({"params": jax.random.key(0),
+                      "diffusion": jax.random.key(1)},
+                     images[:1], emb[:1], mask[:1])
+    params = variables["params"]
+    tx = optax.adamw(1e-4, mu_dtype=jnp.bfloat16)
+    opt = tx.init(params)
+
+    def loss_fn(p, x, e, m, key):
+        pred, target, log_snr, gamma = model.apply(
+            {"params": p}, x, e, m, rngs={"diffusion": key})
+        return imagen_criterion(pred, target, log_snr, gamma)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state, x, e, m):
+        p, o, key = state
+        key, sub = jax.random.split(key)
+        loss, g = jax.value_and_grad(loss_fn)(p, x, e, m, sub)
+        up, o = tx.update(g, o, p)
+        return optax.apply_updates(p, up), o, key
+
+    dt = _step_time(step, (params, opt, jax.random.key(2)),
+                    images, emb, mask)
+    print(f"Imagen base U-Net 397M text2im 64x64 bf16 train step, "
+          f"bs={batch}: {dt * 1e3:.1f} ms = {batch / dt:.0f} images/s")
+
+
+if __name__ == "__main__":
+    which = sys.argv[1:] or ["vit", "imagen"]
+    print("device:", jax.devices()[0].device_kind)
+    if "vit" in which:
+        smoke_vit()
+    if "imagen" in which:
+        smoke_imagen()
