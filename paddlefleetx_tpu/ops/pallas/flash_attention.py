@@ -201,6 +201,28 @@ def _flash_forward(q, k, v, sm_scale, causal, query_offset, block_q,
 # -- backward ----------------------------------------------------------
 
 
+def _bwd_block_math(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    masked, qi, ki, sm_scale, block_q, block_kv,
+                    query_offset):
+    """Score-block recomputation shared by all backward kernels:
+    ``(q_s, p, ds)`` with q pre-scaled (so dk = ds^T @ q_s absorbs one
+    sm_scale factor and the OTHER stays pending on dq — the caller
+    applies it once on [bq, d]). Single definition so the backward
+    kernels cannot diverge (same contract as ``_masked_dispatch``)."""
+    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    lse, delta = lse_ref[0], delta_ref[0]               # [bq, 1]
+    q_s = (q.astype(jnp.float32) * sm_scale).astype(q.dtype)
+    s = _dot(q_s, k, trans_b=True)                      # [bq, bkv]
+    if masked:
+        s = jnp.where(
+            _causal_mask(qi, ki, block_q, block_kv, query_offset),
+            s, NEG_INF)
+    p = jnp.exp(s - lse)                                # [bq, bkv]
+    dp = _dot(do, v, trans_b=True)                      # [bq, bkv]
+    ds = p * (dp - delta)
+    return q_s, p, ds
+
+
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
                     block_q, block_kv, num_q, query_offset):
@@ -212,22 +234,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     def _block(masked: bool):
-        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        lse = lse_ref[0]                                # [bq, 1]
-        delta = delta_ref[0]                            # [bq, 1]
-        # s from pre-scaled q; dk = ds_true^T @ (sm_scale*q) absorbs
-        # the other sm_scale factor, so ds needs none
-        q_s = (q.astype(jnp.float32) * sm_scale).astype(q.dtype)
-        s = _dot(q_s, k, trans_b=True)                  # [bq, bkv]
-        if masked:
-            s = jnp.where(
-                _causal_mask(qi, ki, block_q, block_kv, query_offset),
-                s, NEG_INF)
-        p = jnp.exp(s - lse)                            # [bq, bkv]
-        dv_scr[:] += _dot(p.astype(do.dtype), do, trans_a=True)
-        dp = _dot(do, v, trans_b=True)                  # [bq, bkv]
-        ds = p * (dp - delta)
-        dk_scr[:] += _dot(ds.astype(q.dtype), q_s, trans_a=True)
+        q_s, p, ds = _bwd_block_math(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, masked,
+            qi, ki, sm_scale, block_q, block_kv, query_offset)
+        dv_scr[:] += _dot(p.astype(do_ref.dtype), do_ref[0],
+                          trans_a=True)
+        dk_scr[:] += _dot(ds.astype(q_s.dtype), q_s, trans_a=True)
 
     _masked_dispatch(_block, qi, ki, block_q, block_kv, causal,
                      query_offset)
@@ -248,24 +260,60 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     def _block(masked: bool):
-        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        lse, delta = lse_ref[0], delta_ref[0]
-        q_s = (q.astype(jnp.float32) * sm_scale).astype(q.dtype)
-        s = _dot(q_s, k, trans_b=True)
-        if masked:
-            s = jnp.where(
-                _causal_mask(qi, ki, block_q, block_kv, query_offset),
-                s, NEG_INF)
-        p = jnp.exp(s - lse)
-        dp = _dot(do, v, trans_b=True)
-        # accumulate ds_true @ k; the pending sm_scale factor
-        # (ds = sm_scale * ds_true wrt the scaled score) is applied
-        # once at _finish on [bq, d] instead of per block on [bq, bkv]
-        ds = p * (dp - delta)
-        dq_scr[:] += _dot(ds.astype(k.dtype), k)
+        _, _, ds = _bwd_block_math(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, masked,
+            qi, ki, sm_scale, block_q, block_kv, query_offset)
+        dq_scr[:] += _dot(ds.astype(k_ref.dtype), k_ref[0])
 
     _masked_dispatch(_block, qi, ki, block_q, block_kv, causal,
                      query_offset)
+
+    @pl.when(ki == num_kv - 1)
+    def _finish():
+        dq_ref[0] = (dq_scr[:] * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_combined_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         delta_ref, dq_ref, dk_ref, dv_ref, dq_scr, *,
+                         sm_scale, causal, block_q, block_kv, num_kv,
+                         query_offset):
+    """Combined backward for the ``num_q == 1`` regime (the training
+    hot path: s <= block_q, and every ring-attention shard): ONE pass
+    over the ki blocks produces dq, dk, AND dv — the split kernel
+    pair recomputes each score block and its exp twice (the pair
+    measured 33.7 ms of the 345M microbatch backward; combined 24).
+    With a single q block, dq accumulates in VMEM scratch exactly
+    like the split dq kernel, while each ki's dk/dv block is visited
+    once and written directly."""
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _block(masked: bool):
+        q_s, p, ds = _bwd_block_math(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, masked,
+            0, ki, sm_scale, block_q, block_kv, query_offset)
+        dv_ref[0] = _dot(p.astype(do_ref.dtype), do_ref[0],
+                         trans_a=True).astype(dv_ref.dtype)
+        dk_ref[0] = _dot(ds.astype(q_s.dtype), q_s,
+                         trans_a=True).astype(dk_ref.dtype)
+        dq_scr[:] += _dot(ds.astype(k_ref.dtype), k_ref[0])
+
+    _masked_dispatch(_block, 0, ki, block_q, block_kv, causal,
+                     query_offset)
+
+    # a dead kv block (possible only with query_offset < block math
+    # bounds; defensive — with sq == skv and one q block every kv
+    # block is live) must still define its dk/dv output
+    live, _ = _live_interior(0, ki, block_q, block_kv, causal,
+                             query_offset)
+
+    @pl.when(jnp.logical_not(live))
+    def _dead():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
 
     @pl.when(ki == num_kv - 1)
     def _finish():
@@ -285,6 +333,31 @@ def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
         # so it folds into the kernels' existing ds = p * (dp - delta)
         # as delta' = delta - g_lse — no kernel change needed
         delta = delta - g_lse.astype(jnp.float32)
+
+    if num_q == 1:
+        q_spec = pl.BlockSpec((1, block_q, d), lambda b, i: (b, 0, 0))
+        r_spec = pl.BlockSpec((1, block_q, 1), lambda b, i: (b, 0, 0))
+        kv_spec = pl.BlockSpec((1, block_kv, d),
+                               lambda b, i: (b, i, 0))
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(
+                _bwd_combined_kernel, sm_scale=sm_scale, causal=causal,
+                block_q=block_q, block_kv=block_kv, num_kv=num_kv,
+                query_offset=query_offset),
+            grid=(bh, num_kv),
+            in_specs=[q_spec, kv_spec, kv_spec, q_spec, r_spec,
+                      r_spec],
+            out_specs=[q_spec, kv_spec, kv_spec],
+            out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype,
+                                            vma=_vma(q)),
+                       jax.ShapeDtypeStruct((bh, skv, d), k.dtype,
+                                            vma=_vma(q)),
+                       jax.ShapeDtypeStruct((bh, skv, d), v.dtype,
+                                            vma=_vma(q))],
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            interpret=_interpret(),
+        )(q, k, v, g, lse, delta)
+        return dq, dk, dv
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
     r_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))

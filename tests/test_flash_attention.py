@@ -129,6 +129,30 @@ def test_ring_with_flash_blocks_matches_dense(causal):
                                    atol=1e-3, rtol=1e-3)
 
 
+def test_combined_backward_multi_kv_blocks_matches_xla():
+    """The num_q==1 combined backward kernel (single q block, several
+    kv blocks — the training hot path's regime) must reproduce XLA
+    gradients: exercises dq accumulation across kv blocks and the
+    per-ki direct dk/dv writes, which the split-kernel tests never
+    reach."""
+    q, k, v = _rand(s=256)
+
+    def loss_flash(q, k, v):
+        # block_q=256 -> num_q=1, block_kv=128 -> num_kv=2
+        return (flash_attention(q, k, v, block_q=256,
+                                block_kv=128) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (_xla_attention(q, k, v, None, True, 0, 0.0, None, True,
+                               True) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-3, rtol=1e-3)
+
+
 def test_bf16_training_dtype_matches_xla_within_tolerance():
     """Kernel vs XLA path at the TRAINING dtype (bf16 q/k/v, fp32
     accumulation in both): the kernel pre-scales q in bf16 (one extra
