@@ -6,6 +6,7 @@ python benchmarks/run_benchmark.py \
   --model_item gpt_bs16_fp32_DP1-MP1-PP1 \
   --config configs/nlp/gpt/pretrain_gpt_345M_single_card.yaml \
   --max_steps "${MAX_STEPS:-100}" \
+  ${CPU_DEVICES:+--cpu-devices "$CPU_DEVICES"} \
   --overrides \
     Global.local_batch_size=16 Global.micro_batch_size=16 \
     Model.num_layers=4 Model.hidden_size=1024 \

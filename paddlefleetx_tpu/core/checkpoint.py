@@ -13,6 +13,7 @@ Layout: ``<output>/epoch_{E}_step_{S}/{state,meta}``.
 
 from __future__ import annotations
 
+import atexit
 import os
 import re
 from typing import Any, Dict, Optional, Tuple
@@ -29,24 +30,59 @@ def _checkpointer() -> ocp.Checkpointer:
     return ocp.Checkpointer(ocp.CompositeCheckpointHandler())
 
 
+_ASYNC_CKPTR: Optional[ocp.AsyncCheckpointer] = None
+
+
+def _async_checkpointer() -> ocp.AsyncCheckpointer:
+    """Process-wide async checkpointer (holds the background write
+    thread pool); drained at interpreter exit so a fast-exiting run
+    cannot truncate its last checkpoint."""
+    global _ASYNC_CKPTR
+    if _ASYNC_CKPTR is None:
+        _ASYNC_CKPTR = ocp.AsyncCheckpointer(
+            ocp.CompositeCheckpointHandler())
+        atexit.register(wait_for_pending_save)
+    return _ASYNC_CKPTR
+
+
+def wait_for_pending_save() -> None:
+    """Block until an in-flight async save (if any) is durable."""
+    if _ASYNC_CKPTR is not None:
+        _ASYNC_CKPTR.wait_until_finished()
+
+
 def save_checkpoint(output_dir: str, epoch: int, step: int, state,
-                    meta: Dict[str, Any]) -> str:
+                    meta: Dict[str, Any],
+                    async_save: bool = False) -> str:
+    """Write ``<output>/epoch_{E}_step_{S}``. With ``async_save`` the
+    device arrays are snapshotted and the TensorStore write proceeds
+    on background threads while training continues (the reference
+    serializes training behind ``paddle.save``); the next save — or
+    process exit — waits for the previous one."""
     path = os.path.abspath(
         os.path.join(output_dir, f"epoch_{epoch}_step_{step}"))
-    with _checkpointer() as ckptr:
-        ckptr.save(
-            path,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state),
-                meta=ocp.args.JsonSave(meta)),
-            force=True)
-    logger.info("saved checkpoint to %s", path)
+    args = ocp.args.Composite(
+        state=ocp.args.StandardSave(state),
+        meta=ocp.args.JsonSave(meta))
+    if async_save:
+        ckptr = _async_checkpointer()
+        ckptr.wait_until_finished()   # at most one save in flight
+        ckptr.save(path, args=args, force=True)
+        logger.info("async checkpoint save started to %s", path)
+    else:
+        with _checkpointer() as ckptr:
+            ckptr.save(path, args=args, force=True)
+        logger.info("saved checkpoint to %s", path)
     return path
 
 
 def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
     """Resolve a checkpoint path: either a step dir itself or the
     newest ``epoch_*_step_*`` below ``ckpt_dir``."""
+    # an in-flight async save only gets its final (regex-matching)
+    # name at commit; resolving before that would miss it or silently
+    # pick the previous step
+    wait_for_pending_save()
     if ckpt_dir is None or not os.path.isdir(ckpt_dir):
         return None
     if _STEP_DIR.search(ckpt_dir):
@@ -65,6 +101,7 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
 def load_checkpoint(path: str, abstract_state):
     """Restore (state, meta); ``abstract_state`` carries target
     shardings so arrays land directly on the current mesh."""
+    wait_for_pending_save()   # same-process restore-after-async-save
     path = os.path.abspath(path)
     with _checkpointer() as ckptr:
         restored = ckptr.restore(

@@ -95,6 +95,9 @@ class Engine(BasicEngine):
         save_load = eng.get("save_load", {})
         self.save_steps = save_load.get("save_steps", sys.maxsize)
         self.save_epoch = save_load.get("save_epoch", 1)
+        # TPU-native extra (reference paddle.save blocks training):
+        # overlap the TensorStore write with the next steps
+        self.async_save = bool(save_load.get("async_save", False))
         self.output_dir = save_load.get("output_dir", "./output")
         self.ckpt_dir = save_load.get("ckpt_dir")
 
@@ -621,7 +624,7 @@ class Engine(BasicEngine):
             "seed": int(self.configs.Global.get("seed", 1024)),
         }
         ckpt.save_checkpoint(self.output_dir, epoch, step, self.state,
-                             meta)
+                             meta, async_save=self.async_save)
 
     def load(self):
         path = ckpt.latest_checkpoint(self.ckpt_dir)

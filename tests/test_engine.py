@@ -137,6 +137,29 @@ def test_checkpoint_save_load_resume(tmp_path):
         params_before, engine2.state["params"])
 
 
+def test_async_checkpoint_save_then_resume(tmp_path):
+    """Engine.save_load.async_save overlaps the TensorStore write with
+    training; a fresh engine must restore the identical state (the
+    load path waits for any in-flight save)."""
+    cfg, engine, loader = _build(
+        tmp_path, **{"Engine.max_steps": 2,
+                     "Engine.save_load.async_save": True})
+    assert engine.async_save
+    engine.fit(epoch=1, train_data_loader=loader)
+    engine.save(epoch=1)
+    step = int(engine.state["step"])
+    params_before = jax.tree.map(np.asarray, engine.state["params"])
+
+    cfg2, engine2, _ = _build(
+        tmp_path, **{"Engine.max_steps": 2,
+                     "Engine.save_load.ckpt_dir": str(tmp_path / "out")})
+    assert int(engine2.state["step"]) == step
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        params_before, engine2.state["params"])
+
+
 def test_checkpoint_restores_across_topologies(tmp_path):
     """Save on mesh A (dp2 x mp2 x sharding2), restore on mesh B
     (mp4 x pp... different axis split) — the SURVEY 'hard part' the
