@@ -219,7 +219,13 @@ def bench_train():
     peak = peak_flops() if on_tpu else None
     mfu = (tokens_per_sec * model_flops_per_token(cfg, seq) / peak) \
         if peak else None
-    mfu_67b = decoder_geometry_mfu(peak) if peak else None
+    mfu_67b = None
+    if peak:
+        try:
+            mfu_67b = decoder_geometry_mfu(peak)
+        except Exception as e:  # secondary metric must not kill the
+            sys.stderr.write(   # headline number (e.g. OOM on <16G)
+                f"warning: 6.7B-geometry bench failed: {e}\n")
     print(json.dumps({
         "metric": "gpt345m_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
