@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Single-card text generation from a trained 345M checkpoint.
+# Reference: tasks/gpt/run_generation.sh (CUDA_VISIBLE_DEVICES=0 there;
+# device selection is automatic on a single-chip TPU host).
+
+python tasks/gpt/generation.py -c ./configs/nlp/gpt/generation_gpt_345M_single_card.yaml
