@@ -18,6 +18,12 @@ costs ~6/8 of the roofline before hardware efficiency).
 through the fixed-capacity KV cache) in decoded tokens/s — the
 reference publishes generation behavior via ``tasks/gpt/generation.py``
 but no number; this attaches one.
+
+``--mode moe`` benchmarks the 8-expert top-2 MoE variant of the 345M
+geometry (models/gpt/moe.py; no reference analogue — it has no MoE).
+Reported MFU counts ACTIVE FLOPs (top-2 of 8 experts ≈ 2x the dense
+FFN per token), so it is comparable to the dense number: the delta is
+the routing/dispatch overhead.
 """
 
 import argparse
@@ -66,13 +72,15 @@ def peak_flops() -> float:
 
 
 def _gpt345m(on_tpu: bool, **kw):
-    return GPTConfig(
+    base = dict(
         vocab_size=50304, hidden_size=1024, num_layers=24,
         num_attention_heads=16, ffn_hidden_size=4096,
         max_position_embeddings=1024, hidden_dropout_prob=0.0,
         attention_probs_dropout_prob=0.0,
         dtype="bfloat16" if on_tpu else "float32",
-        use_flash_attention=on_tpu, **kw)
+        use_flash_attention=on_tpu)
+    base.update(kw)
+    return GPTConfig(**base)
 
 
 def model_flops_per_token(cfg: GPTConfig, seq: int) -> float:
@@ -237,6 +245,43 @@ def bench_train():
     }))
 
 
+def bench_moe():
+    """Tokens/s + active-FLOPs MFU of an 8-expert top-2 MoE at the
+    345M width (h=1024; 12 layers — the full 24-layer 8-expert stack
+    is ~1.8B params, whose fp32 master + Adam moments alone exceed a
+    16G chip). Single-chip = ep 1; the dispatch/combine einsums and
+    router still run, so the number prices MoE's routing overhead
+    against ``bench_train``'s dense MFU."""
+    on_tpu = jax.devices()[0].platform == "tpu"
+    batch, seq, acc = (4, 1024, 8) if on_tpu else (2, 128, 1)
+    cfg = _gpt345m(
+        on_tpu, use_recompute=on_tpu,
+        recompute_granularity="save_dots" if on_tpu else "full",
+        loss_chunks=8 if on_tpu else 1,
+        num_layers=12,
+        moe_num_experts=8, moe_top_k=2, moe_capacity_factor=1.25,
+        moe_z_loss_weight=1e-3)
+    tokens_per_sec = _measure_train(cfg, batch, seq, acc,
+                                    6 if on_tpu else 2, on_tpu)
+    peak = peak_flops() if on_tpu else None
+    mfu = None
+    if peak:
+        # active FLOPs/token: dense + (k-1) extra expert FFNs. The
+        # FFN share of the dense 72*L*h^2 is 48*L*h^2 (2*h*4h fwd x3
+        # for fwd+bwd), so top-k routing adds (k-1)*48*L*h^2.
+        L, h = cfg.num_layers, cfg.hidden_size
+        flops = model_flops_per_token(cfg, seq) \
+            + (cfg.moe_top_k - 1) * 48.0 * L * h * h
+        mfu = tokens_per_sec * flops / peak
+    print(json.dumps({
+        "metric": "gpt345m_moe8_top2_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,  # no reference MoE exists
+        "mfu_active_flops": round(mfu, 4) if mfu is not None else None,
+    }))
+
+
 def bench_generation():
     """Decode tokens/s: batch sampling through the fixed KV cache."""
     from paddlefleetx_tpu.models.gpt.generation import (
@@ -286,11 +331,13 @@ def bench_generation():
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--mode", choices=["train", "generation"],
+    p.add_argument("--mode", choices=["train", "generation", "moe"],
                    default="train")
     args = p.parse_args()
     if args.mode == "train":
         bench_train()
+    elif args.mode == "moe":
+        bench_moe()
     else:
         bench_generation()
 

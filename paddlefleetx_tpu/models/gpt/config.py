@@ -47,6 +47,15 @@ class GPTConfig:
     #: materializes beyond one chunk. Trades one extra head matmul
     #: per chunk in backward for O(s/chunks) logits memory.
     loss_chunks: int = 1
+    #: Mixture-of-Experts (beyond-reference; the reference has no MoE,
+    #: SURVEY §2.2 EP row). 0 = dense FFN. >0: every decoder block's
+    #: FFN becomes ``moe_num_experts`` routed experts (models/gpt/moe.py),
+    #: expert-parallel over ``Distributed.ep_degree`` dataflow devices.
+    moe_num_experts: int = 0
+    moe_top_k: int = 2                    # experts per token
+    moe_capacity_factor: float = 1.25     # slots = ceil(k*s*cf/E)
+    moe_aux_loss_weight: float = 0.01     # Switch load-balance loss
+    moe_z_loss_weight: float = 0.0        # router z-loss (off by default)
     dtype: str = "float32"                # compute dtype (bf16 for AMP-O2)
     param_dtype: str = "float32"
 
@@ -66,6 +75,13 @@ class GPTConfig:
             raise ValueError(
                 f"unknown pipeline_schedule {self.pipeline_schedule!r} "
                 f"(expected '1F1B' or 'GPipe')")
+        if self.moe_num_experts:
+            if not 1 <= self.moe_top_k <= self.moe_num_experts:
+                raise ValueError(
+                    f"moe_top_k ({self.moe_top_k}) must be in "
+                    f"[1, moe_num_experts={self.moe_num_experts}]")
+            if self.moe_capacity_factor <= 0:
+                raise ValueError("moe_capacity_factor must be > 0")
 
     @property
     def head_dim(self) -> int:

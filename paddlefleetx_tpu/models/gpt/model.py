@@ -223,29 +223,36 @@ class TransformerDecoderLayer(nn.Module):
 
         residual = x
         y = ln("norm2")(x)
-        y = nn.DenseGeneral(
-            cfg.ffn_hidden_size, name="linear1", dtype=dtype,
-            param_dtype=pdtype,
-            kernel_init=nn.with_logical_partitioning(
-                _dense_init(cfg), ("embed", "mlp")),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros_init(), ("mlp",)))(y)
-        y = checkpoint_name(y, "mlp1")
-        y = nn.gelu(y, approximate=True)
-        y = with_logical_constraint(y, ("batch", None, "act_mlp"))
-        y = nn.DenseGeneral(
-            cfg.hidden_size, name="linear2", dtype=dtype,
-            param_dtype=pdtype,
-            kernel_init=nn.with_logical_partitioning(
-                _dense_init(cfg), ("mlp", "embed")),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros_init(), ("embed",)))(y)
-        y = checkpoint_name(y, "mlp2")
+        moe_aux = None
+        if cfg.moe_num_experts:
+            from .moe import MoEMLP
+            y, moe_aux = MoEMLP(cfg, name="moe_mlp")(y, deterministic)
+        else:
+            y = nn.DenseGeneral(
+                cfg.ffn_hidden_size, name="linear1", dtype=dtype,
+                param_dtype=pdtype,
+                kernel_init=nn.with_logical_partitioning(
+                    _dense_init(cfg), ("embed", "mlp")),
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), ("mlp",)))(y)
+            y = checkpoint_name(y, "mlp1")
+            y = nn.gelu(y, approximate=True)
+            y = with_logical_constraint(y, ("batch", None, "act_mlp"))
+            y = nn.DenseGeneral(
+                cfg.hidden_size, name="linear2", dtype=dtype,
+                param_dtype=pdtype,
+                kernel_init=nn.with_logical_partitioning(
+                    _dense_init(cfg), ("mlp", "embed")),
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), ("embed",)))(y)
+            y = checkpoint_name(y, "mlp2")
         y = nn.Dropout(cfg.hidden_dropout_prob, name="dropout2")(
             y, deterministic=deterministic)
         x = residual + y
         x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
-        return (x, None) if self.scanned else x
+        if self.scanned:
+            return x, moe_aux
+        return (x, moe_aux) if cfg.moe_num_experts else x
 
 
 class GPTEmbeddings(nn.Module):
@@ -306,7 +313,7 @@ class GPTModel(nn.Module):
                 prevent_cse=not cfg.scan_layers,
                 static_argnums=(3, 4))
         if cfg.scan_layers:
-            x, _ = nn.scan(
+            x, aux_stack = nn.scan(
                 block,
                 variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
@@ -315,11 +322,22 @@ class GPTModel(nn.Module):
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, scanned=True, name="decoder")(
                 x, attn_bias, use_cache, deterministic)
+            moe_aux = aux_stack.sum() if cfg.moe_num_experts else None
         else:
+            moe_aux = jnp.zeros((), jnp.float32) \
+                if cfg.moe_num_experts else None
             for i in range(cfg.num_layers):
                 x = block(cfg, name=f"decoder_{i}")(
                     x, attn_bias, use_cache, deterministic)
+                if cfg.moe_num_experts:
+                    x, aux = x
+                    moe_aux = moe_aux + aux
 
+        if moe_aux is not None:
+            # picked up by loss paths via mutable=["losses"]; silently
+            # dropped (flax sow semantics) by eval/generation/export
+            # applies that don't request the collection
+            self.sow("losses", "moe_aux", moe_aux)
         return _final_norm(cfg, name="final_norm")(x)
 
 
@@ -401,6 +419,11 @@ def _pipeline_parts(cfg: GPTConfig, input_ids, position_ids,
     if not cfg.scan_layers:
         raise ValueError("pipeline parallelism requires scan_layers=True "
                          "(stacked decoder params)")
+    if cfg.moe_num_experts:
+        raise ValueError("MoE is not supported with pipeline "
+                         "parallelism (the per-layer router aux loss "
+                         "is not plumbed through the 1F1B schedule); "
+                         "use ep x tp x dp/fsdp")
     if position_ids is None:
         position_ids = jnp.broadcast_to(
             jnp.arange(input_ids.shape[-1], dtype=jnp.int32)[None, :],
@@ -560,7 +583,7 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
 def chunked_lm_loss(model: "GPTForPretraining", params, input_ids,
                     labels, loss_mask, *, chunks: int,
                     position_ids=None, deterministic: bool = True,
-                    rngs=None) -> jax.Array:
+                    rngs=None, include_moe_aux: bool = True) -> jax.Array:
     """Masked-CE pretraining loss with the LM head + softmax computed
     over ``chunks`` sequence chunks inside a rematerialized scan.
 
@@ -581,9 +604,16 @@ def chunked_lm_loss(model: "GPTForPretraining", params, input_ids,
         raise ValueError(
             f"loss_chunks ({chunks}) must divide the sequence length "
             f"({s})")
-    h = GPTModel(cfg).apply({"params": params["gpt"]}, input_ids,
-                            position_ids, None, False, deterministic,
-                            rngs=rngs)
+    moe_aux = jnp.zeros((), jnp.float32)
+    if cfg.moe_num_experts and include_moe_aux:
+        h, mods = GPTModel(cfg).apply(
+            {"params": params["gpt"]}, input_ids, position_ids, None,
+            False, deterministic, rngs=rngs, mutable=["losses"])
+        moe_aux = sum(jax.tree.leaves(mods["losses"]))
+    else:
+        h = GPTModel(cfg).apply({"params": params["gpt"]}, input_ids,
+                                position_ids, None, False,
+                                deterministic, rngs=rngs)
     word_emb = _word_embedding(params["gpt"]["embeddings"])
 
     csz = s // chunks
@@ -600,4 +630,4 @@ def chunked_lm_loss(model: "GPTForPretraining", params, input_ids,
     (nll, msum), _ = jax.lax.scan(
         body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
         (hc, lc, mc))
-    return nll / jnp.maximum(msum, 1.0)
+    return nll / jnp.maximum(msum, 1.0) + moe_aux

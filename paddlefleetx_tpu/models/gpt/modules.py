@@ -74,6 +74,10 @@ class GPTModule(LanguageModule):
             raise ValueError("QAT is not supported with pipeline "
                              "parallelism (reference QAT recipe is "
                              "mp-only, pretrain_gpt_345M_mp8_qat)")
+        if self.model_config.moe_num_experts and self.qat_cfg.enable:
+            raise ValueError("QAT is not supported with MoE (the QAT "
+                             "wrapper fake-quantizes dense Linear "
+                             "kernels only)")
         # microbatch count = accumulate_steps (reference
         # ``utils/config.py:117``); eval batches that don't divide
         # fall back to a single microbatch
@@ -117,7 +121,7 @@ class GPTModule(LanguageModule):
                 self.model, params, tokens, labels, loss_mask,
                 chunks=self.model_config.loss_chunks,
                 position_ids=position_ids, deterministic=deterministic,
-                rngs=rngs)
+                rngs=rngs, include_moe_aux=train)
         if self.qat_cfg.enable:
             from ...ops.quantization import qat_apply
             logits = qat_apply(
@@ -127,6 +131,20 @@ class GPTModule(LanguageModule):
                 position_ids=position_ids, deterministic=deterministic,
                 rngs=rngs)
         else:
+            if self.model_config.moe_num_experts:
+                # the router's load-balance/z losses are sown into the
+                # "losses" collection (models/gpt/moe.py); the TRAIN
+                # loss adds them to the LM cross-entropy (eval reports
+                # pure CE so perplexities stay comparable)
+                logits, mods = self.model.apply(
+                    {"params": params}, tokens,
+                    position_ids=position_ids,
+                    deterministic=deterministic, rngs=rngs,
+                    mutable=["losses"])
+                ce = cross_entropy_loss(logits, labels, loss_mask)
+                if train:
+                    ce = ce + sum(jax.tree.leaves(mods["losses"]))
+                return ce
             logits = self.model.apply(
                 {"params": params}, tokens, position_ids=position_ids,
                 deterministic=deterministic, rngs=rngs)

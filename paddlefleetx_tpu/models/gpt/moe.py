@@ -1,0 +1,178 @@
+"""Mixture-of-Experts FFN with expert parallelism (beyond-reference).
+
+The reference has no MoE (SURVEY §2.2: "EP … not present"); this adds
+it the TPU-native way — the GShard/Switch design expressed as einsums
+that GSPMD partitions:
+
+  - a fp32 router picks top-k experts per token;
+  - tokens are packed into per-expert capacity slots through a
+    one-hot *dispatch* tensor and unpacked through a gate-weighted
+    *combine* tensor (all static shapes — no ragged scatter, so the
+    MXU sees dense batched matmuls);
+  - expert weights are stacked on a leading ``expert`` logical axis.
+    Expert parallelism = sharding that axis over the dataflow mesh
+    axes (``Distributed.ep_degree`` → dp/fsdp; a *dedicated* mesh
+    axis would replicate the attention compute ep-fold, which is why
+    EP classically rides the data-parallel groups). XLA inserts the
+    token all-to-alls at the dispatch/combine einsum boundaries.
+    The ``expert_mlp`` inner dim still shards over mp, composing
+    EP x TP.
+
+Load balancing follows Switch/GShard: an auxiliary loss
+``E * sum_e f_e * P_e`` (f = fraction of tokens whose top-1 choice is
+expert e, P = mean router probability) plus an optional router z-loss
+``mean(logsumexp(logits)^2)``. The layer returns the already-weighted
+auxiliary total; the model sows it into the ``losses`` collection and
+the training loss adds it.
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ...parallel.sharding import with_logical_constraint
+from .config import GPTConfig
+
+
+def _dense_init(cfg: GPTConfig):
+    return nn.initializers.normal(stddev=cfg.initializer_range)
+
+
+def expert_capacity(cfg: GPTConfig, seq_len: int) -> int:
+    """Per-expert capacity slots for one routing group (= one batch
+    row): ``ceil(top_k * seq * capacity_factor / num_experts)``."""
+    return max(1, int(math.ceil(
+        cfg.moe_top_k * seq_len * cfg.moe_capacity_factor
+        / cfg.moe_num_experts)))
+
+
+def router_dispatch(probs: jax.Array, top_k: int, capacity: int):
+    """Token-choice routing with per-expert capacity.
+
+    Args:
+      probs: fp32 router probabilities ``[b, s, E]``.
+      top_k: experts per token.
+      capacity: slots per expert per batch row.
+
+    Returns ``(dispatch, combine, aux_frac)``:
+      dispatch: 0/1 ``[b, s, E, C]`` — token (b,s) occupies slot c of
+        expert e. Tokens overflowing an expert's capacity are dropped
+        (their dispatch row is zero → they pass through the residual
+        only, the standard Switch overflow behavior).
+      combine: fp32 ``[b, s, E, C]`` — dispatch weighted by the
+        (renormalized, for k>1) gate probabilities.
+      aux_frac: fp32 ``[E]`` — fraction of tokens whose *first* choice
+        is each expert (the f_e of the Switch load-balance loss,
+        computed before capacity drops, as in GShard).
+    """
+    b, s, E = probs.shape
+    gate, idx = jax.lax.top_k(probs, top_k)            # [b, s, k]
+    if top_k > 1:
+        gate = gate / jnp.maximum(
+            gate.sum(axis=-1, keepdims=True), 1e-9)
+    onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)   # [b, s, k, E]
+
+    # Position of each (token, choice) in its expert's slot queue:
+    # lexicographic (s, k) priority — all of a token's choices are
+    # adjacent, earlier tokens win slots, matching the reference-free
+    # GShard formulation.
+    flat = onehot.reshape(b, s * top_k, E)
+    pos = jnp.sum((jnp.cumsum(flat, axis=1) - flat) * flat,
+                  axis=-1)                             # [b, s*k]
+    kept = (pos < capacity)[..., None] * flat          # [b, s*k, E]
+    slot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)
+    dispatch = jnp.einsum("bte,btc->btec", kept.astype(jnp.float32),
+                          slot)
+    dispatch = dispatch.reshape(b, s, top_k, E, capacity)
+    combine = jnp.einsum("bskec,bsk->bsec", dispatch, gate)
+    dispatch = dispatch.sum(axis=2)                    # [b, s, E, C]
+
+    aux_frac = onehot[:, :, 0, :].astype(jnp.float32).mean(axis=(0, 1))
+    return dispatch, combine, aux_frac
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for the decoder block's dense FFN.
+
+    Returns ``(y, aux)`` where ``aux`` is the weighted auxiliary loss
+    (load balance + router z-loss) as an fp32 scalar.
+    """
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        E, k = cfg.moe_num_experts, cfg.moe_top_k
+        b, s, h = x.shape
+        m = cfg.ffn_hidden_size
+        dtype = jnp.dtype(cfg.dtype)
+        pdtype = jnp.dtype(cfg.param_dtype)
+
+        # router runs in fp32 (bf16 logits make top-k ties and the
+        # z-loss noisy); its params are tiny and stay replicated
+        wr = self.param(
+            "router_kernel",
+            nn.with_logical_partitioning(_dense_init(cfg),
+                                         ("embed", None)),
+            (h, E), pdtype)
+        logits = jnp.einsum("bsh,he->bse", x.astype(jnp.float32),
+                            wr.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        C = expert_capacity(cfg, s)
+        dispatch, combine, aux_frac = router_dispatch(probs, k, C)
+
+        # pack tokens into expert slots: [b,s,h] -> [E,b,C,h]; the E
+        # axis is ep-sharded, so this einsum IS the all-to-all
+        xe = jnp.einsum("bsec,bsh->ebch", dispatch.astype(dtype), x)
+        xe = with_logical_constraint(
+            xe, ("act_expert", "act_expert_batch", None, None))
+
+        w1 = self.param(
+            "wi", nn.with_logical_partitioning(
+                _dense_init(cfg), ("expert", "expert_embed",
+                                   "expert_mlp")),
+            (E, h, m), pdtype)
+        b1 = self.param(
+            "wi_bias", nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("expert", "expert_mlp")),
+            (E, m), pdtype)
+        w2 = self.param(
+            "wo", nn.with_logical_partitioning(
+                _dense_init(cfg), ("expert", "expert_mlp",
+                                   "expert_embed")),
+            (E, m, h), pdtype)
+        b2 = self.param(
+            "wo_bias", nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("expert",
+                                               "expert_embed")),
+            (E, h), pdtype)
+
+        from jax.ad_checkpoint import checkpoint_name
+        y = jnp.einsum("ebch,ehm->ebcm", xe, w1.astype(dtype)) \
+            + b1.astype(dtype)[:, None, None, :]
+        y = checkpoint_name(y, "mlp1")
+        y = nn.gelu(y, approximate=True)
+        y = with_logical_constraint(
+            y, ("act_expert", "act_expert_batch", None, "act_mlp"))
+        y = jnp.einsum("ebcm,emh->ebch", y, w2.astype(dtype)) \
+            + b2.astype(dtype)[:, None, None, :]
+        y = checkpoint_name(y, "mlp2")
+
+        # unpack + gate-weight: the return all-to-all
+        out = jnp.einsum("ebch,bsec->bsh", y, combine.astype(dtype))
+        out = with_logical_constraint(out, ("batch", None, "act_embed"))
+
+        aux = jnp.asarray(0.0, jnp.float32)
+        if cfg.moe_aux_loss_weight:
+            load_balance = E * jnp.sum(aux_frac * probs.mean(axis=(0, 1)))
+            aux = aux + cfg.moe_aux_loss_weight * load_balance
+        if cfg.moe_z_loss_weight:
+            z = jnp.mean(
+                jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
+            aux = aux + cfg.moe_z_loss_weight * z
+        return out, aux

@@ -54,6 +54,18 @@ def process_model_configs(config) -> None:
             config.Distributed.mp_degree <= 1:
         # reference forces SP off when mp<=1 (hybrid_model.py:649-652)
         model["sequence_parallel"] = False
+    n_experts = model.get("moe_num_experts") or 0
+    if n_experts:
+        if pp > 1:
+            raise ValueError(
+                "MoE is not supported with pipeline parallelism "
+                "(the per-layer router aux loss is not plumbed "
+                "through the 1F1B schedule); use ep x tp x dp/fsdp")
+        ep = config.Distributed.get("ep_degree") or 1
+        if n_experts % ep != 0:
+            raise ValueError(
+                f"moe_num_experts ({n_experts}) must be divisible by "
+                f"ep_degree ({ep})")
 
 
 def process_data_configs(config) -> None:

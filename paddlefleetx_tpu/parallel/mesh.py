@@ -47,6 +47,13 @@ class TopologyConfig:
     pp_degree: int = 1
     cp_degree: int = 1          # context parallel (ring attention) —
     #                             beyond-reference (SURVEY §5.7)
+    ep_degree: int = 1          # expert parallel (MoE) — beyond-
+    #                             reference. Rides the dataflow axes
+    #                             (dp x fsdp): a dedicated mesh axis
+    #                             would replicate non-MoE compute
+    #                             ep-fold, so ep does NOT multiply
+    #                             world_size; it must equal dp, fsdp,
+    #                             or dp*fsdp (parallel/sharding.py)
     sharding_degree: int = 1
     sharding_stage: int = 1
     sharding_offload: bool = False
@@ -69,6 +76,7 @@ class TopologyConfig:
             mp_degree=dist.get("mp_degree") or 1,
             pp_degree=dist.get("pp_degree") or 1,
             cp_degree=dist.get("cp_degree") or 1,
+            ep_degree=dist.get("ep_degree") or 1,
             sharding_degree=sharding.get("sharding_degree") or 1,
             sharding_stage=sharding.get("sharding_stage") or 1,
             sharding_offload=bool(sharding.get("sharding_offload", False)),

@@ -49,6 +49,25 @@ def make_sharding_rules(topo: TopologyConfig) -> Rules:
       explicit collectives.
     """
     embed_axis = FSDP_AXIS if topo.sharding_stage == 3 else None
+    # EP (MoE): the stacked expert axis shards over dataflow devices —
+    # ep_degree selects how much of the dp x fsdp plane it uses. With
+    # ep == 1 under ZeRO-3 the expert stack still shards over fsdp
+    # (that IS the natural param-sharding of expert weights; GSPMD
+    # gathers/all-to-alls as the dispatch einsums demand either way).
+    if topo.ep_degree == 1:
+        expert_axis = FSDP_AXIS if topo.sharding_stage == 3 else None
+    elif topo.ep_degree == topo.dp_degree * topo.sharding_degree:
+        expert_axis = DATA_AXES
+    elif topo.ep_degree == topo.sharding_degree:
+        expert_axis = FSDP_AXIS
+    elif topo.ep_degree == topo.dp_degree:
+        expert_axis = DP_AXIS
+    else:
+        raise ValueError(
+            f"ep_degree ({topo.ep_degree}) must equal dp_degree "
+            f"({topo.dp_degree}), sharding_degree "
+            f"({topo.sharding_degree}), or their product — expert "
+            f"parallelism rides the dataflow axes")
     if topo.cp_degree > 1:
         # context parallel: activations flow sequence-sharded over cp;
         # attention runs the ring (ops/ring_attention.py)
@@ -76,7 +95,30 @@ def make_sharding_rules(topo: TopologyConfig) -> Rules:
         ("act_heads", MP_AXIS),
         ("act_mlp", MP_AXIS),
         ("act_vocab", MP_AXIS),
+        # MoE expert stack (models/gpt/moe.py): expert dim over the
+        # dataflow plane, inner FFN dim over mp (EP x TP); the
+        # "expert_embed" hidden dim stays unsharded — ZeRO-3 coverage
+        # of expert params comes from the expert axis itself
+        ("expert", expert_axis),
+        ("expert_embed", None),
+        ("expert_mlp", MP_AXIS),
+        ("act_expert", expert_axis),
+        # batch dim of the dispatched [E, b, C, h] tokens: the
+        # dataflow axes the expert axis does NOT consume — without
+        # this, ep < dp*fsdp would silently replicate expert compute
+        # over the uncovered axes
+        ("act_expert_batch", _residual_data_axes(expert_axis)),
     )
+
+
+def _residual_data_axes(expert_axis):
+    used = set()
+    if isinstance(expert_axis, str):
+        used.add(expert_axis)
+    elif expert_axis:
+        used.update(expert_axis)
+    residual = tuple(a for a in DATA_AXES if a not in used)
+    return residual or None
 
 
 def logical_to_mesh_spec(logical_axes: Sequence[Optional[str]],

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Mixture-of-Experts GPT (8 experts, top-2) with expert parallelism
+# over 8 chips. Beyond the reference's capability surface (SURVEY.md
+# §2.2: no MoE/EP there). Under SPMD one process drives all local
+# chips; use pfx-launch for multi-host.
+python ./tools/train.py -c ./configs/nlp/gpt/pretrain_moe_gpt_8x345M_ep8.yaml "$@"
