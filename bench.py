@@ -117,6 +117,14 @@ def _measure_train(cfg, batch, seq, acc, n_steps, on_tpu):
             return chunked_lm_loss(model, p, ids, labels, mask,
                                    chunks=cfg.loss_chunks,
                                    deterministic=True)
+        if cfg.moe_num_experts:
+            # match the engine's MoE objective: router aux losses in
+            # the measured backward (flax sow is a no-op without the
+            # mutable collection)
+            logits, mods = model.apply({"params": p}, ids,
+                                       mutable=["losses"])
+            return cross_entropy_loss(logits, labels, mask) \
+                + sum(jax.tree.leaves(mods["losses"]))
         return cross_entropy_loss(
             model.apply({"params": p}, ids), labels, mask)
 

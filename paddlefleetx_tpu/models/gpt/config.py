@@ -39,8 +39,14 @@ class GPTConfig:
     # TPU-specific knobs (absent in reference):
     scan_layers: bool = True              # lax.scan over layers
     use_flash_attention: bool = False     # Pallas kernel on TPU
-    context_parallel: bool = False        # ring attention over the cp
+    context_parallel: bool = False        # sequence sharded over the cp
     #                                       mesh axis (long context)
+    #: cp algorithm: "ring" (exact ring attention, O((s/cp)^2) memory,
+    #: ops/ring_attention.py) or "ulysses" (all-to-all: seq gathers
+    #: while heads shard over cp x mp for the attention itself — two
+    #: sharding constraints, XLA emits the all-to-alls; supports
+    #: attention dropout, needs heads % (cp*mp) == 0)
+    context_parallel_algo: str = "ring"
     #: >1: compute the LM loss over this many sequence chunks inside a
     #: rematerialized scan — the [b, s, V] logits tensor (the largest
     #: single activation: bs8 x s1024 x 50304 is 1.6 GB fp32) never
@@ -75,6 +81,11 @@ class GPTConfig:
             raise ValueError(
                 f"unknown pipeline_schedule {self.pipeline_schedule!r} "
                 f"(expected '1F1B' or 'GPipe')")
+        if self.context_parallel_algo not in ("ring", "ulysses"):
+            raise ValueError(
+                f"unknown context_parallel_algo "
+                f"{self.context_parallel_algo!r} (expected 'ring' or "
+                f"'ulysses')")
         if self.moe_num_experts:
             if not 1 <= self.moe_top_k <= self.moe_num_experts:
                 raise ValueError(

@@ -149,8 +149,22 @@ class MultiHeadAttention(nn.Module):
         if cfg.attention_probs_dropout_prob > 0.0 and not deterministic:
             dropout_rng = self.make_rng("dropout")
 
+        # Ulysses all-to-all CP (beyond-reference; DeepSpeed-Ulysses
+        # semantics expressed as GSPMD reshards): for the attention
+        # itself the seq dim gathers while heads shard over cp x mp —
+        # the two constraints below make XLA emit the token
+        # all-to-alls. Exact attention per head-shard, so dropout and
+        # biases work unchanged (unlike the ring path).
+        use_ulysses = (cfg.context_parallel and not use_cache
+                       and cfg.context_parallel_algo == "ulysses")
+        if use_ulysses:
+            q, k, v = (with_logical_constraint(
+                t, ("batch", None, "act_heads_cp", None))
+                for t in (q, k, v))
+
         ring_mesh = None
         if cfg.context_parallel and not use_cache and attn_bias is None \
+                and cfg.context_parallel_algo == "ring" \
                 and (deterministic
                      or cfg.attention_probs_dropout_prob == 0.0):
             from ...parallel.mesh import (
@@ -178,6 +192,10 @@ class MultiHeadAttention(nn.Module):
                 dropout_rng=dropout_rng, deterministic=deterministic,
                 use_flash=cfg.use_flash_attention,
                 kv_cache_layout=kv_cache_layout)
+        if use_ulysses:
+            # all-to-all back: seq re-shards over cp, heads gather
+            out = with_logical_constraint(
+                out, ("batch", "seq", "act_heads", None))
         out = checkpoint_name(out, "attn")
 
         out = nn.DenseGeneral(
@@ -193,8 +211,11 @@ class MultiHeadAttention(nn.Module):
 class TransformerDecoderLayer(nn.Module):
     """Pre-LN decoder block (reference ``single_model.py:340-427``).
 
-    With ``scanned=True`` the call returns ``(x, None)`` — the
-    ``(carry, ys)`` pair ``nn.scan`` requires.
+    With ``scanned=True`` the call returns ``(x, aux)`` — the
+    ``(carry, ys)`` pair ``nn.scan`` requires, where ``aux`` is the
+    MoE router auxiliary loss (None for the dense FFN). Non-scanned,
+    the return is the bare ``x`` for dense configs and ``(x, aux)``
+    when ``moe_num_experts > 0``.
     """
     config: GPTConfig
     scanned: bool = False

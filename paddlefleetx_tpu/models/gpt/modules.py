@@ -39,14 +39,17 @@ class GPTModule(LanguageModule):
         self.model_config = GPTConfig.from_config(self.configs)
         cp = (self.configs.get("Distributed") or {}).get("cp_degree", 1)
         if (cp or 1) > 1:
-            if self.model_config.attention_probs_dropout_prob > 0:
+            if self.model_config.context_parallel_algo == "ring" and \
+                    self.model_config.attention_probs_dropout_prob > 0:
                 # the ring path has no attention-prob dropout; a
                 # silent dense fallback would defeat cp's O((s/cp)^2)
-                # memory purpose
+                # memory purpose (Ulysses supports dropout — use
+                # context_parallel_algo: ulysses)
                 raise ValueError(
-                    "cp_degree > 1 requires "
+                    "cp_degree > 1 with the ring algorithm requires "
                     "attention_probs_dropout_prob = 0 (ring attention "
-                    "does not implement attention-prob dropout)")
+                    "does not implement attention-prob dropout; "
+                    "context_parallel_algo: ulysses does)")
             if not self.model_config.context_parallel:
                 import dataclasses
                 self.model_config = dataclasses.replace(

@@ -39,7 +39,10 @@ from .config import GPTConfig
 
 
 def _dense_init(cfg: GPTConfig):
-    return nn.initializers.normal(stddev=cfg.initializer_range)
+    # single source of truth lives in model.py (which imports this
+    # module lazily, so the import is cycle-safe)
+    from .model import _dense_init as impl
+    return impl(cfg)
 
 
 def expert_capacity(cfg: GPTConfig, seq_len: int) -> int:

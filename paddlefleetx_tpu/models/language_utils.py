@@ -54,6 +54,15 @@ def process_model_configs(config) -> None:
             config.Distributed.mp_degree <= 1:
         # reference forces SP off when mp<=1 (hybrid_model.py:649-652)
         model["sequence_parallel"] = False
+    cp = config.Distributed.get("cp_degree") or 1
+    if cp > 1 and model.get("context_parallel_algo") == "ulysses":
+        mp = config.Distributed.mp_degree or 1
+        heads = model["num_attention_heads"]
+        if heads % (cp * mp):
+            raise ValueError(
+                f"Ulysses context parallelism shards attention heads "
+                f"over cp*mp: num_attention_heads ({heads}) must be "
+                f"divisible by cp_degree*mp_degree ({cp * mp})")
     n_experts = model.get("moe_num_experts") or 0
     if n_experts:
         if pp > 1:

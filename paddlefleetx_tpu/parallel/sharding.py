@@ -93,6 +93,10 @@ def make_sharding_rules(topo: TopologyConfig) -> Rules:
         ("seq", seq_axis),
         ("act_embed", None),
         ("act_heads", MP_AXIS),
+        # Ulysses all-to-all CP: during attention the heads dim takes
+        # the cp axis on top of mp while seq gathers (models/gpt/
+        # model.py routes via context_parallel_algo="ulysses")
+        ("act_heads_cp", (CP_AXIS, MP_AXIS)),
         ("act_mlp", MP_AXIS),
         ("act_vocab", MP_AXIS),
         # MoE expert stack (models/gpt/moe.py): expert dim over the

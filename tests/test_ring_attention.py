@@ -139,3 +139,124 @@ def test_context_parallel_gpt_matches_single_device():
 def test_cp_excludes_megatron_sp():
     with pytest.raises(ValueError):
         TopologyConfig(cp_degree=2, mp_degree=2, sequence_parallel=True)
+
+
+def _ulysses_golden(topo, cfg_kw, ids_seed=1):
+    """Shared harness: GPT loss+grads under Ulysses cp vs single-device."""
+    import dataclasses
+
+    from paddlefleetx_tpu.models.gpt import (
+        GPTConfig, GPTForPretraining, cross_entropy_loss,
+    )
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_attention_heads=4, max_position_embeddings=64,
+                    ffn_hidden_size=64, hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0, **cfg_kw)
+    rng = np.random.default_rng(ids_seed)
+    ids = jnp.asarray(rng.integers(0, 64, (2, 32)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, 64, (2, 32)), jnp.int32)
+    mask = jnp.ones((2, 32), jnp.float32)
+
+    model = GPTForPretraining(cfg)
+    params = nn.meta.unbox(model.init(
+        {"params": jax.random.key(0)}, ids))["params"]
+
+    def loss_fn(m):
+        def f(p, i, l, msk):
+            logits = m.apply({"params": p}, i)
+            return cross_entropy_loss(logits, l, msk)
+        return f
+
+    ref_loss, ref_grads = jax.value_and_grad(loss_fn(model))(
+        params, ids, labels, mask)
+
+    mesh = build_mesh(topo)
+    set_mesh(mesh)
+    rules = make_sharding_rules(topo)
+    cp_model = GPTForPretraining(dataclasses.replace(
+        cfg, context_parallel=True, context_parallel_algo="ulysses"))
+    logical = nn.get_partition_spec(
+        jax.eval_shape(cp_model.init, {"params": jax.random.key(0)},
+                       ids))
+    shardings = nn.logical_to_mesh_sharding(logical, mesh, list(rules))
+    params_s = jax.device_put({"params": params},
+                              nn.meta.unbox(shardings))["params"]
+    data_sharding = NamedSharding(mesh, P(("dp", "fsdp"), "cp"))
+    ids_s, labels_s, mask_s = (jax.device_put(x, data_sharding)
+                               for x in (ids, labels, mask))
+    with mesh, nn.logical_axis_rules(list(rules)):
+        loss, grads = jax.jit(jax.value_and_grad(loss_fn(cp_model)))(
+            params_s, ids_s, labels_s, mask_s)
+    set_mesh(None)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-3),
+        ref_grads, grads)
+
+
+def test_ulysses_cp_gpt_matches_single_device():
+    """cp4 all-to-all (Ulysses): heads shard over cp during attention,
+    seq gathers — loss/grads == single-device."""
+    _ulysses_golden(TopologyConfig(dp_degree=2, cp_degree=4), {})
+
+
+def test_ulysses_composes_with_tp():
+    """cp2 x mp2: heads shard over cp*mp=4 during attention while the
+    MLP stays tensor-parallel."""
+    _ulysses_golden(TopologyConfig(dp_degree=2, cp_degree=2,
+                                   mp_degree=2), {})
+
+
+def test_ulysses_allows_attention_dropout():
+    """The ring guard must not fire for the Ulysses algorithm (exact
+    attention per head shard supports dropout)."""
+    from paddlefleetx_tpu.models import build_module
+    from paddlefleetx_tpu.utils.config import AttrDict, process_configs
+
+    cfg = AttrDict({
+        "Global": AttrDict({"seed": 1, "local_batch_size": 8,
+                            "micro_batch_size": 8,
+                            "global_batch_size": None}),
+        "Engine": AttrDict({"max_steps": 1,
+                            "mix_precision": AttrDict({})}),
+        "Model": AttrDict({
+            "module": "GPTModule", "name": "GPT", "vocab_size": 64,
+            "hidden_size": 32, "num_layers": 2,
+            "num_attention_heads": 4, "ffn_hidden_size": 64,
+            "max_position_embeddings": 32,
+            "hidden_dropout_prob": 0.1,
+            "attention_probs_dropout_prob": 0.1,
+            "context_parallel_algo": "ulysses",
+        }),
+        "Distributed": AttrDict({"dp_degree": 2, "cp_degree": 4,
+                                 "sharding": AttrDict({})}),
+        "Optimizer": AttrDict({
+            "name": "FusedAdamW",
+            "lr": AttrDict({"name": "CosineAnnealingWithWarmupDecay",
+                            "decay_steps": 10, "warmup_rate": 0.1,
+                            "max_lr": 1e-3, "min_lr": 1e-4}),
+        }),
+    })
+    process_configs(cfg, nranks=8)
+    module = build_module(cfg)  # must not raise the ring-dropout guard
+    assert module.model_config.context_parallel_algo == "ulysses"
+
+
+def test_ulysses_heads_divisibility_guard():
+    from paddlefleetx_tpu.utils.config import AttrDict
+    from paddlefleetx_tpu.models.language_utils import (
+        process_model_configs,
+    )
+    cfg = AttrDict({
+        "Global": AttrDict({"local_batch_size": 8,
+                            "micro_batch_size": 8}),
+        "Model": AttrDict({"hidden_size": 32, "num_layers": 2,
+                           "num_attention_heads": 6,
+                           "context_parallel_algo": "ulysses"}),
+        "Distributed": AttrDict({"pp_degree": 1, "mp_degree": 1,
+                                 "dp_degree": 2, "cp_degree": 4}),
+    })
+    with pytest.raises(ValueError, match="divisible by"):
+        process_model_configs(cfg)
