@@ -10,7 +10,9 @@ from __future__ import annotations
 import copy
 
 from ..utils.log import logger
-from .dataset.gpt_dataset import GPTDataset  # noqa: F401
+from .dataset.gpt_dataset import (  # noqa: F401
+    BlendedGPTDataset, GPTDataset,
+)
 from .loader import DataLoader
 from .sampler.batch_sampler import (  # noqa: F401
     DistributedBatchSampler, GPTBatchSampler,
@@ -36,6 +38,7 @@ def register_dataset(name):
 
 def _populate():
     DATASETS.setdefault("GPTDataset", GPTDataset)
+    DATASETS.setdefault("BlendedGPTDataset", BlendedGPTDataset)
     optional = {
         "dataset.gpt_dataset_eval": ("LM_Eval_Dataset",
                                      "Lambada_Eval_Dataset"),

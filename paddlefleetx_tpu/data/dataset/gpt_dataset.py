@@ -199,10 +199,14 @@ class GPTDataset:
     def __init__(self, input_dir: str, split: Sequence[float],
                  max_seq_len: int, num_samples: int, mode: str,
                  seed: int = 1234, eos_id: int = 50256,
-                 build_data_file: Optional[bool] = None):
+                 build_data_file: Optional[bool] = None,
+                 data_prefix: Optional[str] = None):
         if mode not in MODE_TO_INDEX:
             raise ValueError(f"mode must be one of {list(MODE_TO_INDEX)}")
-        prefix = get_train_data_file(input_dir)[0]
+        # data_prefix pins one corpus (used by BlendedGPTDataset);
+        # default: the first corpus in the directory, matching the
+        # reference (its input_dir list also resolves to one prefix)
+        prefix = data_prefix or get_train_data_file(input_dir)[0]
         for suffix in ("_ids.npy", "_idx.npz"):
             if not os.path.isfile(prefix + suffix):
                 raise ValueError(f"file not found: {prefix + suffix}")
@@ -262,3 +266,67 @@ class GPTDataset:
 
     def __len__(self) -> int:
         return self.sample_idx.shape[0] - 1
+
+
+class BlendedGPTDataset:
+    """Weighted blend of every corpus in ``input_dir`` (Megatron-style
+    multi-dataset mixing).
+
+    Drives the ``build_blending_indices`` native helper end-to-end —
+    the reference ships the same C++ entry point
+    (``fast_index_map_helpers.cpp:32``) but nothing in its Python ever
+    calls it; here it becomes a usable dataset
+    (``Data.Train.dataset.name: BlendedGPTDataset``).
+
+    ``weights`` (optional list, normalized internally) sets each
+    corpus's share of the sample stream; default is proportional to
+    corpus token counts. The greedy largest-error interleave keeps
+    running counts on-ratio at every prefix of the stream, so
+    curriculum position is stable under resume. Each child corpus
+    builds its own (cached) doc/sample/shuffle indices sized for its
+    share plus slack.
+    """
+
+    def __init__(self, input_dir: str, split: Sequence[float],
+                 max_seq_len: int, num_samples: int, mode: str,
+                 seed: int = 1234, eos_id: int = 50256,
+                 build_data_file: Optional[bool] = None,
+                 weights: Optional[Sequence[float]] = None):
+        from ..data_tools.index_helpers import build_blending_indices
+
+        prefixes = get_train_data_file(input_dir)
+        if weights is None:
+            sizes = [np.load(p + "_idx.npz")["lens"].sum()
+                     for p in prefixes]
+            weights = np.asarray(sizes, np.float64)
+        else:
+            if len(weights) != len(prefixes):
+                raise ValueError(
+                    f"weights ({len(weights)}) must match the number "
+                    f"of corpora in {input_dir!r} ({len(prefixes)}: "
+                    f"{[os.path.basename(p) for p in prefixes]})")
+            weights = np.asarray(weights, np.float64)
+        if (weights <= 0).any():
+            raise ValueError("blend weights must be positive")
+        weights = weights / weights.sum()
+
+        self.dataset_index, self.dataset_sample_index = \
+            build_blending_indices(len(prefixes), weights, num_samples)
+        # each child needs ceil(w * n) samples plus slack for the
+        # greedy interleave's rounding (Megatron uses the same margin)
+        self.datasets = [
+            GPTDataset(input_dir, split, max_seq_len,
+                       int(np.ceil(num_samples * w * 1.005)) + 1,
+                       mode, seed=seed, eos_id=eos_id,
+                       build_data_file=build_data_file, data_prefix=p)
+            for p, w in zip(prefixes, weights)]
+        self.mode = mode
+        self.weights = weights
+        self.num_samples = num_samples
+
+    def __getitem__(self, index: int):
+        ds = self.dataset_index[index]
+        return self.datasets[ds][int(self.dataset_sample_index[index])]
+
+    def __len__(self) -> int:
+        return self.num_samples

@@ -2,6 +2,7 @@ import numpy as np
 import pytest
 
 from paddlefleetx_tpu.data import (
+    BlendedGPTDataset,
     build_dataloader, gpt_collate_fn, GPTBatchSampler, GPTDataset,
     Pad, Stack, Tuple,
 )
@@ -14,7 +15,7 @@ from paddlefleetx_tpu.utils.config import AttrDict
 
 
 def make_corpus(tmp_path, n_docs=20, doc_len_range=(5, 40), seed=0,
-                vocab=1000, eos=50256):
+                vocab=1000, eos=50256, name="corpus"):
     """Synthetic {prefix}_ids.npy + {prefix}_idx.npz corpus."""
     rng = np.random.default_rng(seed)
     lens = rng.integers(*doc_len_range, n_docs).astype(np.int32)
@@ -22,7 +23,7 @@ def make_corpus(tmp_path, n_docs=20, doc_len_range=(5, 40), seed=0,
     # sprinkle EOS at document ends
     pos = np.cumsum(lens) - 1
     ids[pos] = eos
-    prefix = str(tmp_path / "corpus")
+    prefix = str(tmp_path / name)
     np.save(prefix + "_ids.npy", ids)
     np.savez(prefix + "_idx.npz", lens=lens)
     return prefix, ids, lens
@@ -244,3 +245,74 @@ def test_tokenizer_bpe_merges(tmp_path):
     (tmp_path / "merges.txt").write_text("h e\nhe l\n")
     tok = GPTTokenizer.from_pretrained(str(tmp_path))
     assert tok.tokenize("hello") == ["hel", "l", "o"]
+
+
+def make_named_corpus(tmp_path, name, n_docs, vocab=1000, eos=50256,
+                      seed=0):
+    """A corpus under a specific prefix name (for blending tests)."""
+    return make_corpus(tmp_path, n_docs=n_docs, doc_len_range=(10, 30),
+                       seed=seed, vocab=vocab, eos=eos, name=name)[0]
+
+
+class TestBlendedGPTDataset:
+    """BlendedGPTDataset drives the native build_blending_indices
+    helper end-to-end (the reference ships the C++ entry point but
+    never calls it from Python)."""
+
+    def _corpora(self, tmp_path):
+        make_named_corpus(tmp_path, "aa", 40, seed=1)
+        make_named_corpus(tmp_path, "bb", 40, seed=2)
+        return tmp_path
+
+    def test_blend_ratio_tracks_weights(self, tmp_path):
+        d = BlendedGPTDataset(
+            str(self._corpora(tmp_path)), [1, 0, 0], 16, 200, "Train",
+            weights=[3, 1], build_data_file=True)
+        assert len(d) == 200
+        counts = np.bincount(d.dataset_index, minlength=2)
+        np.testing.assert_allclose(counts / 200, [0.75, 0.25],
+                                   atol=0.01)
+        # the greedy interleave keeps every prefix of the stream
+        # on-ratio (within one sample per dataset)
+        run = np.cumsum(d.dataset_index == 0)
+        pos = np.arange(1, 201)
+        assert np.abs(run - 0.75 * pos).max() <= 1.5
+
+    def test_samples_come_from_the_right_corpus(self, tmp_path):
+        d = BlendedGPTDataset(
+            str(self._corpora(tmp_path)), [1, 0, 0], 16, 60, "Train",
+            weights=[1, 1], build_data_file=True)
+        for i in (0, 7, 31, 59):
+            ds, j = d.dataset_index[i], int(d.dataset_sample_index[i])
+            expect = d.datasets[ds][j]
+            got = d[i]
+            for a, b in zip(got, expect):
+                np.testing.assert_array_equal(a, b)
+
+    def test_default_weights_proportional_to_tokens(self, tmp_path):
+        make_named_corpus(tmp_path, "big", 60, seed=3)
+        make_named_corpus(tmp_path, "small", 20, seed=4)
+        d = BlendedGPTDataset(str(tmp_path), [1, 0, 0], 16, 100,
+                              "Train", build_data_file=True)
+        assert d.weights[0] > d.weights[1]  # "big" sorts first
+        np.testing.assert_allclose(d.weights.sum(), 1.0)
+
+    def test_weights_length_mismatch_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="weights"):
+            BlendedGPTDataset(
+                str(self._corpora(tmp_path)), [1, 0, 0], 16, 10,
+                "Train", weights=[1, 2, 3], build_data_file=True)
+
+    def test_builds_through_dataloader_registry(self, tmp_path):
+        from paddlefleetx_tpu.data import build_dataset
+
+        self._corpora(tmp_path)
+        cfg = {"Train": {"dataset": {
+            "name": "BlendedGPTDataset", "input_dir": str(tmp_path),
+            "split": [1, 0, 0], "max_seq_len": 16, "num_samples": 20,
+            "mode": "Train", "weights": [2, 1],
+            "build_data_file": True}}}
+        ds = build_dataset(cfg, "Train")
+        assert len(ds) == 20
+        sample = ds[0]
+        assert len(sample) == 4 and len(sample[0]) == 16
