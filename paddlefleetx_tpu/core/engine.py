@@ -23,6 +23,7 @@ collective, and update.
 from __future__ import annotations
 
 import os
+import signal
 import sys
 import time
 from typing import Any, Dict, Optional
@@ -98,8 +99,19 @@ class Engine(BasicEngine):
         # TPU-native extra (reference paddle.save blocks training):
         # overlap the TensorStore write with the next steps
         self.async_save = bool(save_load.get("async_save", False))
+        # TPU-native extra: TPU VMs get maintenance/preemption SIGTERM
+        # with a grace window; save at the next step boundary and stop
+        # cleanly so the restarted job resumes instead of losing the
+        # save_steps tail (the reference recovers only from its last
+        # periodic checkpoint, SURVEY.md §5.3)
+        self.save_on_preemption = bool(
+            save_load.get("save_on_preemption", True))
         self.output_dir = save_load.get("output_dir", "./output")
         self.ckpt_dir = save_load.get("ckpt_dir")
+
+        from ..utils.env import setup_compilation_cache
+        setup_compilation_cache(
+            configs.Global.get("compilation_cache_dir"))
 
         self.topo = TopologyConfig.from_config(configs)
         self.mesh = build_mesh(self.topo, devices=devices)
@@ -115,6 +127,7 @@ class Engine(BasicEngine):
         self._load_recovery = {"epoch": 0, "step": 0,
                                "consumed_samples": 0}
         self._host_step = 0
+        self._preempt_signum = None
 
         # config-gated profiler window (reference
         # ``eager_engine.py:202-224``: paddle.profiler over a
@@ -420,6 +433,25 @@ class Engine(BasicEngine):
             valid_data_loader=None):
         self._finalize_vit_schedule(train_data_loader)
         self._step_costs = []   # per-fit summary samples
+        self._preempt_signum = None
+        prev_handler, installed = None, False
+        if self.save_on_preemption:
+            try:
+                prev_handler = signal.signal(
+                    signal.SIGTERM,
+                    lambda signum, frame: setattr(
+                        self, "_preempt_signum", signum))
+                installed = True
+            except ValueError:
+                pass   # not the main thread; no handler possible
+        try:
+            self._fit_epochs(epoch, train_data_loader,
+                             valid_data_loader)
+        finally:
+            if installed:   # prev_handler may legitimately be None
+                signal.signal(signal.SIGTERM, prev_handler)
+
+    def _fit_epochs(self, epoch, train_data_loader, valid_data_loader):
         start_epoch = self._load_recovery["epoch"]
         consumed = self._load_recovery["consumed_samples"]
         for ep in range(start_epoch, epoch):
@@ -431,6 +463,18 @@ class Engine(BasicEngine):
                                   valid_data_loader)
             self.module.training_epoch_end(
                 {"epoch": ep, "train_cost": time.time() - t0})
+            if self._preempt_signum is not None:
+                # the signal may also have landed after the epoch's
+                # last per-batch check (loader exhaustion, epoch-end
+                # hooks) — save here, the single preemption exit path
+                step = int(self.state["step"])
+                logger.warning(
+                    "signal %d (preemption) received: saving "
+                    "checkpoint at step %d and stopping cleanly",
+                    self._preempt_signum, step)
+                self.save(ep)
+                ckpt.wait_for_pending_save()
+                break
             if self.run_mode == "epoch" and \
                     (ep + 1) % self.eval_freq == 0 and \
                     valid_data_loader is not None:
@@ -499,6 +543,8 @@ class Engine(BasicEngine):
                     self.save(epoch)
                     step_start = time.time()
                     window_clean = False
+                if self._preempt_signum is not None:
+                    return   # _fit_epochs saves, then stops
 
     def _print_summary(self) -> None:
         """Post-run host-time summary (reference ``_print_summary``
@@ -568,6 +614,11 @@ class Engine(BasicEngine):
         t0 = time.time()
         for i, batch in enumerate(valid_data_loader):
             if max_iters is not None and i >= max_iters:
+                break
+            if self._preempt_signum is not None:
+                # preemption grace windows are short; don't let a long
+                # eval pass outlive them — the preemption checkpoint
+                # in _fit_epochs is what matters
                 break
             batch = self.module.pretreating_batch(batch)
             out = self._eval_step(self.state, self._put_batch(batch))

@@ -161,7 +161,13 @@ def construct_samples_and_shuffle_data(name: str, data_prefix: str,
                                    - 1) // seq_length
             last_epoch_samples = num_samples - samples_before_last
             samples_per_epoch = (tokens_per_epoch - 1) // seq_length
-            if not 0 <= last_epoch_samples <= samples_per_epoch:
+            # the last epoch may hold one sample more than the floor
+            # estimate whenever tokens_per_epoch % seq_length != 0
+            # (per-epoch sample counts alternate between floor(T/s)
+            # and floor(T/s)+1); the reference asserts the un-jittered
+            # bound (gpt_dataset.py:298) and crashes on e.g.
+            # T=75/s=32/N=70 — tolerate the +1 instead
+            if not 0 <= last_epoch_samples <= samples_per_epoch + 1:
                 raise ValueError("inconsistent sample/epoch accounting")
             separate_last_epoch = (
                 last_epoch_samples < int(0.80 * samples_per_epoch))
@@ -200,7 +206,8 @@ class GPTDataset:
                  max_seq_len: int, num_samples: int, mode: str,
                  seed: int = 1234, eos_id: int = 50256,
                  build_data_file: Optional[bool] = None,
-                 data_prefix: Optional[str] = None):
+                 data_prefix: Optional[str] = None,
+                 lens: Optional[np.ndarray] = None):
         if mode not in MODE_TO_INDEX:
             raise ValueError(f"mode must be one of {list(MODE_TO_INDEX)}")
         # data_prefix pins one corpus (used by BlendedGPTDataset);
@@ -212,7 +219,8 @@ class GPTDataset:
                 raise ValueError(f"file not found: {prefix + suffix}")
         self.sample_ids = np.load(prefix + "_ids.npy", mmap_mode="r",
                                   allow_pickle=True)
-        lens = np.load(prefix + "_idx.npz")["lens"].astype(np.int32)
+        if lens is None:   # Blended passes its already-loaded copy
+            lens = np.load(prefix + "_idx.npz")["lens"].astype(np.int32)
         self.sample_lens = lens
 
         bounds = get_train_valid_test_split_(split, len(lens))
@@ -295,10 +303,13 @@ class BlendedGPTDataset:
         from ..data_tools.index_helpers import build_blending_indices
 
         prefixes = get_train_data_file(input_dir)
+        # one _idx.npz read per corpus, shared with the children below
+        lens_by_prefix = {
+            p: np.load(p + "_idx.npz")["lens"].astype(np.int32)
+            for p in prefixes}
         if weights is None:
-            sizes = [np.load(p + "_idx.npz")["lens"].sum()
-                     for p in prefixes]
-            weights = np.asarray(sizes, np.float64)
+            weights = np.asarray(
+                [lens_by_prefix[p].sum() for p in prefixes], np.float64)
         else:
             if len(weights) != len(prefixes):
                 raise ValueError(
@@ -318,7 +329,8 @@ class BlendedGPTDataset:
             GPTDataset(input_dir, split, max_seq_len,
                        int(np.ceil(num_samples * w * 1.005)) + 1,
                        mode, seed=seed, eos_id=eos_id,
-                       build_data_file=build_data_file, data_prefix=p)
+                       build_data_file=build_data_file, data_prefix=p,
+                       lens=lens_by_prefix[p])
             for p, w in zip(prefixes, weights)]
         self.mode = mode
         self.weights = weights

@@ -51,6 +51,25 @@ def init_dist_env(coordinator: Optional[str] = None,
                     jax.process_index(), jax.process_count())
 
 
+def setup_compilation_cache(cache_dir: Optional[str]) -> None:
+    """Point JAX's persistent compilation cache at ``cache_dir``
+    (``Global.compilation_cache_dir``). TPU-native concern with no
+    reference analogue: XLA compiles of big jitted train steps take
+    minutes, and preempted-and-restarted jobs (see
+    ``Engine.save_on_preemption``) would pay them again on every
+    restart — with the cache on shared storage they are skipped.
+    """
+    if not cache_dir:
+        return
+    cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # cache every program: the default thresholds skip fast compiles,
+    # but a restart replays *all* of them, so small entries pay too
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    logger.info("persistent compilation cache at %s", cache_dir)
+
+
 def set_seed(seed: int, data_rank: int = 0) -> jax.Array:
     """Seed host RNGs (offset by dataflow rank) and return the root key.
 

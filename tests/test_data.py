@@ -69,6 +69,24 @@ def test_dataset_samples_and_loss_mask(tmp_path):
     assert (mask[tokens != 50256] == 1).all()
 
 
+def test_dataset_epoch_jitter_geometry(tmp_path):
+    """tokens_per_epoch=75, seq=32, num_samples=70: the last epoch
+    holds floor(T/s)+1 samples (floor jitter). The reference's assert
+    (gpt_dataset.py:298) crashes on this geometry; ours must build and
+    index every advertised sample."""
+    lens = np.asarray([40, 35], np.int32)
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 1000, int(lens.sum())).astype(np.int32)
+    np.save(str(tmp_path / "c_ids.npy"), ids)
+    np.savez(str(tmp_path / "c_idx.npz"), lens=lens)
+    ds = GPTDataset(str(tmp_path), [1, 0, 0], max_seq_len=32,
+                    num_samples=70, mode="Train", build_data_file=True)
+    assert len(ds) >= 70
+    for i in (0, 69, len(ds) - 1):
+        tokens, pos, labels, mask = ds[i]
+        assert tokens.shape == (32,)
+
+
 def test_dataset_index_cache_reused(tmp_path):
     make_corpus(tmp_path)
     ds1 = GPTDataset(str(tmp_path), [1, 0, 0], 16, 10, "Train",

@@ -137,6 +137,60 @@ def test_checkpoint_save_load_resume(tmp_path):
         params_before, engine2.state["params"])
 
 
+def test_sigterm_preemption_saves_and_stops(tmp_path):
+    """TPU preemption semantics: SIGTERM mid-run checkpoints at the
+    next step boundary and fit returns cleanly (no periodic-save tail
+    lost), with the previous handler restored afterwards."""
+    import os
+    import signal as _signal
+
+    cfg, engine, loader = _build(tmp_path, **{"Engine.max_steps": 50})
+
+    def kicking(loader, after):
+        for i, b in enumerate(loader):
+            yield b
+            if i == after - 1:
+                os.kill(os.getpid(), _signal.SIGTERM)
+
+    prev = _signal.getsignal(_signal.SIGTERM)
+    engine.fit(epoch=1, train_data_loader=kicking(loader, 2))
+    assert _signal.getsignal(_signal.SIGTERM) is prev
+
+    step = int(engine.state["step"])
+    assert 2 <= step < 50, step
+    from paddlefleetx_tpu.core import checkpoint as ckpt
+    path = ckpt.latest_checkpoint(str(tmp_path / "out"))
+    assert path is not None and path.endswith(f"step_{step}")
+
+    # and a restarted engine resumes from the preemption point
+    cfg2, engine2, _ = _build(
+        tmp_path, **{"Engine.max_steps": 50,
+                     "Engine.save_load.ckpt_dir": str(tmp_path / "out")})
+    assert int(engine2.state["step"]) == step
+
+
+def test_preemption_handler_opt_out(tmp_path):
+    """save_on_preemption: False leaves SIGTERM handling alone."""
+    import signal as _signal
+
+    cfg, engine, loader = _build(
+        tmp_path, **{"Engine.max_steps": 2,
+                     "Engine.save_load.save_on_preemption": False})
+    seen = []
+
+    def mine(*a):
+        seen.append(a)
+
+    prev = _signal.signal(_signal.SIGTERM, mine)
+    try:
+        engine.fit(epoch=1, train_data_loader=loader)
+        # identity: OUR handler stayed installed the whole time (an
+        # engine lambda would also be callable — compare the object)
+        assert _signal.getsignal(_signal.SIGTERM) is mine
+    finally:
+        _signal.signal(_signal.SIGTERM, prev)
+
+
 def test_async_checkpoint_save_then_resume(tmp_path):
     """Engine.save_load.async_save overlaps the TensorStore write with
     training; a fresh engine must restore the identical state (the

@@ -62,6 +62,51 @@ def test_batch_spec_covers_dataflow_axis():
     assert batch_spec(1) == P(("dp", "fsdp"), None)
 
 
+def test_dcn_factorization_prefers_dp_then_pp():
+    from paddlefleetx_tpu.parallel.mesh import dcn_factorization
+    # shape order: (pp, dp, cp, fsdp, mp)
+    assert dcn_factorization(2, (1, 4, 1, 1, 2)) == (1, 2, 1, 1, 1)
+    assert dcn_factorization(4, (2, 2, 1, 1, 2)) == (2, 2, 1, 1, 1)
+    # dp exhausted -> spills to pp, then fsdp; partial factors via gcd
+    assert dcn_factorization(8, (2, 2, 1, 2, 1)) == (2, 2, 1, 2, 1)
+    assert dcn_factorization(6, (2, 3, 1, 1, 4)) == (2, 3, 1, 1, 1)
+
+
+def test_dcn_factorization_never_splits_mp():
+    from paddlefleetx_tpu.parallel.mesh import dcn_factorization
+    with pytest.raises(ValueError, match="mp/cp collectives onto"):
+        dcn_factorization(4, (1, 2, 1, 1, 8))  # only dp2 available
+
+
+def test_multislice_mesh_keeps_mp_inside_a_slice():
+    """Two fake 4-device slices, dp2 x mp4: every mp row must live
+    entirely inside one slice (mp collectives ride ICI), and the dp
+    axis is what crosses the slice boundary (DCN)."""
+    devs = jax.devices()
+    mesh = build_mesh(topo(dp_degree=2, mp_degree=4), devices=devs,
+                      slice_id_fn=lambda d: d.id // 4)
+    arr = mesh.devices  # shape (pp1, dp2, cp1, fsdp1, mp4)
+    for dp in range(2):
+        row_slices = {d.id // 4 for d in arr[0, dp, 0, 0, :]}
+        assert len(row_slices) == 1, (
+            f"mp row {dp} spans slices {row_slices}")
+    # the two dp coordinates sit on different slices
+    assert {d.id // 4 for d in arr[0, :, 0, 0, 0]} == {0, 1}
+    # and the composed mesh still computes: dp-sharded psum-style sum
+    from jax.sharding import NamedSharding
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    xs = jax.device_put(x, NamedSharding(mesh, P(("dp",), "mp")))
+    np.testing.assert_allclose(
+        np.asarray(jax.jit(lambda a: a.sum())(xs)), x.sum())
+
+
+def test_multislice_mesh_uneven_slices_rejected():
+    devs = jax.devices()
+    with pytest.raises(ValueError, match="uneven"):
+        build_mesh(topo(dp_degree=2, mp_degree=4), devices=devs,
+                   slice_id_fn=lambda d: 0 if d.id < 3 else 1)
+
+
 def test_sharded_matmul_matches_single_device():
     """TP einsum under the mesh == single-device reference."""
     mesh = build_mesh(topo(mp_degree=4, dp_degree=2))

@@ -8,6 +8,24 @@ import time
 import pytest
 
 
+def test_compilation_cache_knob(tmp_path):
+    """Global.compilation_cache_dir points jax's persistent cache at
+    shared storage (restart-after-preemption skips recompiles)."""
+    import jax
+    from paddlefleetx_tpu.utils.env import setup_compilation_cache
+
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        target = str(tmp_path / "xla-cache")
+        setup_compilation_cache(target)
+        assert jax.config.jax_compilation_cache_dir == target
+        assert jax.config.jax_persistent_cache_min_entry_size_bytes == 0
+        setup_compilation_cache(None)   # absent knob: no-op
+        assert jax.config.jax_compilation_cache_dir == target
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
 def test_cached_path(tmp_path, monkeypatch):
     from paddlefleetx_tpu.utils import download
     f = tmp_path / "x.bin"
