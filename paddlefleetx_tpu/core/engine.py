@@ -461,12 +461,12 @@ class Engine(BasicEngine):
             t0 = time.time()
             self._train_one_epoch(ep, train_data_loader,
                                   valid_data_loader)
-            self.module.training_epoch_end(
-                {"epoch": ep, "train_cost": time.time() - t0})
             if self._preempt_signum is not None:
                 # the signal may also have landed after the epoch's
-                # last per-batch check (loader exhaustion, epoch-end
-                # hooks) — save here, the single preemption exit path
+                # last per-batch check (loader exhaustion) — save
+                # here, the single preemption exit path. Before the
+                # epoch-end hook: the epoch did NOT complete, and a
+                # slow hook would eat the preemption grace window
                 step = int(self.state["step"])
                 logger.warning(
                     "signal %d (preemption) received: saving "
@@ -475,6 +475,8 @@ class Engine(BasicEngine):
                 self.save(ep)
                 ckpt.wait_for_pending_save()
                 break
+            self.module.training_epoch_end(
+                {"epoch": ep, "train_cost": time.time() - t0})
             if self.run_mode == "epoch" and \
                     (ep + 1) % self.eval_freq == 0 and \
                     valid_data_loader is not None:

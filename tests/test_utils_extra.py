@@ -14,7 +14,11 @@ def test_compilation_cache_knob(tmp_path):
     import jax
     from paddlefleetx_tpu.utils.env import setup_compilation_cache
 
-    prev = jax.config.jax_compilation_cache_dir
+    prev = {
+        k: getattr(jax.config, k) for k in (
+            "jax_compilation_cache_dir",
+            "jax_persistent_cache_min_compile_time_secs",
+            "jax_persistent_cache_min_entry_size_bytes")}
     try:
         target = str(tmp_path / "xla-cache")
         setup_compilation_cache(target)
@@ -23,7 +27,8 @@ def test_compilation_cache_knob(tmp_path):
         setup_compilation_cache(None)   # absent knob: no-op
         assert jax.config.jax_compilation_cache_dir == target
     finally:
-        jax.config.update("jax_compilation_cache_dir", prev)
+        for k, v in prev.items():
+            jax.config.update(k, v)
 
 
 def test_cached_path(tmp_path, monkeypatch):
