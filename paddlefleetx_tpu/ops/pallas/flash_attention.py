@@ -320,6 +320,133 @@ def _bwd_combined_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_ref[0] = (dq_scr[:] * sm_scale).astype(dq_ref.dtype)
 
 
+#: fused multi-q-block backward: bytes of VMEM the resident tensors
+#: (q, do at input dtype; dq f32; lse, delta f32) may claim. 6 MB
+#: leaves ~10 MB of the ~16 MB/core for the streamed k/v blocks and
+#: the [bq, bkv] score/exp temporaries. At bf16/d=64 this admits
+#: sq <= 11776, covering the s=8192 long-context bench point; at
+#: bf16/d=128, sq <= 5632, covering the 6.7B s=2048 geometry.
+FUSED_BWD_RESIDENT_BUDGET = 6 * 1024 * 1024
+#: internal block sizes of the fused backward's qi loop / ki grid —
+#: inside one kernel there are no per-block launch overheads, so
+#: small blocks only shrink the [bq, bkv] score temporaries that
+#: compete with the resident tensors for VMEM
+FUSED_BWD_BLOCK_Q = 512
+FUSED_BWD_BLOCK_KV = 512
+
+
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, *, sm_scale, causal,
+                      block_q, block_kv, num_q, query_offset):
+    """One-pass backward for the multi-q-block regime with q RESIDENT:
+    grid (bh, ki); q/do/lse/delta/dq map to the same block for every
+    ki, so they are fetched once per bh and stay in VMEM, dq (fp32)
+    accumulating in place; k/v stream per ki; an inner fori_loop over
+    qi computes each score block exactly once and emits its dk/dv and
+    dq contributions together. The split kernel pair computes every
+    score block twice — this path removes that recomputation for
+    1024 < sq <= the VMEM budget (``FUSED_BWD_RESIDENT_BUDGET``),
+    which is exactly the long-context operating point."""
+    ki = pl.program_id(1)
+    k, v = k_ref[0], v_ref[0]
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
+
+    def _compute(qi, dk_acc, dv_acc, masked):
+        sl = pl.ds(qi * block_q, block_q)
+        q = q_ref[0, sl, :]
+        do = do_ref[0, sl, :]
+        lse = lse_ref[0, sl, :]
+        delta = delta_ref[0, sl, :]
+        q_s = (q.astype(jnp.float32) * sm_scale).astype(q.dtype)
+        s = _dot(q_s, k, trans_b=True)
+        if masked:
+            s = jnp.where(
+                _causal_mask(qi, ki, block_q, block_kv, query_offset),
+                s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = _dot(do, v, trans_b=True)
+        ds = p * (dp - delta)
+        return (dk_acc + _dot(ds.astype(q_s.dtype), q_s, trans_a=True),
+                dv_acc + _dot(p.astype(do.dtype), do, trans_a=True),
+                _dot(ds.astype(k.dtype), k))
+
+    def _body(qi, carry):
+        dk_acc, dv_acc = carry
+        if causal:
+            live, interior = _live_interior(qi, ki, block_q, block_kv,
+                                            causal, query_offset)
+            dk_acc, dv_acc, dq_blk = jax.lax.cond(
+                interior,
+                lambda: _compute(qi, dk_acc, dv_acc, False),
+                # diagonal-crossing: masked math; dead (possible only
+                # off the fori_loop start estimate): the mask zeroes p
+                # and ds, so contributions are exactly zero anyway
+                lambda: _compute(qi, dk_acc, dv_acc, True))
+        else:
+            dk_acc, dv_acc, dq_blk = _compute(qi, dk_acc, dv_acc, False)
+        cur = dq_ref[0, pl.ds(qi * block_q, block_q), :]
+        dq_ref[0, pl.ds(qi * block_q, block_q), :] = cur + dq_blk
+        return dk_acc, dv_acc
+
+    zeros = jnp.zeros((k.shape[0], k.shape[1]), jnp.float32)
+    # first possibly-live qi block: its end must reach the kv block
+    qi_start = ((ki * block_kv - query_offset) // block_q) if causal \
+        else 0
+    qi_start = jnp.maximum(qi_start, 0) if causal else 0
+    dk_acc, dv_acc = jax.lax.fori_loop(qi_start, num_q, _body,
+                                       (zeros, zeros))
+    dk_ref[0] = dk_acc.astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+
+
+def _flash_backward_fused(q, k, v, g, lse, delta, sm_scale, causal,
+                          query_offset):
+    """Dispatch wrapper for ``_bwd_fused_kernel``; returns None when
+    the shape doesn't fit the resident-VMEM budget (caller falls back
+    to the split kernel pair)."""
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    bq, bkv = FUSED_BWD_BLOCK_Q, FUSED_BWD_BLOCK_KV
+    if sq % bq or skv % bkv:
+        return None
+    # q and do (the out-cotangent) are resident at the input dtype,
+    # dq at fp32, lse+delta at fp32 — fp32 inputs must not sneak past
+    # a bf16-sized estimate into a Mosaic allocation failure
+    itemsize = jnp.dtype(q.dtype).itemsize
+    if sq * (d * (2 * itemsize + 4) + 8) > FUSED_BWD_RESIDENT_BUDGET:
+        return None
+    # the resident tensors' block index never changes within one bh —
+    # single-buffer them so the pipeline does not allocate a useless
+    # second copy of the largest VMEM tenants
+    single = pl.Buffered(buffer_count=1)
+    res_spec = pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0),
+                            pipeline_mode=single)
+    row_spec = pl.BlockSpec((1, sq, 1), lambda b, i: (b, 0, 0),
+                            pipeline_mode=single)
+    kv_spec = pl.BlockSpec((1, bkv, d), lambda b, i: (b, i, 0))
+    dq32, dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_fused_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=bq, block_kv=bkv, num_q=sq // bq,
+            query_offset=query_offset),
+        grid=(bh, skv // bkv),
+        in_specs=[res_spec, kv_spec, kv_spec, res_spec, row_spec,
+                  row_spec],
+        out_specs=[res_spec, kv_spec, kv_spec],
+        out_shape=[jax.ShapeDtypeStruct((bh, sq, d), jnp.float32,
+                                        vma=_vma(q)),
+                   jax.ShapeDtypeStruct((bh, skv, d), k.dtype,
+                                        vma=_vma(q)),
+                   jax.ShapeDtypeStruct((bh, skv, d), v.dtype,
+                                        vma=_vma(q))],
+        interpret=_interpret(),
+    )(q, k, v, g, lse, delta)
+    return (dq32 * sm_scale).astype(q.dtype), dk, dv
+
+
 def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
                     block_kv, g_lse=None):
     q, k, v, out, lse = res
@@ -358,6 +485,11 @@ def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
             interpret=_interpret(),
         )(q, k, v, g, lse, delta)
         return dq, dk, dv
+
+    fused = _flash_backward_fused(q, k, v, g, lse, delta, sm_scale,
+                                  causal, query_offset)
+    if fused is not None:
+        return fused
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
     r_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
