@@ -26,6 +26,31 @@ def model_and_params():
     return model, variables["params"]
 
 
+def test_unrolled_twin_param_layout(model_and_params):
+    """The decode path unrolls the layer scan: the twin's params must
+    expand the stacked ``decoder`` subtree into per-layer copies whose
+    leaves are the stack's slices, leaving everything else intact."""
+    from paddlefleetx_tpu.models.gpt.generation import _unrolled_twin
+    model, params = model_and_params
+    twin, tp = _unrolled_twin(model, params)
+    assert twin.config.scan_layers is False
+    gpt = tp["gpt"]
+    assert "decoder" not in gpt
+    assert {f"decoder_{i}" for i in range(CFG.num_layers)} <= set(gpt)
+    stacked = params["gpt"]["decoder"]
+    for i in range(CFG.num_layers):
+        jax.tree.map(
+            lambda full, sliced: np.testing.assert_array_equal(
+                np.asarray(full[i]), np.asarray(sliced)),
+            dict(stacked), gpt[f"decoder_{i}"])
+    # twin logits == scanned logits (prefill path, both models)
+    ids = jnp.arange(8, dtype=jnp.int32)[None, :]
+    a = model.apply({"params": params}, ids)
+    b = twin.apply({"params": tp}, ids)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-6, atol=1e-6)
+
+
 def test_greedy_matches_argmax_unrolled(model_and_params):
     """Cached greedy decode == repeatedly re-running the full forward."""
     model, params = model_and_params
