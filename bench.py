@@ -281,10 +281,10 @@ def bench_train():
 
 def bench_moe():
     """Tokens/s + active-FLOPs MFU of an 8-expert top-2 MoE at the
-    345M width (h=1024; 12 layers — the full 24-layer 8-expert stack
-    is ~1.8B params, whose fp32 master + Adam moments alone exceed a
-    16G chip). Single-chip = ep 1; the dispatch/combine einsums and
-    router still run, so the number prices MoE's routing overhead
+    345M width (h=1024; 8 layers — an ~620M-param stack whose fp32
+    master + Adam moments + activations fill a 16G chip; 12 layers
+    measured 18.8G). Single-chip = ep 1; the dispatch/combine einsums
+    and router still run, so the number prices MoE's routing overhead
     against ``bench_train``'s dense MFU."""
     on_tpu = jax.devices()[0].platform == "tpu"
     batch, seq, acc = (4, 1024, 8) if on_tpu else (2, 128, 1)
@@ -292,7 +292,7 @@ def bench_moe():
         on_tpu, use_recompute=on_tpu,
         recompute_granularity="save_dots" if on_tpu else "full",
         loss_chunks=8 if on_tpu else 1,
-        num_layers=12,
+        num_layers=8,
         moe_num_experts=8, moe_top_k=2, moe_capacity_factor=1.25,
         moe_z_loss_weight=1e-3)
     tokens_per_sec = _measure_train(cfg, batch, seq, acc,

@@ -65,6 +65,63 @@ def test_failing_child_propagates_and_terminates_peers(tmp_path):
     assert rc == 3
 
 
+CHILD_DP_TRAIN = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, os.environ["PFX_TEST_REPO"])
+    repo = os.environ["PFX_TEST_REPO"]
+    data = os.environ["PFX_DATA_DIR"]
+    sys.argv = [
+        "train.py", "-c",
+        os.path.join(repo,
+                     "configs/nlp/gpt/pretrain_gpt_345M_single_card.yaml"),
+        "-o", "Model.vocab_size=128", "-o", "Model.hidden_size=32",
+        "-o", "Model.num_layers=2", "-o", "Model.num_attention_heads=4",
+        "-o", "Model.ffn_hidden_size=64",
+        "-o", "Model.max_position_embeddings=64",
+        "-o", "Model.use_recompute=False", "-o", "Model.loss_chunks=1",
+        "-o", "Model.use_flash_attention=False",
+        "-o", "Global.local_batch_size=2",
+        "-o", "Global.micro_batch_size=2",
+        "-o", "Distributed.dp_degree=2",
+        "-o", "Engine.max_steps=4", "-o", "Engine.logging_freq=2",
+        "-o", "Engine.eval_freq=1000",
+        "-o", "Engine.save_load.save_steps=1000",
+        "-o", "Engine.save_load.output_dir=" + data + "/out",
+        "-o", "Data.Train.dataset.input_dir=" + data,
+        "-o", "Data.Train.dataset.max_seq_len=32",
+        "-o", "Data.Eval.dataset.input_dir=" + data,
+        "-o", "Data.Eval.dataset.max_seq_len=32",
+    ]
+    from paddlefleetx_tpu.cli import train_main
+    train_main()
+    print("rank", os.environ.get("PFX_PROCESS_ID", "0"), "trained ok")
+""")
+
+
+def test_two_process_dp_training_end_to_end(tmp_path):
+    """The real multi-host story in one test: pfx-launch TWO OS
+    processes (one CPU device each) running ``tools/train.py``'s
+    ``train_main`` with ``dp_degree=2`` — ``jax.distributed``
+    rendezvous, per-process dataflow-shard loaders
+    (``process_data_rank``), global batch assembly via
+    ``make_array_from_process_local_data``, and XLA's cross-process
+    gradient all-reduce, to four completed optimizer steps."""
+    from test_data import make_corpus
+    make_corpus(tmp_path, n_docs=40, doc_len_range=(20, 60), vocab=128,
+                eos=127)
+    script = tmp_path / "child.py"
+    script.write_text(CHILD_DP_TRAIN)
+    os.environ["PFX_TEST_REPO"] = REPO
+    os.environ["PFX_DATA_DIR"] = str(tmp_path)
+    try:
+        rc = launch([sys.executable, str(script)], nprocs=2,
+                    cpu_devices_per_proc=1)
+    finally:
+        os.environ.pop("PFX_TEST_REPO", None)
+        os.environ.pop("PFX_DATA_DIR", None)
+    assert rc == 0
+
+
 CHILD_DP_INFERENCE = textwrap.dedent("""
     import os, sys
     sys.path.insert(0, os.environ["PFX_TEST_REPO"])
