@@ -172,19 +172,30 @@ def construct_samples_and_shuffle_data(name: str, data_prefix: str,
             separate_last_epoch = (
                 last_epoch_samples < int(0.80 * samples_per_epoch))
         t0 = time.time()
+
+        def save_atomic(fn: str, arr: np.ndarray) -> None:
+            # other processes poll os.path.isfile and then mmap-load:
+            # a plain np.save would let a waiter see the file mid-write
+            # and read a truncated header; write-then-rename makes the
+            # appearance of the final name atomic (same-directory
+            # rename, POSIX)
+            tmp = fn + ".tmp.npy"
+            np.save(tmp, arr)
+            os.replace(tmp, fn)
+
         doc_idx = _build_doc_idx(documents, num_epochs, np_rng,
                                  separate_last_epoch)
-        np.save(fn_doc, doc_idx)
+        save_atomic(fn_doc, doc_idx)
         sample_idx = _build_sample_idx(sizes, doc_idx, seq_length,
                                        num_epochs, tokens_per_epoch)
-        np.save(fn_sample, sample_idx)
+        save_atomic(fn_sample, sample_idx)
         if separate_last_epoch:
             shuffle_n = samples_before_last
         else:
             shuffle_n = sample_idx.shape[0] - 1
         shuffle_idx = _build_shuffle_idx(shuffle_n,
                                          sample_idx.shape[0] - 1, np_rng)
-        np.save(fn_shuffle, shuffle_idx)
+        save_atomic(fn_shuffle, shuffle_idx)
         logger.info("built index mappings for %s in %.2fs (%d samples)",
                     name, time.time() - t0, sample_idx.shape[0] - 1)
     elif not build_data_file:

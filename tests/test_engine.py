@@ -169,6 +169,39 @@ def test_sigterm_preemption_saves_and_stops(tmp_path):
     assert int(engine2.state["step"]) == step
 
 
+def test_sigterm_during_eval_breaks_out_and_saves(tmp_path):
+    """A SIGTERM landing mid-eval must not wait for the whole eval
+    pass (preemption grace windows are short): the eval loop breaks,
+    and the preemption checkpoint is still written."""
+    import os
+    import signal as _signal
+
+    cfg, engine, loader = _build(
+        tmp_path, **{"Engine.max_steps": 4,
+                     "Engine.run_mode": "step",
+                     "Engine.eval_freq": 2,
+                     "Engine.eval_iters": 100})
+    eval_batches = []
+
+    def eval_loader():
+        for i, b in enumerate(loader):
+            if i == 1:   # signal arrives while eval is running
+                os.kill(os.getpid(), _signal.SIGTERM)
+            eval_batches.append(i)
+            yield b
+
+    prev = _signal.getsignal(_signal.SIGTERM)
+    engine.fit(epoch=1, train_data_loader=loader,
+               valid_data_loader=eval_loader())
+    assert _signal.getsignal(_signal.SIGTERM) is prev
+    # eval stopped long before its 100-iteration budget
+    assert len(eval_batches) <= 3, eval_batches
+    from paddlefleetx_tpu.core import checkpoint as ckpt
+    step = int(engine.state["step"])
+    path = ckpt.latest_checkpoint(str(tmp_path / "out"))
+    assert path is not None and path.endswith(f"step_{step}")
+
+
 def test_preemption_handler_opt_out(tmp_path):
     """save_on_preemption: False leaves SIGTERM handling alone."""
     import signal as _signal
