@@ -100,14 +100,162 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
 
 def load_checkpoint(path: str, abstract_state):
     """Restore (state, meta); ``abstract_state`` carries target
-    shardings so arrays land directly on the current mesh."""
+    shardings so arrays land directly on the current mesh.
+
+    Layer-layout portability: ``Model.scan_layers`` changes the param
+    pytree — scanned models stack the decoder under one ``decoder``
+    subtree, unrolled models carry ``decoder_0..N`` — and the
+    optimizer moments mirror whichever layout trained. A checkpoint
+    written under one layout restores into a model built with the
+    other: on a structure mismatch the restore is retried against the
+    layout-toggled template and the result converted
+    (stack <-> unstack) to the live model's layout, keeping
+    ``scan_layers`` a pure performance knob rather than a checkpoint
+    format fork.
+    """
     wait_for_pending_save()   # same-process restore-after-async-save
     path = os.path.abspath(path)
     with _checkpointer() as ckptr:
-        restored = ckptr.restore(
-            path,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract_state),
-                meta=ocp.args.JsonRestore()))
+        try:
+            restored = ckptr.restore(
+                path,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(abstract_state),
+                    meta=ocp.args.JsonRestore()))
+            state = restored.state
+        except Exception as primary_err:
+            toggled = _toggle_layer_stack_template(abstract_state)
+            if toggled is None:
+                raise
+            alt_abstract, convert = toggled
+            try:
+                restored = ckptr.restore(
+                    path,
+                    args=ocp.args.Composite(
+                        state=ocp.args.StandardRestore(alt_abstract),
+                        meta=ocp.args.JsonRestore()))
+            except Exception:
+                raise primary_err   # alt failed too: original error
+            logger.info(
+                "checkpoint layer layout differs from the model's "
+                "(scan_layers toggled between save and load); "
+                "converting")
+            state = convert(restored.state)
     logger.info("restored checkpoint from %s", path)
-    return restored.state, restored.meta
+    return state, restored.meta
+
+
+# -- scan_layers layout adapter ----------------------------------------
+
+
+def _is_mapping(x) -> bool:
+    return isinstance(x, dict)
+
+
+_LAYER_KEY = re.compile(r"^decoder_(\d+)$")
+
+
+def _toggle_layer_stack_template(abstract):
+    """(alt_abstract, convert_fn) for the opposite ``scan_layers``
+    layout of every ``decoder``/``decoder_N`` subtree in
+    ``abstract`` (params and the optimizer-moment trees that mirror
+    them), or None when no such subtree exists. ``alt_abstract``
+    drops shardings (plain ShapeDtypeStruct — the conversion
+    re-places leaves onto the model's shardings with ``device_put``);
+    ``convert_fn`` maps a tree restored under ``alt_abstract`` back
+    to the layout (and shardings) of ``abstract``. The alt restore is
+    unsharded (re-placed leaf-by-leaf afterwards) — fine for the
+    model sizes where layouts ever toggle: pipeline topologies
+    require the scanned layout on both sides."""
+    toggled = [False]
+
+    def walk_template(node):
+        if _is_mapping(node):
+            layer_keys = sorted(
+                (k for k in node if _LAYER_KEY.match(k)),
+                key=lambda k: int(_LAYER_KEY.match(k).group(1)))
+            out = {}
+            if "decoder" in node and _is_mapping(node["decoder"]):
+                # stacked -> unrolled template: leaf[i] per layer
+                sub = node["decoder"]
+                lengths = {x.shape[0] for x in jax.tree.leaves(sub)}
+                if len(lengths) == 1:
+                    # only a uniform stack counts as a layout toggle —
+                    # flagging anything else would let an unrelated
+                    # restore failure retry through a layout-identical
+                    # (but unsharded) template and mask the real error
+                    toggled[0] = True
+                    (num_layers,) = lengths
+                    for i in range(num_layers):
+                        out[f"decoder_{i}"] = jax.tree.map(
+                            lambda x: jax.ShapeDtypeStruct(
+                                x.shape[1:], x.dtype), sub)
+                else:   # not a uniform stack; leave untouched
+                    out["decoder"] = walk_template(sub)
+            elif layer_keys:
+                # unrolled -> stacked template: leading layer axis
+                toggled[0] = True
+                first = node[layer_keys[0]]
+                out["decoder"] = jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(
+                        (len(layer_keys),) + tuple(x.shape), x.dtype),
+                    first)
+            for k, v in node.items():
+                if k == "decoder" and "decoder" not in out:
+                    continue
+                if _LAYER_KEY.match(k) and layer_keys:
+                    continue
+                if k not in out:
+                    out[k] = walk_template(v)
+            return out
+        if isinstance(node, (list, tuple)):
+            mapped = [walk_template(v) for v in node]
+            if hasattr(node, "_fields"):       # NamedTuple (optax)
+                return type(node)(*mapped)
+            return type(node)(mapped)
+        return jax.ShapeDtypeStruct(node.shape, node.dtype) \
+            if hasattr(node, "shape") else node
+
+    def convert(alt, template):
+        """Restored-alt tree -> the layout+shardings of template."""
+        if _is_mapping(template):
+            out = {}
+            for k, v in template.items():
+                if k == "decoder" and _is_mapping(v) and \
+                        any(_LAYER_KEY.match(a) for a in alt):
+                    layer_keys = sorted(
+                        (a for a in alt if _LAYER_KEY.match(a)),
+                        key=lambda a: int(_LAYER_KEY.match(a).group(1)))
+                    import jax.numpy as jnp
+                    stacked = jax.tree.map(
+                        lambda *xs: jnp.stack(xs),
+                        *[alt[a] for a in layer_keys])
+                    out[k] = _replace_leaves(stacked, v)
+                elif _LAYER_KEY.match(k) and "decoder" in alt:
+                    i = int(_LAYER_KEY.match(k).group(1))
+                    sliced = jax.tree.map(lambda x: x[i],
+                                          alt["decoder"])
+                    out[k] = _replace_leaves(sliced, v)
+                else:
+                    out[k] = convert(alt[k], v)
+            return out
+        if isinstance(template, (list, tuple)):
+            mapped = [convert(a, t) for a, t in zip(alt, template)]
+            if hasattr(template, "_fields"):
+                return type(template)(*mapped)
+            return type(template)(mapped)
+        return _place(alt, template)
+
+    def _place(value, abstract_leaf):
+        sharding = getattr(abstract_leaf, "sharding", None)
+        if sharding is not None:
+            return jax.device_put(value, sharding)
+        return value
+
+    def _replace_leaves(value_tree, abstract_tree):
+        return jax.tree.map(_place, value_tree, abstract_tree)
+
+    alt = walk_template(abstract)
+    if not toggled[0]:
+        return None
+    return alt, (lambda restored: convert(restored, abstract))

@@ -26,9 +26,15 @@ def process_model_configs(config) -> None:
                 f"num_layers {model['num_layers']} must be divisible by "
                 f"pp_degree {pp}")
         if model.get("scan_layers") is False:
-            raise ValueError(
-                "pipeline parallelism requires scan_layers (stacked "
-                "decoder params sharded over the pp axis)")
+            # same policy as loss_chunks below: the single-chip recipe
+            # sets scan_layers False for throughput, and a -o
+            # pp_degree override on top of it must not be fatal —
+            # pipeline stages need the stacked decoder params, so the
+            # knob flips back with a log line
+            from ..utils.log import logger
+            logger.info("pp_degree > 1 needs scan-stacked decoder "
+                        "params; overriding scan_layers False -> True")
+            model["scan_layers"] = True
         if (model.get("loss_chunks") or 1) > 1:
             # the pipeline computes the loss per microbatch, which IS
             # the logits-memory property loss_chunks exists for — the

@@ -137,6 +137,52 @@ def test_checkpoint_save_load_resume(tmp_path):
         params_before, engine2.state["params"])
 
 
+@pytest.mark.parametrize("train_scan,restore_scan", [(True, False),
+                                                     (False, True)])
+def test_checkpoint_restores_across_scan_layers_toggle(
+        tmp_path, train_scan, restore_scan):
+    """scan_layers is a performance knob, not a checkpoint format: a
+    checkpoint trained with the nn.scan-stacked decoder restores into
+    an unrolled model and vice versa — params AND optimizer moments
+    converted between the stacked and per-layer layouts."""
+    cfg, engine, loader = _build(
+        tmp_path, **{"Engine.max_steps": 2,
+                     "Model.scan_layers": train_scan})
+    engine.fit(epoch=1, train_data_loader=loader)
+    engine.save(epoch=1)
+    step = int(engine.state["step"])
+    params_trained = jax.tree.map(np.asarray, engine.state["params"])
+
+    cfg2, engine2, _ = _build(
+        tmp_path, **{"Engine.max_steps": 2,
+                     "Model.scan_layers": restore_scan,
+                     "Engine.save_load.ckpt_dir": str(tmp_path / "out")})
+    assert int(engine2.state["step"]) == step
+    gpt = engine2.state["params"]["gpt"]
+    if restore_scan:
+        assert "decoder" in gpt and "decoder_0" not in gpt
+        stacked = gpt["decoder"]
+        jax.tree.map(
+            lambda full, sliced: np.testing.assert_array_equal(
+                np.asarray(full[0]), np.asarray(sliced)),
+            dict(stacked),
+            dict(params_trained["gpt"]["decoder_0"]))
+    else:
+        assert "decoder_0" in gpt and "decoder" not in gpt
+        jax.tree.map(
+            lambda sliced, full: np.testing.assert_array_equal(
+                np.asarray(sliced), np.asarray(full[0])),
+            dict(gpt["decoder_0"]),
+            dict(params_trained["gpt"]["decoder"]))
+    # the converted state must step normally
+    import flax.linen as nn
+    batch = next(iter(loader))
+    with engine2.mesh, nn.logical_axis_rules(engine2.rules):
+        _, metrics = engine2._train_step(engine2.state,
+                                         engine2._put_batch(batch))
+    assert np.isfinite(float(metrics["loss"]))
+
+
 def test_sigterm_preemption_saves_and_stops(tmp_path):
     """TPU preemption semantics: SIGTERM mid-run checkpoints at the
     next step boundary and fit returns cleanly (no periodic-save tail
