@@ -29,6 +29,7 @@ the routing/dispatch overhead.
 import argparse
 import functools
 import json
+import os
 import sys
 import time
 
@@ -384,6 +385,19 @@ def main():
     p.add_argument("--mode", choices=["train", "generation", "moe"],
                    default="train")
     args = p.parse_args()
+    # the CLIs' hook: PFX_CPU_DEVICES forces the CPU platform through
+    # jax.config (site customization may pin another platform that
+    # ignores the JAX_PLATFORMS env var)
+    from paddlefleetx_tpu.cli import maybe_virtual_cpu_mesh
+    maybe_virtual_cpu_mesh()
+    # persistent compile cache: the unrolled 24-layer configs take
+    # minutes to compile cold; repeated bench runs (and the perf-CI
+    # driver) should pay that once per program, not per run
+    from paddlefleetx_tpu.utils.env import setup_compilation_cache
+    setup_compilation_cache(
+        os.environ.get("PFX_COMPILE_CACHE",
+                       os.path.join(os.path.dirname(
+                           os.path.abspath(__file__)), ".xla_cache")))
     if args.mode == "train":
         bench_train()
     elif args.mode == "moe":

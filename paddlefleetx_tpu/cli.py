@@ -12,7 +12,7 @@ from __future__ import annotations
 import os
 
 
-def _maybe_virtual_cpu_mesh() -> None:
+def maybe_virtual_cpu_mesh() -> None:
     """PFX_CPU_DEVICES=N: run any topology on an N-device virtual CPU
     mesh (podless correctness runs). Routed through jax.config — site
     customization may force another platform before env vars are read.
@@ -23,7 +23,7 @@ def _maybe_virtual_cpu_mesh() -> None:
 
 
 def train_main(argv=None):
-    _maybe_virtual_cpu_mesh()
+    maybe_virtual_cpu_mesh()
     from .core import Engine
     from .data import build_dataloader
     from .models import build_module
@@ -70,7 +70,7 @@ def auto_main(argv=None):
 
 
 def eval_main(argv=None):
-    _maybe_virtual_cpu_mesh()
+    maybe_virtual_cpu_mesh()
     from .core import Engine
     from .data import build_dataloader
     from .models import build_module
@@ -87,7 +87,7 @@ def eval_main(argv=None):
 
 
 def export_main(argv=None):
-    _maybe_virtual_cpu_mesh()
+    maybe_virtual_cpu_mesh()
     from .core import Engine
     from .models import build_module
     from .utils import env
@@ -117,7 +117,7 @@ def export_script(argv=None):
 
 
 def inference_main(argv=None):
-    _maybe_virtual_cpu_mesh()
+    maybe_virtual_cpu_mesh()
     import numpy as np
 
     from .core import Engine

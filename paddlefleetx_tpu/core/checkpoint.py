@@ -123,7 +123,10 @@ def load_checkpoint(path: str, abstract_state):
                     state=ocp.args.StandardRestore(abstract_state),
                     meta=ocp.args.JsonRestore()))
             state = restored.state
-        except Exception as primary_err:
+        except (ValueError, KeyError, TypeError) as primary_err:
+            # tree-structure mismatches surface as these; I/O or
+            # device failures must NOT trigger a full re-read of a
+            # possibly multi-GB checkpoint
             toggled = _toggle_layer_stack_template(abstract_state)
             if toggled is None:
                 raise
@@ -160,14 +163,22 @@ def _toggle_layer_stack_template(abstract):
     layout of every ``decoder``/``decoder_N`` subtree in
     ``abstract`` (params and the optimizer-moment trees that mirror
     them), or None when no such subtree exists. ``alt_abstract``
-    drops shardings (plain ShapeDtypeStruct — the conversion
-    re-places leaves onto the model's shardings with ``device_put``);
-    ``convert_fn`` maps a tree restored under ``alt_abstract`` back
-    to the layout (and shardings) of ``abstract``. The alt restore is
-    unsharded (re-placed leaf-by-leaf afterwards) — fine for the
-    model sizes where layouts ever toggle: pipeline topologies
-    require the scanned layout on both sides."""
+    carries an explicit single-device sharding on every leaf — left
+    unset, Orbax would fall back to the sharding RECORDED IN THE
+    CHECKPOINT, which it warns is unsafe when the restoring topology
+    differs from the saving one (the exact cross-topology case this
+    module guarantees). The conversion then re-places every leaf
+    onto the model's own shardings with ``device_put``. Fully
+    materializing each leaf on one device is fine for the model
+    sizes where layouts ever toggle: pipeline topologies require the
+    scanned layout on both sides."""
     toggled = [False]
+    from jax.sharding import SingleDeviceSharding
+    host_sharding = SingleDeviceSharding(jax.local_devices()[0])
+
+    def _leaf(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype,
+                                    sharding=host_sharding)
 
     def walk_template(node):
         if _is_mapping(node):
@@ -188,8 +199,8 @@ def _toggle_layer_stack_template(abstract):
                     (num_layers,) = lengths
                     for i in range(num_layers):
                         out[f"decoder_{i}"] = jax.tree.map(
-                            lambda x: jax.ShapeDtypeStruct(
-                                x.shape[1:], x.dtype), sub)
+                            lambda x: _leaf(x.shape[1:], x.dtype),
+                            sub)
                 else:   # not a uniform stack; leave untouched
                     out["decoder"] = walk_template(sub)
             elif layer_keys:
@@ -197,7 +208,7 @@ def _toggle_layer_stack_template(abstract):
                 toggled[0] = True
                 first = node[layer_keys[0]]
                 out["decoder"] = jax.tree.map(
-                    lambda x: jax.ShapeDtypeStruct(
+                    lambda x: _leaf(
                         (len(layer_keys),) + tuple(x.shape), x.dtype),
                     first)
             for k, v in node.items():
@@ -213,7 +224,7 @@ def _toggle_layer_stack_template(abstract):
             if hasattr(node, "_fields"):       # NamedTuple (optax)
                 return type(node)(*mapped)
             return type(node)(mapped)
-        return jax.ShapeDtypeStruct(node.shape, node.dtype) \
+        return _leaf(node.shape, node.dtype) \
             if hasattr(node, "shape") else node
 
     def convert(alt, template):

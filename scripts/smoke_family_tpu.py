@@ -1,12 +1,13 @@
-"""Real-chip step-time smoke for the ViT and Imagen families.
+"""Real-chip step-time smoke for the ViT, Imagen, and ERNIE families.
 
-Ad hoc: python scripts/smoke_family_tpu.py [vit|imagen] — measures a
-bf16 train step (fwd+bwd+adamw) at a production-shaped operating point
-on the attached chip. Numbers are recorded in projects/{vit,imagen}/
-README.md.
+Ad hoc: python scripts/smoke_family_tpu.py [vit|imagen|ernie] —
+measures a bf16 train step (fwd+bwd+adamw) at a production-shaped
+operating point on the attached chip. Numbers are recorded in
+projects/{vit,imagen}/README.md and projects/ernie/README.md.
 """
 
 import functools
+import os
 import sys
 import time
 
@@ -102,10 +103,60 @@ def smoke_imagen(batch=16):
           f"bs={batch}: {dt * 1e3:.1f} ms = {batch / dt:.0f} images/s")
 
 
+def smoke_ernie(batch=32, seq=512):
+    """ERNIE-345M-class encoder MLM train step (the reference's
+    ``pretrain_ernie_345M_single_card.yaml`` geometry: h=1024, 24
+    layers, s=512)."""
+    from paddlefleetx_tpu.models.ernie.config import ErnieConfig
+    from paddlefleetx_tpu.models.ernie.model import (
+        ErnieForPretraining, ernie_pretraining_loss,
+    )
+    from paddlefleetx_tpu.models.ernie.modules import apply_mlm_masking
+
+    cfg = ErnieConfig(
+        vocab_size=50304, hidden_size=1024, num_hidden_layers=24,
+        num_attention_heads=16, max_position_embeddings=seq,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        dtype="bfloat16", use_flash_attention=True, scan_layers=False)
+    model = ErnieForPretraining(cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(1, cfg.vocab_size, (batch, seq)),
+                         jnp.int32)
+    params = jax.jit(model.init)(
+        {"params": jax.random.key(0)}, tokens[:1])["params"]
+    tx = optax.adamw(1e-4, mu_dtype=jnp.bfloat16)
+    opt = tx.init(params)
+
+    def loss_fn(p, masked, labels):
+        scores, _ = model.apply({"params": p}, masked,
+                                deterministic=True)
+        return ernie_pretraining_loss(scores, labels,
+                                      with_nsp_loss=False)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state, tokens):
+        p, o, key = state
+        key, sub = jax.random.split(key)
+        masked, labels = apply_mlm_masking(sub, tokens, cfg)
+        loss, g = jax.value_and_grad(loss_fn)(p, masked, labels)
+        up, o = tx.update(g, o, p)
+        return optax.apply_updates(p, up), o, key
+
+    dt = _step_time(step, (params, opt, jax.random.key(2)), tokens)
+    print(f"ERNIE-345M MLM bf16 train step, bs={batch}/s={seq}: "
+          f"{dt * 1e3:.1f} ms = {batch * seq / dt:.0f} tokens/s")
+
+
 if __name__ == "__main__":
-    which = sys.argv[1:] or ["vit", "imagen"]
+    from paddlefleetx_tpu.utils.env import setup_compilation_cache
+    setup_compilation_cache(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".xla_cache"))   # the unrolled 24-layer ERNIE compiles slowly
+    which = sys.argv[1:] or ["vit", "imagen", "ernie"]
     print("device:", jax.devices()[0].device_kind)
     if "vit" in which:
         smoke_vit()
     if "imagen" in which:
         smoke_imagen()
+    if "ernie" in which:
+        smoke_ernie()
