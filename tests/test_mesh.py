@@ -72,6 +72,47 @@ def test_dcn_factorization_prefers_dp_then_pp():
     assert dcn_factorization(6, (2, 3, 1, 1, 4)) == (2, 3, 1, 1, 1)
 
 
+def test_dcn_factorization_properties():
+    """For every feasible (shape, num_slices): the DCN degrees
+    multiply to num_slices, divide their axis degrees, and never
+    touch mp/cp. Infeasible combinations raise."""
+    hypothesis = pytest.importorskip("hypothesis")
+    st = hypothesis.strategies
+
+    from paddlefleetx_tpu.parallel.mesh import (
+        MESH_AXES, dcn_factorization,
+    )
+
+    degree = st.sampled_from([1, 2, 3, 4, 6, 8])
+    outcomes = {"ok": 0, "raised": 0}
+
+    @hypothesis.settings(max_examples=200, deadline=None)
+    @hypothesis.given(pp=degree, dp=degree, fsdp=degree, cp=degree,
+                      mp=degree,
+                      slices=st.sampled_from([1, 2, 3, 4, 6, 8, 16]))
+    def check(pp, dp, fsdp, cp, mp, slices):
+        shape = (pp, dp, cp, fsdp, mp)
+        try:
+            dcn = dcn_factorization(slices, shape)
+        except ValueError:
+            # infeasible is fine — but only when actually infeasible:
+            # one slice is always layout-able
+            assert slices > 1, "raised for the trivially feasible case"
+            outcomes["raised"] += 1
+            return
+        outcomes["ok"] += 1
+        assert int(np.prod(dcn)) == slices
+        for axis, d, s in zip(MESH_AXES, dcn, shape):
+            assert s % d == 0, (axis, d, s)
+            if axis in ("mp", "cp"):
+                assert d == 1, f"{axis} split across DCN"
+
+    check()
+    # both behaviors must have been exercised — a regression that
+    # raises (or succeeds) universally would otherwise pass vacuously
+    assert outcomes["ok"] > 0 and outcomes["raised"] > 0, outcomes
+
+
 def test_dcn_factorization_never_splits_mp():
     from paddlefleetx_tpu.parallel.mesh import dcn_factorization
     with pytest.raises(ValueError, match="mp/cp collectives onto"):
