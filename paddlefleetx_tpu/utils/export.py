@@ -36,25 +36,83 @@ _SPEC_FILE = "spec.json"
 _PARAMS_DIR = "params"
 
 
+def _symbolic_abstract_inputs(input_spec):
+    """``None`` dims become symbolic dimensions (shape polymorphism):
+    the artifact then serves ANY size on those axes — the reference's
+    ``InputSpec(shape=[None, ...])`` dynamic-batch semantics.
+
+    ``None`` dims at the SAME axis index share one symbol across
+    inputs: tokens+mask both shaped ``(None, s)`` trace as
+    ``(b, s), (b, s)`` — distinct symbols would make their equality
+    comparisons inconclusive and kill the symbolic export for every
+    multi-input model (batch/sequence axes are shared in practice;
+    the constraint is also enforced at call time, where it catches
+    mismatched inputs early). Returns None when no dim is dynamic."""
+    if not any(d is None for shape, _ in input_spec for d in shape):
+        return None
+    scope = jax.export.SymbolicScope()
+    out = []
+    for shape, dtype in input_spec:
+        dims = [f"d{i}" if d is None else str(int(d))
+                for i, d in enumerate(shape)]
+        out.append(jax.ShapeDtypeStruct(
+            jax.export.symbolic_shape(",".join(dims), scope=scope),
+            jax.numpy.dtype(dtype)))
+    return out
+
+
 def export_inference_model(fn: Callable, params,
                            input_spec: Sequence[Tuple[Sequence, str]],
                            output_dir: str,
                            metadata: Dict[str, Any] = None) -> str:
     """Serialize ``fn(params, *inputs)`` + ``params`` to ``output_dir``.
 
-    ``input_spec`` is the module contract's ``[(shape, dtype), ...]``
-    (None dims become 1 — the exported program has static shapes).
+    ``input_spec`` is the module contract's ``[(shape, dtype), ...]``.
+    ``None`` dims export as SYMBOLIC dimensions where the traced
+    computation allows it (plain forwards do; value-dependent loops
+    like the generation scan may not) — the served artifact then
+    accepts any size on those axes. When symbolic tracing fails — or
+    for partitioned artifacts, where jax.export's polymorphism does
+    not compose with baked shardings — ``None`` dims are concretized
+    to 1 and the runtime pads to spec (``pad_to_spec``).
     """
     os.makedirs(output_dir, exist_ok=True)
+    abstract_params = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params)
+    exported = None
+    dynamic_dims: List[List[int]] = []
+    # partitioned params (any leaf sharded over >1 device): jax
+    # export polymorphism does not compose with baked shardings —
+    # derived from the params themselves, not a caller convention
+    partitioned = any(
+        getattr(getattr(x, "sharding", None), "num_devices", 1) > 1
+        for x in jax.tree.leaves(params))
+    symbolic = _symbolic_abstract_inputs(input_spec) \
+        if not partitioned else None
+    if symbolic is not None:
+        try:
+            exported = jax.export.export(jax.jit(fn))(
+                abstract_params, *symbolic)
+            dynamic_dims = [
+                [i for i, d in enumerate(shape) if d is None]
+                for shape, _ in input_spec]
+        except Exception as e:
+            # a capability downgrade of the shipped artifact (it will
+            # only accept the concretized sizes) — say so loudly
+            logger.warning(
+                "symbolic-shape export unsupported for this function; "
+                "baking dynamic dims to 1 (the artifact pads to spec "
+                "instead of accepting any size). %s: %s",
+                type(e).__name__, e)
     abstract_inputs = [
         jax.ShapeDtypeStruct(
             tuple(1 if d is None else int(d) for d in shape),
             jax.numpy.dtype(dtype))
         for shape, dtype in input_spec]
-    abstract_params = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params)
-    exported = jax.export.export(jax.jit(fn))(
-        abstract_params, *abstract_inputs)
+    if exported is None:
+        exported = jax.export.export(jax.jit(fn))(
+            abstract_params, *abstract_inputs)
+        dynamic_dims = [[] for _ in input_spec]
     with open(os.path.join(output_dir, _MODEL_FILE), "wb") as f:
         f.write(exported.serialize())
 
@@ -63,7 +121,11 @@ def export_inference_model(fn: Callable, params,
         ckptr.save(params_path, jax.device_get(params), force=True)
 
     spec = {
-        "inputs": [[list(s.shape), s.dtype.name] for s in abstract_inputs],
+        # dynamic axes record null: the runtime accepts any size there
+        "inputs": [
+            [[None if i in dyn else int(d)
+              for i, d in enumerate(s.shape)], s.dtype.name]
+            for s, dyn in zip(abstract_inputs, dynamic_dims)],
         "metadata": metadata or {},
     }
     with open(os.path.join(output_dir, _SPEC_FILE), "w") as f:
@@ -163,15 +225,19 @@ def pad_to_spec(arrays: List[np.ndarray], spec: Dict[str, Any],
     for arr, (shape, dtype), pad, side in zip(arrays, spec["inputs"],
                                               pad_values, sides):
         arr = np.asarray(arr)
-        if list(arr.shape) == shape:
+        # None = symbolic (dynamic) axis: any size passes through
+        target = [a if s is None else s
+                  for a, s in zip(arr.shape, shape)] \
+            if arr.ndim == len(shape) else shape
+        if list(arr.shape) == target:
             out.append(arr.astype(dtype))
             continue
         if arr.ndim != len(shape) or any(
-                a > s for a, s in zip(arr.shape, shape)):
+                a > s for a, s in zip(arr.shape, target)):
             raise ValueError(
                 f"input shape {arr.shape} incompatible with exported "
                 f"spec {shape}")
-        widths = [(0, s - a) for a, s in zip(arr.shape, shape)]
+        widths = [(0, s - a) for a, s in zip(arr.shape, target)]
         if side == "left" and arr.ndim >= 1:
             widths[-1] = (widths[-1][1], 0)
         out.append(np.pad(arr, widths,

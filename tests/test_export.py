@@ -42,6 +42,33 @@ def test_export_roundtrip_matches_apply(tmp_path):
     assert spec["inputs"] == [[[2, 16], "int32"]]
 
 
+def test_symbolic_export_shares_batch_symbol_across_inputs(tmp_path):
+    """Two inputs with a dynamic leading axis (tokens + mask shape)
+    must share one symbol — distinct symbols make their equality
+    comparisons inconclusive and would silently kill the symbolic
+    export for every multi-input model."""
+    from paddlefleetx_tpu.utils.export import (
+        export_inference_model, load_inference_model, load_spec,
+    )
+
+    params = {"w": jnp.ones((4, 2), jnp.float32)}
+
+    def fn(p, tokens, mask):
+        return (tokens * mask) @ p["w"]
+
+    out = export_inference_model(
+        fn, params, [((None, 4), "float32"), ((None, 4), "float32")],
+        str(tmp_path / "m"))
+    spec = load_spec(out)
+    assert spec["inputs"][0][0][0] is None   # symbolic survived
+    assert spec["inputs"][1][0][0] is None
+    call, p, _ = load_inference_model(out)
+    for b in (1, 3):
+        x = np.ones((b, 4), np.float32)
+        got = call(p, x, x)
+        assert np.asarray(got).shape == (b, 2)
+
+
 def test_pad_to_spec():
     spec = {"inputs": [[[2, 8], "int32"], [[2, 8], "int32"]]}
     a = np.ones((2, 5), np.int64)
@@ -157,10 +184,13 @@ def test_vit_export_and_inference_roundtrip(tmp_path):
                             "warmup_steps": 1}),
         })
 
-    # the AOT artifact bakes the spec's concrete batch (None -> 1);
-    # larger batches loop client-side, same as the reference predictor
+    # the ViT forward exports with a SYMBOLIC batch axis (the
+    # reference's InputSpec(None, ...) semantics): spec records null
+    # and the artifact serves any batch size
+    from paddlefleetx_tpu.utils.export import load_spec
+    assert load_spec(out_dir)["inputs"][0][0][0] is None
     images = np.random.default_rng(0).uniform(
-        -1, 1, (1, 3, 16, 16)).astype(np.float32)
+        -1, 1, (3, 3, 16, 16)).astype(np.float32)
     inf = InferenceEngine(out_dir)
     outs = inf.predict([images])
     got = list(outs.values())[0]
