@@ -81,14 +81,28 @@ def export_inference_model(fn: Callable, params,
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params)
     exported = None
     dynamic_dims: List[List[int]] = []
-    # partitioned params (any leaf sharded over >1 device): jax
-    # export polymorphism does not compose with baked shardings —
-    # derived from the params themselves, not a caller convention
-    partitioned = any(
-        getattr(getattr(x, "sharding", None), "num_devices", 1) > 1
-        for x in jax.tree.leaves(params))
+    # partitioned params (any leaf actually SPLIT across devices —
+    # dp-replicated leaves live on many devices but are not split;
+    # the same replication-aware predicate engine.export uses to pick
+    # its export mesh): jax export polymorphism does not compose with
+    # baked shardings — derived from the params themselves, not a
+    # caller convention
+    def _split(x):
+        s = getattr(x, "sharding", None)
+        return (s is not None
+                and getattr(s, "num_devices", 1) > 1
+                and not s.is_fully_replicated)
+
+    partitioned = any(_split(x) for x in jax.tree.leaves(params))
     symbolic = _symbolic_abstract_inputs(input_spec) \
         if not partitioned else None
+    if partitioned and symbolic is None and any(
+            d is None for shape, _ in input_spec for d in shape):
+        logger.warning(
+            "partitioned export: dynamic (None) input dims are baked "
+            "to 1 (jax export polymorphism does not compose with "
+            "baked shardings); the artifact pads to spec instead of "
+            "accepting any size")
     if symbolic is not None:
         try:
             exported = jax.export.export(jax.jit(fn))(

@@ -164,6 +164,33 @@ def test_pp_subsumes_loss_chunks():
     assert module.model_config.loss_chunks == 1
 
 
+def test_pp_flips_scan_layers_back_on():
+    """The single-chip recipe unrolls layers (scan_layers False); a pp
+    override on top of it needs the scan-stacked params, so module
+    processing flips the knob back with a log line instead of dying
+    (same policy as loss_chunks above)."""
+    import os
+
+    from paddlefleetx_tpu.utils.config import get_config
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = get_config(
+        os.path.join(repo, "configs/nlp/gpt/"
+                           "pretrain_gpt_345M_single_card.yaml"),
+        overrides=["Distributed.pp_degree=2",
+                   "Distributed.dp_degree=4",
+                   "Model.num_layers=2", "Model.hidden_size=64",
+                   "Model.num_attention_heads=4",
+                   "Model.ffn_hidden_size=128", "Model.vocab_size=128",
+                   "Model.max_position_embeddings=64"],
+        show=False, nranks=8)
+    assert cfg.Model.scan_layers is False      # the recipe's setting
+    from paddlefleetx_tpu.models import build_module
+    module = build_module(cfg)
+    assert cfg.Model.scan_layers is True       # flipped for pp
+    assert module.model_config.scan_layers is True
+
+
 def test_get_config_end_to_end(cfg_tree):
     cfg = get_config(str(cfg_tree / "child.yaml"),
                      overrides=["Model.num_layers=4"], nranks=8)

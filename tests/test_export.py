@@ -69,6 +69,25 @@ def test_symbolic_export_shares_batch_symbol_across_inputs(tmp_path):
         assert np.asarray(got).shape == (b, 2)
 
 
+def test_symbolic_export_survives_dp_replicated_params(tmp_path):
+    """dp-trained params live on many devices but are fully
+    replicated, not split — that must NOT disable the symbolic
+    export (replication-aware partitioned predicate)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    from paddlefleetx_tpu.utils.export import (
+        export_inference_model, load_spec,
+    )
+
+    mesh = Mesh(np.asarray(jax.devices()).reshape(8), ("dp",))
+    params = {"w": jax.device_put(
+        jnp.ones((4, 2), jnp.float32),
+        NamedSharding(mesh, PartitionSpec()))}
+    out = export_inference_model(
+        lambda p, x: x @ p["w"], params, [((None, 4), "float32")],
+        str(tmp_path / "m"))
+    assert load_spec(out)["inputs"][0][0][0] is None
+
+
 def test_pad_to_spec():
     spec = {"inputs": [[[2, 8], "int32"], [[2, 8], "int32"]]}
     a = np.ones((2, 5), np.int64)
