@@ -94,10 +94,11 @@ def export_inference_model(fn: Callable, params,
                 and not s.is_fully_replicated)
 
     partitioned = any(_split(x) for x in jax.tree.leaves(params))
+    has_dynamic = any(d is None for shape, _ in input_spec
+                      for d in shape)
     symbolic = _symbolic_abstract_inputs(input_spec) \
-        if not partitioned else None
-    if partitioned and symbolic is None and any(
-            d is None for shape, _ in input_spec for d in shape):
+        if has_dynamic and not partitioned else None
+    if partitioned and has_dynamic:
         logger.warning(
             "partitioned export: dynamic (None) input dims are baked "
             "to 1 (jax export polymorphism does not compose with "

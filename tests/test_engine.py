@@ -293,6 +293,43 @@ def test_async_checkpoint_save_then_resume(tmp_path):
         params_before, engine2.state["params"])
 
 
+def test_checkpoint_restores_across_mesh_and_scan_toggle(tmp_path):
+    """The hardest combined case: save under the nn.scan layout on a
+    dp2 x mp2 x fsdp2 mesh, restore into an UNROLLED model on a
+    different mesh split — the layout adapter must not inherit the
+    checkpoint's recorded shardings (Orbax calls that unsafe across
+    topologies); it restores via explicit single-device placement and
+    re-places onto the new mesh."""
+    cfg, engine, loader = _build(tmp_path, **{"Engine.max_steps": 2})
+    engine.fit(epoch=1, train_data_loader=loader)
+    engine.save(epoch=1)
+    step = int(engine.state["step"])
+    stacked_before = jax.tree.map(
+        np.asarray, engine.state["params"]["gpt"]["decoder"])
+
+    cfg2, engine2, loader2 = _build(
+        tmp_path, **{"Engine.max_steps": 4,
+                     "Model.scan_layers": False,
+                     "Distributed.dp_degree": 2,
+                     "Distributed.mp_degree": 4,
+                     "Distributed.sharding.sharding_degree": 1,
+                     "Engine.save_load.ckpt_dir": str(tmp_path / "out")})
+    assert dict(engine2.mesh.shape) != dict(engine.mesh.shape)
+    assert int(engine2.state["step"]) == step
+    gpt = engine2.state["params"]["gpt"]
+    assert "decoder_0" in gpt
+    jax.tree.map(
+        lambda sliced, full: np.testing.assert_array_equal(
+            np.asarray(sliced), np.asarray(full[1])),
+        dict(gpt["decoder_1"]), dict(stacked_before))
+    import flax.linen as nn
+    batch = next(iter(loader2))
+    with engine2.mesh, nn.logical_axis_rules(engine2.rules):
+        _, metrics = engine2._train_step(engine2.state,
+                                         engine2._put_batch(batch))
+    assert np.isfinite(float(metrics["loss"]))
+
+
 def test_checkpoint_restores_across_topologies(tmp_path):
     """Save on mesh A (dp2 x mp2 x sharding2), restore on mesh B
     (mp4 x pp... different axis split) — the SURVEY 'hard part' the
