@@ -30,6 +30,7 @@ import argparse
 import functools
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -45,6 +46,124 @@ from paddlefleetx_tpu.models.gpt import (  # noqa: E402
 )
 
 BASELINE_TOKENS_PER_SEC = 16200.0
+HEADLINE_METRIC = "gpt345m_pretrain_tokens_per_sec_per_chip"
+METRIC_BY_MODE = {
+    "train": HEADLINE_METRIC,
+    "moe": "gpt345m_moe8_top2_pretrain_tokens_per_sec_per_chip",
+    "generation": "gpt345m_generation_decode_tokens_per_sec",
+}
+# which metric a failure is reported against — set from --mode so a
+# crashed `--mode moe` run cannot blame the pretrain headline number
+_active_metric = HEADLINE_METRIC
+
+# -- backend acquisition hardening ------------------------------------
+#
+# The bench IS the scoreboard: a transient PJRT failure must never turn
+# into a raw-traceback rc=1 with no JSON line (round-3 failure mode:
+# ``UNAVAILABLE: TPU backend setup/compile error`` at client creation —
+# the chip/tunnel was momentarily unavailable). Three layers of defense:
+#
+# 1. ``wait_for_backend``: BEFORE the main process touches jax.devices()
+#    (which both caches failure state and can HANG forever on a half-up
+#    tunnel), probe backend init in a kill-able SUBPROCESS with bounded
+#    retry + exponential backoff. The main process only initializes its
+#    own client once a probe has succeeded, so it neither hangs nor
+#    poisons its backend cache.
+# 2. mid-run transients: a top-level catch re-execs the whole script
+#    (fresh process = fresh PJRT state) up to PFX_BENCH_REEXECS times.
+# 3. unrecoverable: emit ONE structured JSON line with an ``error`` /
+#    ``error_kind`` field (backend_unavailable vs exception) so the
+#    driver can distinguish an environment outage from a code bug, then
+#    exit rc=1.
+
+_TRANSIENT_MARKERS = (
+    "UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
+    "Unable to initialize backend", "backend setup/compile error",
+    "Socket closed", "Connection reset", "failed to connect",
+)
+
+_PROBE_SRC = """\
+import json, sys
+import jax
+d = jax.devices()[0]
+print(json.dumps({"platform": d.platform, "device_kind": d.device_kind,
+                  "n": jax.device_count()}))
+"""
+
+
+def _is_transient(text: str) -> bool:
+    return any(m in text for m in _TRANSIENT_MARKERS)
+
+
+def _emit_failure(kind: str, detail: str, rc: int = 1):
+    print(json.dumps({
+        "metric": _active_metric, "value": None, "unit": "tokens/s",
+        "vs_baseline": None, "error_kind": kind,
+        "error": detail[-2000:],
+    }))
+    sys.stdout.flush()
+    sys.exit(rc)
+
+
+def wait_for_backend() -> dict:
+    """Probe PJRT client creation in subprocesses until one succeeds;
+    returns the probe's ``{platform, device_kind, n}``. Bounded by
+    PFX_BENCH_MAX_WAIT seconds (default 900) of total probing; each
+    probe attempt is itself capped (a hung tunnel init cannot stall
+    the bench — the subprocess is killed and counted as transient)."""
+    budget = float(os.environ.get("PFX_BENCH_MAX_WAIT", "900"))
+    probe_timeout = float(os.environ.get("PFX_BENCH_PROBE_TIMEOUT", "300"))
+    deadline = time.monotonic() + budget
+    delay, last = 15.0, "no probe ran"
+    attempt = 0
+    while True:
+        attempt += 1
+        this_timeout = min(probe_timeout,
+                           max(30.0, deadline - time.monotonic()))
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True, timeout=this_timeout)
+            if r.returncode == 0 and r.stdout.strip():
+                info = json.loads(r.stdout.strip().splitlines()[-1])
+                # a probe that silently fell back to CPU while the
+                # environment expects a TPU is an OUTAGE, not success:
+                # a CPU "success" number would read as a massive perf
+                # regression to the driver. The axon/tpu platforms are
+                # pinned through JAX_PLATFORMS; unset/cpu means a
+                # deliberate local run and passes through.
+                plats = os.environ.get("JAX_PLATFORMS", "").lower()
+                expect_tpu = ("tpu" in plats or "axon" in plats or
+                              os.environ.get("PFX_BENCH_EXPECT")
+                              == "tpu")
+                if not (expect_tpu and info.get("platform") != "tpu"):
+                    if attempt > 1:
+                        sys.stderr.write(
+                            f"backend up after {attempt} probes\n")
+                    return info
+                # platform mismatch is retryable (tunnel may come up)
+                last = (f"probe reached platform="
+                        f"{info.get('platform')!r}, expected tpu")
+            else:
+                last = (r.stderr or r.stdout or "").strip()
+                if not _is_transient(last):
+                    _emit_failure(
+                        "exception",
+                        f"backend probe failed (non-transient): "
+                        f"{last}")
+        except subprocess.TimeoutExpired:
+            last = f"probe hung >{this_timeout:.0f}s (killed)"
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            _emit_failure(
+                "backend_unavailable",
+                f"backend unavailable after {attempt} probes over "
+                f"{budget:.0f}s; last: {last}")
+        sys.stderr.write(
+            f"backend probe {attempt} failed ({last.splitlines()[-1] if last else ''}); "
+            f"retrying in {delay:.0f}s ({remaining:.0f}s left)\n")
+        time.sleep(min(delay, max(1.0, remaining)))
+        delay = min(delay * 2, 120.0)
 # bf16 dense peak by device kind (jax Device.device_kind) — platform
 # alone can't distinguish TPU generations and would silently mis-scale
 # MFU on anything but the calibrated chip.
@@ -283,7 +402,7 @@ def bench_train():
             sys.stderr.write(
                 f"warning: long-context bench failed: {e}\n")
     print(json.dumps({
-        "metric": "gpt345m_pretrain_tokens_per_sec_per_chip",
+        "metric": HEADLINE_METRIC,
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 3),
@@ -325,7 +444,7 @@ def bench_moe():
             + (cfg.moe_top_k - 1) * 48.0 * L * h * h
         mfu = tokens_per_sec * flops / peak
     print(json.dumps({
-        "metric": "gpt345m_moe8_top2_pretrain_tokens_per_sec_per_chip",
+        "metric": METRIC_BY_MODE["moe"],
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": None,  # no reference MoE exists
@@ -373,7 +492,7 @@ def bench_generation():
     dt = time.perf_counter() - t0
     decode_tps = batch * dec_len * n_rounds / dt
     print(json.dumps({
-        "metric": "gpt345m_generation_decode_tokens_per_sec",
+        "metric": METRIC_BY_MODE["generation"],
         "value": round(decode_tps, 1),
         "unit": "tokens/s",
         "vs_baseline": None,  # the reference publishes no number
@@ -385,11 +504,17 @@ def main():
     p.add_argument("--mode", choices=["train", "generation", "moe"],
                    default="train")
     args = p.parse_args()
+    global _active_metric
+    _active_metric = METRIC_BY_MODE[args.mode]
     # the CLIs' hook: PFX_CPU_DEVICES forces the CPU platform through
     # jax.config (site customization may pin another platform that
     # ignores the JAX_PLATFORMS env var)
     from paddlefleetx_tpu.cli import maybe_virtual_cpu_mesh
     maybe_virtual_cpu_mesh()
+    # do not probe when the caller explicitly pinned a CPU mesh — that
+    # path exists for offline testing and always initializes instantly
+    if not os.environ.get("PFX_CPU_DEVICES"):
+        wait_for_backend()
     # persistent compile cache: the unrolled 24-layer configs take
     # minutes to compile cold; repeated bench runs (and the perf-CI
     # driver) should pay that once per program, not per run
@@ -406,5 +531,35 @@ def main():
         bench_generation()
 
 
+def _run_guarded():
+    """main() with the transient-failure escape hatch: a transient
+    PJRT error AFTER acquisition (tunnel drop mid-run) re-execs the
+    script in a fresh process (fresh backend state) up to
+    PFX_BENCH_REEXECS times; anything else emits the structured
+    failure JSON instead of a bare traceback."""
+    try:
+        main()
+    except (SystemExit, KeyboardInterrupt):
+        raise
+    except BaseException as e:
+        import traceback
+        detail = "".join(traceback.format_exception(e))
+        sys.stderr.write(detail)
+        if _is_transient(detail):
+            done = int(os.environ.get("PFX_BENCH_REEXEC", "0"))
+            allowed = int(os.environ.get("PFX_BENCH_REEXECS", "2"))
+            if done < allowed:
+                sys.stderr.write(
+                    f"transient backend failure mid-run; re-exec "
+                    f"{done + 1}/{allowed} in 30s\n")
+                time.sleep(30)
+                os.environ["PFX_BENCH_REEXEC"] = str(done + 1)
+                os.execv(sys.executable,
+                         [sys.executable, os.path.abspath(__file__)]
+                         + sys.argv[1:])
+            _emit_failure("backend_unavailable", detail)
+        _emit_failure("exception", detail)
+
+
 if __name__ == "__main__":
-    main()
+    _run_guarded()

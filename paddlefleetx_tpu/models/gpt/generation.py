@@ -318,8 +318,11 @@ def _beam_search(model, params, cache, last_logits, base_valid,
     repetition-penalty / min-length / Hamming shaping), matching the
     reference's and HF's beam semantics — so with
     ``repetition_penalty != 1.0`` the ranking deviates from raw model
-    likelihood by design (pinned by
-    ``tests/test_generation.py::test_beam_search_repetition_penalty``).
+    likelihood by design. Pinned at k=1 by
+    ``test_beam_search_repetition_penalty_k1_equals_greedy`` and at
+    k>1 by ``test_beam_search_processed_score_semantics_k_gt_1``
+    (an independent teacher-forced replay of the processor pipeline
+    must reproduce the returned beam ordering).
 
     With ``num_beam_groups > 1`` this becomes diverse (group) beam
     search: each group of ``k/G`` beams runs the same two-pool update,

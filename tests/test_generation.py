@@ -488,3 +488,78 @@ def test_generation_tp4_matches_single_device(model_and_params):
         dist = np.asarray(generate(model, params_s, prompt, None,
                                    jax.random.key(2), gen_cfg))
     np.testing.assert_array_equal(dist, single)
+
+
+def test_beam_search_processed_score_semantics_k_gt_1(model_and_params):
+    """Pins the PROCESSED-score accumulation for real beam widths
+    (ADVICE r2 #1 / VERDICT r3 #6): beam ranking is by cumulative
+    log-softmax of the repetition-penalty-processed logits — HF /
+    reference semantics — NOT raw model likelihood. Verified by an
+    independent teacher-forced replay of the processor pipeline: the
+    returned beams must be ordered by the replayed processed score,
+    and a repetition penalty != 1 must CHANGE what the search returns
+    versus the unpenalized run."""
+    model, params = model_and_params
+    prompt = jnp.asarray(
+        np.random.default_rng(21).integers(0, 90, (3, 6)), jnp.int32)
+    b0, plen, dec, k, nrs, pen = 3, prompt.shape[1], 5, 4, 2, 1.5
+
+    def replay_processed_score(rows):
+        """Cumulative processed log-prob of each returned row,
+        replayed independently of the beam bookkeeping."""
+        rows = jnp.asarray(rows)                      # [n, dec]
+        n = rows.shape[0]
+        src = jnp.repeat(prompt, nrs, axis=0)         # prompt per row
+        full = jnp.concatenate([src, rows], axis=1)
+        logits = model.apply({"params": params}, full).astype(
+            jnp.float32)
+        appeared = jnp.zeros((n, CFG.vocab_size), bool)
+        appeared = appeared.at[jnp.arange(n)[:, None], src].set(True)
+        total = jnp.zeros((n,), jnp.float32)
+        for t in range(dec):
+            step = repetition_penalty_processor(
+                logits[:, plen - 1 + t, :], appeared, pen)
+            step = min_length_processor(step, t, dec, EOS)
+            lp = jax.nn.log_softmax(step, -1)
+            tok = rows[:, t]
+            total = total + lp[jnp.arange(n), tok]
+            appeared = appeared.at[jnp.arange(n), tok].set(True)
+        return np.asarray(total)
+
+    # min_dec_len = dec bans EOS throughout: every hypothesis stays
+    # live and the replay maps 1:1 (no length-penalized finished pool)
+    kw = dict(max_dec_len=dec, min_dec_len=dec,
+              decode_strategy="beam_search",
+              num_beams=k, num_return_sequences=nrs,
+              eos_token_id=EOS, pad_token_id=PAD)
+    out_pen = np.asarray(generate(
+        model, params, prompt, None, jax.random.key(0),
+        GenerationConfig(repetition_penalty=pen, **kw)))
+    out_raw = np.asarray(generate(
+        model, params, prompt, None, jax.random.key(0),
+        GenerationConfig(repetition_penalty=1.0, **kw)))
+    assert out_pen.shape == (b0 * nrs, dec)
+    assert not (out_pen == EOS).any() and not (out_raw == EOS).any()
+    # (a) the penalty changes the returned hypotheses for >=1 prompt
+    assert not np.array_equal(out_pen, out_raw)
+    # (b) within each prompt the nrs returned beams are ordered by the
+    # REPLAYED processed score (ties allowed)
+    scores = replay_processed_score(out_pen).reshape(b0, nrs)
+    assert (scores[:, :-1] >= scores[:, 1:] - 1e-4).all(), scores
+    # (c) and that order really is the PROCESSED order, not raw
+    # likelihood: for at least one prompt the returned order must
+    # INVERT the raw teacher-forced log-prob order (a beam search that
+    # ranked by raw likelihood would pass (b) only if the two orders
+    # coincided everywhere)
+    raw = np.zeros((b0 * nrs,))
+    full = np.concatenate([np.repeat(np.asarray(prompt), nrs, 0),
+                           out_pen], axis=1)
+    logits = np.asarray(model.apply(
+        {"params": params}, jnp.asarray(full)).astype(jnp.float32))
+    lp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), -1))
+    for t in range(dec):
+        raw += lp[np.arange(b0 * nrs), plen - 1 + t, out_pen[:, t]]
+    raw = raw.reshape(b0, nrs)
+    assert (raw[:, 0] < raw[:, 1] - 1e-6).any(), (
+        "raw and processed orders coincide for every prompt — the "
+        "test lost its discriminating power; change the seed", raw)
