@@ -3,10 +3,13 @@
 from .preprocess import (
     TRANSFORMS,
     CenterCropImage,
+    ColorJitter,
     DecodeImage,
     NormalizeImage,
+    Pixels,
     RandCropImage,
     RandFlipImage,
+    RandomErasing,
     ResizeImage,
     ToCHWImage,
     build_transforms,
@@ -15,10 +18,13 @@ from .preprocess import (
 __all__ = [
     "TRANSFORMS",
     "CenterCropImage",
+    "ColorJitter",
     "DecodeImage",
     "NormalizeImage",
+    "Pixels",
     "RandCropImage",
     "RandFlipImage",
+    "RandomErasing",
     "ResizeImage",
     "ToCHWImage",
     "build_transforms",

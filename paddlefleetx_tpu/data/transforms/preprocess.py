@@ -183,6 +183,138 @@ class ToCHWImage:
         return np.ascontiguousarray(np.asarray(img).transpose((2, 0, 1)))
 
 
+class ColorJitter:
+    """Random brightness/contrast/saturation/hue jitter (reference
+    ``preprocess.py:295`` wraps ``paddle.vision.transforms.ColorJitter``,
+    whose semantics are the torchvision ones reproduced here: factor
+    ``f`` draws uniformly from ``[max(0, 1-f), 1+f]``, hue ``h`` from
+    ``[-h, h]`` (fraction of the hue wheel), ops applied in random
+    order). PIL-backed; returns HWC uint8."""
+
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0,
+                 hue=0.0):
+        self.brightness = float(brightness)
+        self.contrast = float(contrast)
+        self.saturation = float(saturation)
+        self.hue = float(hue)
+        for name, v in (("brightness", self.brightness),
+                        ("contrast", self.contrast),
+                        ("saturation", self.saturation)):
+            if v < 0:
+                raise ValueError(f"{name} must be >= 0, got {v}")
+        if not 0.0 <= self.hue <= 0.5:
+            raise ValueError("hue must be in [0, 0.5]")
+
+    @staticmethod
+    def _enhance(pil, kind, factor):
+        from PIL import ImageEnhance
+        enh = {"brightness": ImageEnhance.Brightness,
+               "contrast": ImageEnhance.Contrast,
+               "saturation": ImageEnhance.Color}[kind]
+        return enh(pil).enhance(factor)
+
+    @staticmethod
+    def _shift_hue(pil, frac):
+        h, s, v = pil.convert("HSV").split()
+        h = np.asarray(h, np.uint8)
+        h = ((h.astype(np.int16) + int(round(frac * 255.0))) % 256
+             ).astype(np.uint8)
+        Image = _pil()
+        return Image.merge(
+            "HSV", (Image.fromarray(h, "L"), s, v)).convert("RGB")
+
+    def __call__(self, img):
+        pil = _to_pil(img).convert("RGB")
+        ops = []
+        for kind, f in (("brightness", self.brightness),
+                        ("contrast", self.contrast),
+                        ("saturation", self.saturation)):
+            if f > 0:
+                lo, hi = max(0.0, 1.0 - f), 1.0 + f
+                factor = random.uniform(lo, hi)
+                ops.append(lambda p, k=kind, x=factor:
+                           self._enhance(p, k, x))
+        if self.hue > 0:
+            frac = random.uniform(-self.hue, self.hue)
+            ops.append(lambda p, x=frac: self._shift_hue(p, x))
+        random.shuffle(ops)
+        for op in ops:
+            pil = op(pil)
+        return np.asarray(pil)
+
+
+class Pixels:
+    """Fill-value source for ``RandomErasing`` (reference
+    ``preprocess.py:312``): ``const`` -> the configured per-channel
+    mean, ``rand`` -> one normal RGB value, ``pixel`` -> a full
+    normal patch."""
+
+    def __init__(self, mode: str = "const", mean=(0.0, 0.0, 0.0)):
+        if mode not in ("const", "rand", "pixel"):
+            raise ValueError(
+                'Invalid mode in RandomErasing, only support "const", '
+                '"rand", "pixel"')
+        self._mode = mode
+        self._mean = np.asarray(mean, np.float32)
+
+    def __call__(self, h=224, w=224, c=3):
+        if self._mode == "rand":
+            return np.random.normal(size=(1, 1, 3)).astype(np.float32)
+        if self._mode == "pixel":
+            return np.random.normal(size=(h, w, c)).astype(np.float32)
+        return self._mean
+
+
+class RandomErasing:
+    """Timm-style random erasing (reference ``preprocess.py:330``):
+    with probability ``EPSILON`` replace one random rectangle (area in
+    ``[sl, sh]`` of the image, aspect in ``[r1, 1/r1]``) with
+    ``Pixels(mode, mean)`` values. Operates on the HWC array (float
+    after ``NormalizeImage`` or uint8 before); never mutates its
+    input. Numeric knobs accept the reference's string forms (parsed
+    with ``float()``, not ``eval``)."""
+
+    def __init__(self, EPSILON=0.5, sl=0.02, sh=0.4, r1=0.3,
+                 mean=(0.0, 0.0, 0.0), attempt=100,
+                 use_log_aspect=False, mode="const"):
+        import math
+        self.EPSILON = float(EPSILON)
+        self.sl, self.sh = float(sl), float(sh)
+        r1 = float(r1)
+        self.r1 = ((math.log(r1), math.log(1 / r1)) if use_log_aspect
+                   else (r1, 1 / r1))
+        self.use_log_aspect = bool(use_log_aspect)
+        self.attempt = int(attempt)
+        self.get_pixels = Pixels(mode, mean)
+
+    def __call__(self, img):
+        import math
+        if random.random() > self.EPSILON:
+            return img
+        arr = np.array(img)  # copy; HWC
+        for _ in range(self.attempt):
+            area = arr.shape[0] * arr.shape[1]
+            target_area = random.uniform(self.sl, self.sh) * area
+            aspect = random.uniform(*self.r1)
+            if self.use_log_aspect:
+                aspect = math.exp(aspect)
+            h = int(round(math.sqrt(target_area * aspect)))
+            w = int(round(math.sqrt(target_area / aspect)))
+            if w < arr.shape[1] and h < arr.shape[0]:
+                pixels = np.asarray(
+                    self.get_pixels(h, w, arr.shape[2]))
+                x1 = random.randint(0, arr.shape[0] - h)
+                y1 = random.randint(0, arr.shape[1] - w)
+                if arr.shape[2] == 3:
+                    arr[x1:x1 + h, y1:y1 + w, :] = \
+                        pixels.astype(arr.dtype, copy=False)
+                else:
+                    arr[x1:x1 + h, y1:y1 + w, 0] = \
+                        np.asarray(pixels).reshape(-1)[0]
+                return arr
+        return arr
+
+
 TRANSFORMS = {
     "DecodeImage": DecodeImage,
     "ResizeImage": ResizeImage,
@@ -191,6 +323,12 @@ TRANSFORMS = {
     "RandFlipImage": RandFlipImage,
     "NormalizeImage": NormalizeImage,
     "ToCHWImage": ToCHWImage,
+    "ColorJitter": ColorJitter,
+    # NOTE: Pixels is deliberately NOT registered — it is
+    # RandomErasing's fill-value source (takes (h, w, c), not an
+    # image), constructed internally from mode/mean; listing it in a
+    # transform_ops pipeline would be a config error
+    "RandomErasing": RandomErasing,
 }
 
 

@@ -11,9 +11,25 @@ from .model import (
     ErniePretrainingHeads,
     ernie_pretraining_loss,
 )
+from .model_outputs import (
+    BaseModelOutputWithPoolingAndCrossAttentions,
+    ErnieForPreTrainingOutput,
+    MaskedLMOutput,
+    MultipleChoiceModelOutput,
+    QuestionAnsweringModelOutput,
+    SequenceClassifierOutput,
+    TokenClassifierOutput,
+)
 
 __all__ = [
+    "BaseModelOutputWithPoolingAndCrossAttentions",
     "ErnieConfig",
+    "ErnieForPreTrainingOutput",
+    "MaskedLMOutput",
+    "MultipleChoiceModelOutput",
+    "QuestionAnsweringModelOutput",
+    "SequenceClassifierOutput",
+    "TokenClassifierOutput",
     "ErnieEmbeddings",
     "ErnieEncoderLayer",
     "ErnieForMaskedLM",

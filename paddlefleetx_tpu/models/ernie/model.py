@@ -120,8 +120,15 @@ class ErnieEmbeddings(nn.Module):
 
 
 class ErnieSelfAttention(nn.Module):
-    """Bidirectional multi-head attention with an additive mask."""
+    """Bidirectional multi-head attention with an additive mask.
+
+    ``output_attentions`` (reference ``single_model.py:256``) returns
+    the post-softmax probabilities alongside the output; that path
+    computes attention densely (the flash kernel never materializes
+    probabilities — asking for them IS asking for the dense
+    [b, h, s, s] tensor)."""
     config: ErnieConfig
+    output_attentions: bool = False
 
     @nn.compact
     def __call__(self, x, attn_bias=None, deterministic: bool = True):
@@ -135,12 +142,30 @@ class ErnieSelfAttention(nn.Module):
         dropout_rng = None
         if cfg.attention_probs_dropout_prob > 0.0 and not deterministic:
             dropout_rng = self.make_rng("dropout")
-        out = dot_product_attention(
-            q, k, v, bias=attn_bias, causal=False,
-            dropout_rate=cfg.attention_probs_dropout_prob,
-            dropout_rng=dropout_rng, deterministic=deterministic,
-            use_flash=cfg.use_flash_attention)
-        return nn.DenseGeneral(
+        probs = None
+        if self.output_attentions:
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k).astype(jnp.float32) \
+                / jnp.sqrt(jnp.float32(hd))
+            if attn_bias is not None:
+                scores = scores + attn_bias.astype(jnp.float32)
+            probs = jax.nn.softmax(scores, axis=-1)
+            weights = probs.astype(v.dtype)
+            if dropout_rng is not None:
+                keep = jax.random.bernoulli(
+                    dropout_rng, 1.0 - cfg.attention_probs_dropout_prob,
+                    weights.shape)
+                weights = jnp.where(
+                    keep, weights / (1.0 - cfg.attention_probs_dropout_prob),
+                    0.0).astype(v.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+        else:
+            out = dot_product_attention(
+                q, k, v, bias=attn_bias, causal=False,
+                dropout_rate=cfg.attention_probs_dropout_prob,
+                dropout_rng=dropout_rng, deterministic=deterministic,
+                use_flash=cfg.use_flash_attention)
+        out = nn.DenseGeneral(
             cfg.hidden_size, axis=(-2, -1), name="out_proj",
             dtype=jnp.dtype(cfg.dtype),
             param_dtype=jnp.dtype(cfg.param_dtype),
@@ -148,18 +173,29 @@ class ErnieSelfAttention(nn.Module):
                 _init(cfg), ("heads", "kv", "embed")),
             bias_init=nn.with_logical_partitioning(
                 nn.initializers.zeros_init(), ("embed",)))(out)
+        return out, probs
 
 
 class ErnieEncoderLayer(nn.Module):
     """Post-LN encoder block (``normalize_before=False``, reference
-    ``single_model.py:226-236``)."""
+    ``single_model.py:226-236``).
+
+    ``collect_hidden``/``output_attentions`` are STATIC module fields
+    (not call args) so they survive ``nn.scan``/``nn.remat`` without
+    touching the traced signature; the scanned form emits per-layer
+    ``(hidden?, attention?)`` as scan ys, which the model splits into
+    the reference's tuples."""
     config: ErnieConfig
     scanned: bool = False
+    collect_hidden: bool = False
+    output_attentions: bool = False
 
     @nn.compact
     def __call__(self, x, attn_bias=None, deterministic: bool = True):
         cfg = self.config
-        y = ErnieSelfAttention(cfg, name="self_attn")(
+        y, probs = ErnieSelfAttention(
+            cfg, name="self_attn",
+            output_attentions=self.output_attentions)(
             x, attn_bias, deterministic)
         y = nn.Dropout(cfg.hidden_dropout_prob, name="dropout1")(
             y, deterministic=deterministic)
@@ -175,7 +211,9 @@ class ErnieEncoderLayer(nn.Module):
             y, deterministic=deterministic)
         x = _ln(cfg, "norm2")(x + y)
         x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
-        return (x, None) if self.scanned else x
+        if self.scanned:
+            return x, (x if self.collect_hidden else None, probs)
+        return x, probs
 
 
 class ErniePooler(nn.Module):
@@ -201,13 +239,22 @@ def attention_mask_bias(attention_mask: Optional[jax.Array],
 
 
 class ErnieModel(nn.Module):
-    """Embeddings -> N post-LN encoder blocks -> (sequence, pooled)."""
+    """Embeddings -> N post-LN encoder blocks -> (sequence, pooled).
+
+    Output plumbing matches reference ``single_model.py:255-257``:
+    ``output_hidden_states`` adds the reference/HF tuple of L+1 states
+    (embedding output + every block output), ``output_attentions`` the
+    per-layer post-softmax probabilities, ``return_dict`` wraps them in
+    :class:`BaseModelOutputWithPoolingAndCrossAttentions`."""
     config: ErnieConfig
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, position_ids=None,
                  attention_mask=None, task_type_ids=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True,
+                 output_hidden_states: bool = False,
+                 output_attentions: bool = False,
+                 return_dict: bool = False):
         cfg = self.config
         if attention_mask is None:
             # No mask: treat the batch as unpadded, on BOTH attention
@@ -224,26 +271,57 @@ class ErnieModel(nn.Module):
             input_ids, token_type_ids, position_ids, task_type_ids,
             deterministic)
 
+        all_hidden = [x] if output_hidden_states else None
+        all_attn = [] if output_attentions else None
         block = ErnieEncoderLayer
         if cfg.use_recompute:
             # argnums count from self: (self, x, attn_bias, deterministic)
             block = nn.remat(block, static_argnums=(3,),
                              prevent_cse=not cfg.scan_layers)
         if cfg.scan_layers:
-            x, _ = nn.scan(
+            x, (h_stack, a_stack) = nn.scan(
                 block,
                 variable_axes={"params": 0},
                 split_rngs={"params": True, "dropout": True},
                 in_axes=nn.broadcast,
                 length=cfg.num_hidden_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, scanned=True, name="encoder")(x, bias, deterministic)
+            )(cfg, scanned=True, collect_hidden=output_hidden_states,
+              output_attentions=output_attentions,
+              name="encoder")(x, bias, deterministic)
+            if output_hidden_states:
+                all_hidden += [h_stack[i]
+                               for i in range(cfg.num_hidden_layers)]
+            if output_attentions:
+                all_attn = [a_stack[i]
+                            for i in range(cfg.num_hidden_layers)]
         else:
             for i in range(cfg.num_hidden_layers):
-                x = block(cfg, name=f"encoder_{i}")(x, bias, deterministic)
+                x, probs = block(
+                    cfg, output_attentions=output_attentions,
+                    name=f"encoder_{i}")(x, bias, deterministic)
+                if output_hidden_states:
+                    all_hidden.append(x)
+                if output_attentions:
+                    all_attn.append(probs)
 
         pooled = ErniePooler(cfg, name="pooler")(x)
-        return x, pooled
+        hidden_states = tuple(all_hidden) if output_hidden_states \
+            else None
+        attentions = tuple(all_attn) if output_attentions else None
+        if not return_dict:
+            out = (x, pooled)
+            if output_hidden_states:
+                out = out + (hidden_states,)
+            if output_attentions:
+                out = out + (attentions,)
+            return out
+        from .model_outputs import (
+            BaseModelOutputWithPoolingAndCrossAttentions,
+        )
+        return BaseModelOutputWithPoolingAndCrossAttentions(
+            last_hidden_state=x, pooler_output=pooled,
+            hidden_states=hidden_states, attentions=attentions)
 
 
 class ErnieLMPredictionHead(nn.Module):
@@ -296,36 +374,109 @@ def _tied_word_embeddings(variables) -> jax.Array:
     return emb
 
 
+def _mean_ce_ignore(logits: jax.Array, labels: jax.Array,
+                    ignore_index: int) -> jax.Array:
+    """Mean softmax CE over positions with ``label != ignore_index``
+    (the reference heads use ``paddle.nn.CrossEntropyLoss`` whose
+    default ignore_index is -100; the pretraining criterion uses -1)."""
+    logits = logits.astype(jnp.float32).reshape(-1, logits.shape[-1])
+    labels = labels.reshape(-1)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    safe = jnp.maximum(labels, 0)
+    picked = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+    mask = (labels != ignore_index).astype(jnp.float32)
+    return jnp.sum((logz - picked) * mask) / jnp.maximum(
+        jnp.sum(mask), 1.0)
+
+
 class ErnieForPretraining(nn.Module):
     """ERNIE with MLM + NSP heads (reference :513-637); returns
-    ``(prediction_scores, seq_relationship_score)``."""
+    ``(prediction_scores, seq_relationship_score)`` — prefixed by the
+    total loss when both label sets are given, or an
+    :class:`ErnieForPreTrainingOutput` under ``return_dict=True``
+    (which the reference declares but leaves commented out, returning
+    ``None``; here it works)."""
     config: ErnieConfig
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, position_ids=None,
                  attention_mask=None, masked_positions=None,
-                 deterministic: bool = True):
-        seq_out, pooled = ErnieModel(self.config, name="ernie")(
+                 labels=None, next_sentence_label=None,
+                 deterministic: bool = True,
+                 output_hidden_states: bool = False,
+                 output_attentions: bool = False,
+                 return_dict: bool = False):
+        outputs = ErnieModel(self.config, name="ernie")(
             input_ids, token_type_ids, position_ids, attention_mask,
-            deterministic=deterministic)
-        return ErniePretrainingHeads(self.config, name="heads")(
-            seq_out, pooled, _tied_word_embeddings(self.variables),
-            masked_positions)
+            deterministic=deterministic,
+            output_hidden_states=output_hidden_states,
+            output_attentions=output_attentions, return_dict=True)
+        scores, seq_rel = ErniePretrainingHeads(
+            self.config, name="heads")(
+            outputs.last_hidden_state, outputs.pooler_output,
+            _tied_word_embeddings(self.variables), masked_positions)
+        total_loss = None
+        if labels is not None and next_sentence_label is not None:
+            # reference :600-609: CrossEntropyLoss() on both heads
+            # (default ignore_index -100)
+            total_loss = _mean_ce_ignore(scores, labels, -100) + \
+                _mean_ce_ignore(seq_rel, next_sentence_label, -100)
+        if not return_dict:
+            out = (scores, seq_rel)
+            if output_hidden_states:
+                out = out + (outputs.hidden_states,)
+            if output_attentions:
+                out = out + (outputs.attentions,)
+            return ((total_loss,) + out) if total_loss is not None \
+                else out
+        from .model_outputs import ErnieForPreTrainingOutput
+        return ErnieForPreTrainingOutput(
+            loss=total_loss, prediction_logits=scores,
+            seq_relationship_logits=seq_rel,
+            hidden_states=outputs.hidden_states,
+            attentions=outputs.attentions)
 
 
 class ErnieForMaskedLM(nn.Module):
     """MLM-only head (reference ``ErnieOnlyMLMHead``/``ErnieForMaskedLM``
-    :696-843); returns prediction scores."""
+    :696-843); returns prediction scores, with loss/typed-output forms
+    matching the reference's ``labels``/``return_dict`` branches."""
     config: ErnieConfig
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, position_ids=None,
-                 attention_mask=None, deterministic: bool = True):
-        seq_out, _pooled = ErnieModel(self.config, name="ernie")(
+                 attention_mask=None, labels=None,
+                 deterministic: bool = True,
+                 output_hidden_states: bool = False,
+                 output_attentions: bool = False,
+                 return_dict: bool = False):
+        outputs = ErnieModel(self.config, name="ernie")(
             input_ids, token_type_ids, position_ids, attention_mask,
-            deterministic=deterministic)
-        return ErnieLMPredictionHead(self.config, name="predictions")(
-            seq_out, _tied_word_embeddings(self.variables))
+            deterministic=deterministic,
+            output_hidden_states=output_hidden_states,
+            output_attentions=output_attentions, return_dict=True)
+        scores = ErnieLMPredictionHead(self.config, name="predictions")(
+            outputs.last_hidden_state,
+            _tied_word_embeddings(self.variables))
+        loss = None
+        if labels is not None:
+            # reference :794-800: CrossEntropyLoss() — "-100 index =
+            # padding token"
+            loss = _mean_ce_ignore(scores, labels, -100)
+        if not return_dict:
+            out = (scores,)
+            if output_hidden_states:
+                out = out + (outputs.hidden_states,)
+            if output_attentions:
+                out = out + (outputs.attentions,)
+            if loss is not None:
+                return (loss,) + out
+            return out[0] if len(out) == 1 else out
+        from .model_outputs import MaskedLMOutput
+        return MaskedLMOutput(
+            loss=loss, logits=scores,
+            hidden_states=outputs.hidden_states,
+            attentions=outputs.attentions)
 
 
 class ErnieForMultipleChoice(nn.Module):
@@ -336,17 +487,40 @@ class ErnieForMultipleChoice(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, position_ids=None,
-                 attention_mask=None, deterministic: bool = True):
+                 attention_mask=None, labels=None,
+                 deterministic: bool = True,
+                 output_hidden_states: bool = False,
+                 output_attentions: bool = False,
+                 return_dict: bool = False):
         b, c, s = input_ids.shape
         flat = lambda t: None if t is None else t.reshape(b * c, s)  # noqa: E731
-        _seq, pooled = ErnieModel(self.config, name="ernie")(
+        outputs = ErnieModel(self.config, name="ernie")(
             flat(input_ids), flat(token_type_ids), flat(position_ids),
-            flat(attention_mask), deterministic=deterministic)
+            flat(attention_mask), deterministic=deterministic,
+            output_hidden_states=output_hidden_states,
+            output_attentions=output_attentions, return_dict=True)
         pooled = nn.Dropout(self.config.hidden_dropout_prob)(
-            pooled, deterministic=deterministic)
+            outputs.pooler_output, deterministic=deterministic)
         logits = _dense(self.config, 1, "classifier",
                         ("embed",), (None,))(pooled)
-        return logits.reshape(b, c)
+        logits = logits.reshape(b, c)
+        loss = None
+        if labels is not None:
+            loss = _mean_ce_ignore(logits, labels, -100)
+        if not return_dict:
+            out = (logits,)
+            if output_hidden_states:
+                out = out + (outputs.hidden_states,)
+            if output_attentions:
+                out = out + (outputs.attentions,)
+            if loss is not None:
+                return (loss,) + out
+            return out[0] if len(out) == 1 else out
+        from .model_outputs import MultipleChoiceModelOutput
+        return MultipleChoiceModelOutput(
+            loss=loss, logits=logits,
+            hidden_states=outputs.hidden_states,
+            attentions=outputs.attentions)
 
 
 def ernie_pretraining_loss(

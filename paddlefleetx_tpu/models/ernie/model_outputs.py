@@ -1,0 +1,122 @@
+"""Typed ERNIE model outputs (reference ``ernie/model_outputs.py``).
+
+The reference ships HF-style ``ModelOutput`` dataclasses with optional
+``hidden_states``/``attentions`` plumbing (reference
+``model_outputs.py:229-627``). TPU-first differences:
+
+- each class is a ``flax.struct.dataclass`` — a registered JAX pytree,
+  so a jitted forward can return it directly (the reference's
+  ``OrderedDict`` subclass with ``__post_init__`` reflection is a
+  Python-side construct XLA could not trace through);
+- optional fields are plain ``None`` when not requested (the pytree
+  just has no leaves there), so ``jax.jit`` sees a different static
+  structure per flag combination — which is exactly the XLA-friendly
+  behavior: each requested output set compiles once;
+- no ``past_key_values``/``cross_attentions`` content: ERNIE here is a
+  pure encoder (the reference carries those fields from its
+  transformers vendoring but its encoder never populates them); the
+  fields exist for API parity and stay ``None``.
+
+``to_tuple()`` matches the reference's tuple forms: non-``None``
+fields in declaration order.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.struct
+
+Array = Any
+ArrayTuple = Tuple[Array, ...]
+
+
+class _OutputMixin:
+    def to_tuple(self) -> tuple:
+        """Non-None fields in declaration order (the reference's
+        ``ModelOutput.to_tuple`` contract)."""
+        return tuple(getattr(self, f.name)
+                     for f in self.__dataclass_fields__.values()
+                     if getattr(self, f.name) is not None)
+
+    def __getitem__(self, k):
+        if isinstance(k, str):
+            v = getattr(self, k)
+            if v is None:
+                raise KeyError(k)
+            return v
+        return self.to_tuple()[k]
+
+    def keys(self):
+        return [f for f in self.__dataclass_fields__
+                if getattr(self, f) is not None]
+
+
+@flax.struct.dataclass
+class BaseModelOutputWithPoolingAndCrossAttentions(_OutputMixin):
+    """``ErnieModel`` output (reference ``model_outputs.py:388-435``)."""
+    last_hidden_state: Array = None
+    pooler_output: Array = None
+    past_key_values: Optional[ArrayTuple] = None
+    hidden_states: Optional[ArrayTuple] = None
+    attentions: Optional[ArrayTuple] = None
+    cross_attentions: Optional[ArrayTuple] = None
+
+
+@flax.struct.dataclass
+class ErnieForPreTrainingOutput(_OutputMixin):
+    """``ErnieForPretraining`` output. The reference declares this
+    shape but its ``return_dict=True`` branch is commented out
+    (``single_model.py:610-622`` falls through and returns ``None``);
+    here it works."""
+    loss: Optional[Array] = None
+    prediction_logits: Array = None
+    seq_relationship_logits: Array = None
+    hidden_states: Optional[ArrayTuple] = None
+    attentions: Optional[ArrayTuple] = None
+
+
+@flax.struct.dataclass
+class MaskedLMOutput(_OutputMixin):
+    """``ErnieForMaskedLM`` output (reference :558-585)."""
+    loss: Optional[Array] = None
+    logits: Array = None
+    hidden_states: Optional[ArrayTuple] = None
+    attentions: Optional[ArrayTuple] = None
+
+
+@flax.struct.dataclass
+class MultipleChoiceModelOutput(_OutputMixin):
+    """``ErnieForMultipleChoice`` output (reference :527-556)."""
+    loss: Optional[Array] = None
+    logits: Array = None
+    hidden_states: Optional[ArrayTuple] = None
+    attentions: Optional[ArrayTuple] = None
+
+
+@flax.struct.dataclass
+class SequenceClassifierOutput(_OutputMixin):
+    """Reference :437-464 (declared for downstream heads)."""
+    loss: Optional[Array] = None
+    logits: Array = None
+    hidden_states: Optional[ArrayTuple] = None
+    attentions: Optional[ArrayTuple] = None
+
+
+@flax.struct.dataclass
+class TokenClassifierOutput(_OutputMixin):
+    """Reference :466-493 (declared for downstream heads)."""
+    loss: Optional[Array] = None
+    logits: Array = None
+    hidden_states: Optional[ArrayTuple] = None
+    attentions: Optional[ArrayTuple] = None
+
+
+@flax.struct.dataclass
+class QuestionAnsweringModelOutput(_OutputMixin):
+    """Reference :495-525 (declared for downstream heads)."""
+    loss: Optional[Array] = None
+    start_logits: Array = None
+    end_logits: Array = None
+    hidden_states: Optional[ArrayTuple] = None
+    attentions: Optional[ArrayTuple] = None

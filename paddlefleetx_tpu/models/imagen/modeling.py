@@ -280,6 +280,64 @@ class ImagenModel(nn.Module):
         (x, _), _ = jax.lax.scan(step, (x0, loop_rng), time_pairs)
         return self._unnormalize(jnp.clip(x, -1.0, 1.0))
 
+    def sample(self, text_embeds=None, text_masks=None,
+               batch_size: int = 1, cond_scale=1.0,
+               stop_at_unet_number: int = None,
+               return_all_unet_outputs: bool = False):
+        """Full-cascade text->image sampling (reference
+        ``modeling.py:506-580``): walk every stage in order, feeding
+        each stage's output into the next stage's low-res conditioning
+        (``sample_stage`` resizes it to the stage resolution,
+        normalizes, and applies the ``lowres_sample_noise_level``
+        augmentation noise exactly as the reference does before the
+        denoising loop). ``cond_scale`` is a scalar or a per-stage
+        sequence (reference ``cast_tuple(cond_scale, num_unets)``);
+        ``stop_at_unet_number`` truncates the cascade; by default the
+        final stage's image (in [0, 1], NHWC — the TPU-native layout
+        every stage here samples in; the reference returns NCHW)
+        returns, or every stage's with ``return_all_unet_outputs``.
+
+        Call via ``model.apply(..., method="sample",
+        rngs={"diffusion": key})``. The loop over stages is a Python
+        loop over distinct compiled programs (each stage has its own
+        resolution — static shapes per stage is the XLA-friendly
+        structure; the reference loops the same way, swapping unets
+        onto the GPU per stage)."""
+        cfg = self.config
+        if cfg.condition_on_text and text_embeds is None:
+            raise ValueError(
+                "text embeddings must be passed when the cascade is "
+                "text-conditional (reference sample() asserts the "
+                "same)")
+        if not cfg.condition_on_text and text_embeds is not None:
+            raise ValueError(
+                "imagen specified not to be conditioned on text, yet "
+                "text embeddings were passed")
+        if text_embeds is not None:
+            if text_embeds.shape[-1] != cfg.text_embed_dim:
+                raise ValueError(
+                    f"text embedding dim {text_embeds.shape[-1]} != "
+                    f"configured {cfg.text_embed_dim}")
+            batch_size = text_embeds.shape[0]
+            if text_masks is None:
+                # reference: default mask = any(embed != 0)
+                text_masks = jnp.any(text_embeds != 0.0, axis=-1)
+        n = len(self.unets)
+        if stop_at_unet_number is not None:
+            n = min(n, int(stop_at_unet_number))
+        scales = _per_unet(cond_scale, len(self.unets))
+        img = None
+        outputs = []
+        for u in range(1, n + 1):
+            size = cfg.image_sizes[u - 1]
+            shape = (batch_size, size, size, cfg.in_chans)
+            img = self.sample_stage(
+                u, shape, text_embeds=text_embeds,
+                text_masks=text_masks, lowres_img=img,
+                cond_scale=scales[u - 1])
+            outputs.append(img)
+        return outputs if return_all_unet_outputs else img
+
 
 def imagen_criterion(pred, target, log_snr, p2_gamma,
                      name: str = "mse_loss", p2_loss_weight_k: float = 1.0):

@@ -234,3 +234,123 @@ def test_ernie_345M_config_parses():
     from paddlefleetx_tpu.models.ernie.config import ErnieConfig
     mc = ErnieConfig.from_config(cfg)
     assert mc.hidden_size == 1024 and mc.num_attention_heads == 1
+
+
+def test_output_dataclasses_and_plumbing():
+    """VERDICT r3 #8 (reference model_outputs.py): hidden-states /
+    attentions / return_dict plumbing on ErnieModel and the heads.
+    Typed outputs must agree exactly with the tuple forms, collect
+    L+1 hidden states and L attention maps, and the attention maps
+    must be genuine post-softmax rows (sum to 1, mask respected)."""
+    from paddlefleetx_tpu.models.ernie import (
+        BaseModelOutputWithPoolingAndCrossAttentions, ErnieModel,
+        MaskedLMOutput,
+    )
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(1, 64, (2, 16)), jnp.int32)
+    mask = jnp.ones((2, 16), jnp.int32).at[1, 12:].set(0)
+    model = ErnieModel(CFG)
+    params = _init_params(model, ids)
+
+    seq, pooled = model.apply({"params": params}, ids,
+                              attention_mask=mask)
+    out = model.apply({"params": params}, ids, attention_mask=mask,
+                      output_hidden_states=True,
+                      output_attentions=True, return_dict=True)
+    assert isinstance(out, BaseModelOutputWithPoolingAndCrossAttentions)
+    # the attentions path computes softmax(QK)V inline (op order
+    # differs from dot_product_attention) — allclose, not bit-equal
+    np.testing.assert_allclose(np.asarray(out.last_hidden_state),
+                               np.asarray(seq), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(out.pooler_output),
+                               np.asarray(pooled), atol=2e-5)
+    # L+1 hidden states: embeddings + each block; last == sequence out
+    assert len(out.hidden_states) == CFG.num_hidden_layers + 1
+    np.testing.assert_allclose(np.asarray(out.hidden_states[-1]),
+                               np.asarray(seq), atol=2e-5)
+    assert len(out.attentions) == CFG.num_hidden_layers
+    a = np.asarray(out.attentions[0])
+    assert a.shape == (2, CFG.num_attention_heads, 16, 16)
+    np.testing.assert_allclose(a.sum(-1), 1.0, atol=1e-5)
+    # masked keys get ~zero probability everywhere
+    assert a[1, :, :, 12:].max() < 1e-3
+    assert out.past_key_values is None and out.cross_attentions is None
+    # tuple form carries the same extras in reference order
+    tup = model.apply({"params": params}, ids, attention_mask=mask,
+                      output_hidden_states=True, output_attentions=True)
+    assert len(tup) == 4
+    np.testing.assert_allclose(np.asarray(tup[2][-1]),
+                               np.asarray(seq), atol=2e-5)
+    # dict-order helpers
+    assert out.keys()[0] == "last_hidden_state"
+    assert np.asarray(out["pooler_output"]).shape == (2, CFG.hidden_size)
+
+    # the flags also work under the layer scan == unrolled agreement
+    import dataclasses
+    unrolled = ErnieModel(dataclasses.replace(CFG, scan_layers=False))
+    # (separate params: structure differs between scan/unrolled)
+    up = _init_params(unrolled, ids)
+    uout = unrolled.apply({"params": up}, ids, attention_mask=mask,
+                          output_hidden_states=True,
+                          output_attentions=True, return_dict=True)
+    assert len(uout.hidden_states) == CFG.num_hidden_layers + 1
+    assert len(uout.attentions) == CFG.num_hidden_layers
+
+    # MaskedLM head: loss + typed output, ignore_index=-100 per the
+    # reference's CrossEntropyLoss default
+    mlm = ErnieForMaskedLM(CFG)
+    mp = _init_params(mlm, ids)
+    labels = jnp.full((2, 16), -100, jnp.int32).at[:, :4].set(
+        ids[:, :4])
+    mout = mlm.apply({"params": mp}, ids, attention_mask=mask,
+                     labels=labels, return_dict=True)
+    assert isinstance(mout, MaskedLMOutput)
+    assert np.isfinite(float(mout.loss))
+    loss_tup = mlm.apply({"params": mp}, ids, attention_mask=mask,
+                         labels=labels)
+    np.testing.assert_allclose(float(loss_tup[0]), float(mout.loss))
+    # loss ignores -100 positions: all-ignored labels give loss on
+    # nothing (0 by the guarded mean)
+    zout = mlm.apply({"params": mp}, ids, attention_mask=mask,
+                     labels=jnp.full((2, 16), -100, jnp.int32),
+                     return_dict=True)
+    assert float(zout.loss) == 0.0
+
+    # typed outputs are jit-compatible pytrees
+    jout = jax.jit(lambda p: mlm.apply(
+        {"params": p}, ids, attention_mask=mask, labels=labels,
+        return_dict=True))(mp)
+    np.testing.assert_allclose(float(jout.loss), float(mout.loss),
+                               rtol=1e-6)
+
+
+def test_pretraining_and_multichoice_outputs():
+    from paddlefleetx_tpu.models.ernie import (
+        ErnieForPreTrainingOutput, MultipleChoiceModelOutput,
+    )
+    ids = jnp.asarray(
+        np.random.default_rng(3).integers(1, 64, (2, 16)), jnp.int32)
+    model = ErnieForPretraining(CFG)
+    params = _init_params(model, ids)
+    labels = jnp.where(jnp.arange(16) < 3, ids, -100)
+    nsp = jnp.asarray([0, 1], jnp.int32)
+    out = model.apply({"params": params}, ids, labels=labels,
+                      next_sentence_label=nsp, return_dict=True)
+    assert isinstance(out, ErnieForPreTrainingOutput)
+    assert np.isfinite(float(out.loss))
+    assert out.prediction_logits.shape == (2, 16, 64)
+    assert out.seq_relationship_logits.shape == (2, 2)
+    tup = model.apply({"params": params}, ids, labels=labels,
+                      next_sentence_label=nsp)
+    assert len(tup) == 3  # (loss, scores, seq_rel)
+    np.testing.assert_allclose(float(tup[0]), float(out.loss))
+
+    mc = ErnieForMultipleChoice(CFG, num_choices=2)
+    cids = jnp.stack([ids, ids], axis=1)  # [b, 2, s]
+    cp = _init_params(mc, cids)
+    mout = mc.apply({"params": cp}, cids,
+                    labels=jnp.asarray([0, 1], jnp.int32),
+                    return_dict=True)
+    assert isinstance(mout, MultipleChoiceModelOutput)
+    assert mout.logits.shape == (2, 2)
+    assert np.isfinite(float(mout.loss))

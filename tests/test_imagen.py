@@ -483,3 +483,59 @@ def test_imagen_fp16o2_runs_bf16_unet_fp32_params():
     loss = module.loss_fn(variables["params"], (images, emb, mask),
                           jax.random.key(3))
     assert loss.dtype == jnp.float32 and np.isfinite(float(loss))
+
+
+def test_full_cascade_sample():
+    """VERDICT r3 #3 (reference modeling.py:506-580): one sample()
+    call walks the whole cascade, feeding each stage's output into
+    the next stage's low-res conditioning, and returns the final
+    resolution. Two tiny stages 8 -> 16."""
+    model = tiny_imagen(unets=("Unet64_397M", "Unet64_397M"),
+                        image_sizes=(8, 16))
+    images = jnp.asarray(
+        np.random.default_rng(0).uniform(0, 1, (2, 3, 16, 16)),
+        jnp.float32)
+    emb = jnp.asarray(np.random.default_rng(1).normal(size=(2, 6, 32)),
+                      jnp.float32)
+    mask = jnp.ones((2, 6), jnp.int32)
+    # each stage trains (and initializes) separately, like the
+    # reference's per-unet training; sampling needs both stages'
+    # params merged — the checkpoint-merge a real cascade deploy does
+    v1 = model.init(
+        {"params": jax.random.key(0), "diffusion": jax.random.key(1)},
+        images, emb, mask, unet_number=1)
+    v2 = model.init(
+        {"params": jax.random.key(0), "diffusion": jax.random.key(1)},
+        images, emb, mask, unet_number=2)
+    variables = {"params": {**v1["params"], **v2["params"]}}
+
+    out = model.apply(
+        variables, text_embeds=emb, text_masks=mask,
+        cond_scale=(1.0, 3.0),  # per-stage guidance like the reference
+        method="sample", rngs={"diffusion": jax.random.key(5)})
+    assert out.shape == (2, 16, 16, 3)
+    assert 0.0 <= float(out.min()) and float(out.max()) <= 1.0
+
+    # every stage's output on request, resolutions ascending
+    outs = model.apply(
+        variables, text_embeds=emb, text_masks=mask,
+        return_all_unet_outputs=True,
+        method="sample", rngs={"diffusion": jax.random.key(5)})
+    assert [o.shape for o in outs] == [(2, 8, 8, 3), (2, 16, 16, 3)]
+    # stage-1 output of sample() == a direct sample_stage call with
+    # the same rng stream (the cascade really starts from stage 1)
+    direct = model.apply(
+        variables, 1, (2, 8, 8, 3), emb, mask,
+        method="sample_stage", rngs={"diffusion": jax.random.key(5)})
+    np.testing.assert_allclose(np.asarray(outs[0]), np.asarray(direct),
+                               atol=1e-6)
+
+    # default text mask derivation + truncation
+    trunc = model.apply(
+        variables, text_embeds=emb, stop_at_unet_number=1,
+        method="sample", rngs={"diffusion": jax.random.key(6)})
+    assert trunc.shape == (2, 8, 8, 3)
+
+    with pytest.raises(ValueError, match="text"):
+        model.apply(variables, method="sample",
+                    rngs={"diffusion": jax.random.key(7)})

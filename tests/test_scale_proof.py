@@ -92,3 +92,22 @@ def test_175B_mp8_pp16_topology_on_128_device_mesh(tmp_path):
             "Global.global_batch_size=16", "Global.local_batch_size=16",
             "Global.micro_batch_size=1",
         ])
+
+
+def test_6_7B_v5p64_topology_on_64_device_mesh(tmp_path):
+    """The v5p-64 north-star recipe (mp4 x fsdp16 ZeRO-3 + Megatron-SP
+    + flash + chunked loss) executes its full 64-chip topology
+    (VERDICT r3 #2 done-criterion)."""
+    _run_scale_proof(
+        tmp_path, "gpt_6.7B_v5p64_scaled",
+        "configs/nlp/gpt/pretrain_gpt_6.7B_v5p64.yaml",
+        devices=64, max_steps=3,
+        shrink_overrides=[
+            "Model.num_layers=4", "Model.hidden_size=128",
+            "Model.num_attention_heads=4", "Model.ffn_hidden_size=256",
+            "Model.loss_chunks=2",
+            "Global.global_batch_size=32",
+            "Global.local_batch_size=2",
+            "Global.micro_batch_size=1",
+            "Engine.accumulate_steps=2",
+        ])
