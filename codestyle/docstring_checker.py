@@ -209,7 +209,10 @@ def check_source(source: str, path: str = "<string>") -> List[Finding]:
                     doc is None or len(doc) < MIN_DOC_CHARS):
                 findings.append(Finding(
                     path, node.lineno, "D003",
-                    f"public function {node.name!r} missing docstring"))
+                    f"public function {node.name!r} missing docstring"
+                    if doc is None else
+                    f"public function {node.name!r} docstring too "
+                    f"short (< {MIN_DOC_CHARS} chars)"))
             elif doc is not None:
                 findings.extend(_doc_findings(node, doc, path))
                 if n_lines > MAX_UNDOCUMENTED_LINES:
@@ -237,6 +240,13 @@ def main(argv=None) -> int:
                   "<paths...>", file=sys.stderr)
             return 2
         select = set(args[i + 1].split(","))
+        known = {f"D{n:03d}" for n in range(1, 11)}
+        bad = select - known
+        if bad:
+            # a typo'd code would otherwise silently disable the rule
+            print(f"unknown rule code(s): {sorted(bad)}; known: "
+                  f"{sorted(known)}", file=sys.stderr)
+            return 2
         del args[i:i + 2]
     findings: List[Finding] = []
     for target in args:

@@ -226,10 +226,15 @@ class ImagenModel(nn.Module):
 
     def sample_stage(self, unet_number: int, shape,
                      text_embeds=None, text_masks=None,
-                     lowres_img=None, cond_scale: float = 1.0):
+                     lowres_img=None, cond_scale: float = 1.0,
+                     skip_steps: int = 0):
         """Ancestral sampling for one cascade stage; returns images in
         [0, 1]. Call via ``model.apply(..., method="sample_stage",
-        rngs={"diffusion": key})``."""
+        rngs={"diffusion": key})``. ``skip_steps`` drops the first
+        (noisiest) timestep pairs (reference ``p_sample_loop``
+        ``timesteps[skip_steps:]``, ``modeling.py:451-452``) — a
+        static slice, so each skip count is its own compiled
+        program."""
         cfg = self.config
         i = unet_number - 1
         scheduler = self.schedules[i]
@@ -249,6 +254,15 @@ class ImagenModel(nn.Module):
 
         x0 = jax.random.normal(init_rng, tuple(shape), jnp.float32)
         time_pairs = scheduler.get_sampling_timesteps(b)  # [T, 2, b]
+        if skip_steps:
+            skip_steps = int(skip_steps)
+            if not 0 <= skip_steps < time_pairs.shape[0]:
+                # a silent negative/oversized slice would return
+                # shape-valid garbage (raw or one-step-denoised noise)
+                raise ValueError(
+                    f"skip_steps={skip_steps} out of range for "
+                    f"{time_pairs.shape[0]} sampling steps")
+            time_pairs = time_pairs[skip_steps:]
 
         def step(carry, tp):
             x, k = carry
@@ -282,6 +296,7 @@ class ImagenModel(nn.Module):
 
     def sample(self, text_embeds=None, text_masks=None,
                batch_size: int = 1, cond_scale=1.0,
+               skip_steps=None,
                stop_at_unet_number: int = None,
                return_all_unet_outputs: bool = False):
         """Full-cascade text->image sampling (reference
@@ -297,12 +312,25 @@ class ImagenModel(nn.Module):
         every stage here samples in; the reference returns NCHW)
         returns, or every stage's with ``return_all_unet_outputs``.
 
+        ``skip_steps`` (scalar or per-stage) drops the noisiest
+        timestep pairs per stage like the reference's
+        ``timesteps[skip_steps:]``.
+
         Call via ``model.apply(..., method="sample",
         rngs={"diffusion": key})``. The loop over stages is a Python
         loop over distinct compiled programs (each stage has its own
         resolution — static shapes per stage is the XLA-friendly
         structure; the reference loops the same way, swapping unets
-        onto the GPU per stage)."""
+        onto the GPU per stage).
+
+        Deliberately NOT ported from the reference ``sample()``
+        signature: ``init_images`` (accepted but never read by the
+        reference — ``p_sample_loop`` ignores it and always starts
+        from noise, ``modeling.py:425,432``), ``cond_images``
+        (channel-concat image conditioning; ``cond_images_channels``
+        is 0 in every shipped reference config, so no recipe can
+        exercise it) and inpainting (same: no shipped config/task
+        drives ``inpaint_images``)."""
         cfg = self.config
         if cfg.condition_on_text and text_embeds is None:
             raise ValueError(
@@ -326,6 +354,8 @@ class ImagenModel(nn.Module):
         if stop_at_unet_number is not None:
             n = min(n, int(stop_at_unet_number))
         scales = _per_unet(cond_scale, len(self.unets))
+        skips = _per_unet(skip_steps if skip_steps is not None else 0,
+                          len(self.unets))
         img = None
         outputs = []
         for u in range(1, n + 1):
@@ -334,7 +364,8 @@ class ImagenModel(nn.Module):
             img = self.sample_stage(
                 u, shape, text_embeds=text_embeds,
                 text_masks=text_masks, lowres_img=img,
-                cond_scale=scales[u - 1])
+                cond_scale=scales[u - 1],
+                skip_steps=int(skips[u - 1]))
             outputs.append(img)
         return outputs if return_all_unet_outputs else img
 

@@ -1,0 +1,106 @@
+"""One-shot flash-attention tuning sweep for the real chip.
+
+Times the Pallas kernel at the bench operating points across block
+sizes, against dense XLA attention, fwd and fwd+bwd — one run prints
+the whole decision table, so a returning/scarce TPU allocation yields
+the full tuning picture in a single session (VERDICT r3 #4: the d=64
+exp path is the named single-chip MFU floor).
+
+Usage (TPU): ``python scripts/tune_flash.py [--points 345m,longctx,67b]``
+"""
+
+import argparse
+import functools
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+POINTS = {
+    # (batch, heads, seq, head_dim) per microbatch at the bench points
+    "345m": (8, 16, 1024, 64),
+    "longctx": (1, 16, 8192, 64),
+    "67b": (2, 32, 2048, 128),
+}
+
+
+def _time(fn, *args, reps=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps * 1e3
+
+
+def sweep(point: str, b: int, h: int, s: int, d: int):
+    """Print ms for kernel block-size variants + dense, fwd and
+    value_and_grad, at one operating point."""
+    from paddlefleetx_tpu.ops.attention import _xla_attention
+    from paddlefleetx_tpu.ops.pallas import flash_attention as fa
+
+    rng = np.random.default_rng(0)
+    shape = (b, s, h, d)
+    q = jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
+
+    def flash_loss(q, k, v, bq, bkv):
+        o = fa.flash_attention(q, k, v, causal=True, block_q=bq,
+                               block_kv=bkv)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def dense_loss(q, k, v):
+        o = _xla_attention(q, k, v, None, True, 0, 0.0, None, True,
+                           True, kv_cache_layout=False)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    print(f"== {point}: b={b} h={h} s={s} d={d} (bf16) ==")
+    blocks = sorted({min(512, s), min(1024, s), min(2048, s)})
+    for bq in blocks:
+        for bkv in blocks:
+            if s % bq or s % bkv:
+                continue
+            try:
+                fwd = _time(jax.jit(functools.partial(
+                    fa.flash_attention, causal=True, block_q=bq,
+                    block_kv=bkv)), q, k, v)
+                vag = _time(jax.jit(jax.grad(functools.partial(
+                    flash_loss, bq=bq, bkv=bkv), argnums=(0, 1, 2))),
+                    q, k, v)
+                print(f"  flash bq={bq:5d} bkv={bkv:5d}: "
+                      f"fwd {fwd:7.3f} ms   fwd+bwd {vag:7.3f} ms")
+            except Exception as e:
+                print(f"  flash bq={bq:5d} bkv={bkv:5d}: FAILED "
+                      f"({type(e).__name__}: {str(e)[:80]})")
+    try:
+        fwd = _time(jax.jit(lambda q, k, v: _xla_attention(
+            q, k, v, None, True, 0, 0.0, None, True, True,
+            kv_cache_layout=False)), q, k, v)
+        vag = _time(jax.jit(jax.grad(dense_loss, argnums=(0, 1, 2))),
+                    q, k, v)
+        print(f"  dense XLA          : fwd {fwd:7.3f} ms   "
+              f"fwd+bwd {vag:7.3f} ms")
+    except Exception as e:
+        print(f"  dense XLA          : FAILED ({str(e)[:80]})")
+
+
+def main():
+    """Run the sweep at the selected operating points."""
+    p = argparse.ArgumentParser()
+    p.add_argument("--points", default="345m,longctx,67b")
+    args = p.parse_args()
+    d = jax.devices()[0]
+    print(f"device: {d.platform} {d.device_kind}")
+    for point in args.points.split(","):
+        b, h, s, hd = POINTS[point.strip()]
+        sweep(point.strip(), b, h, s, hd)
+
+
+if __name__ == "__main__":
+    main()

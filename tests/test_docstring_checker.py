@@ -144,3 +144,21 @@ def test_select_filter(tmp_path):
     p.write_text("x = 1\n")
     assert [f.code for f in dc.check_file(str(p))] == ["D001"]
     assert dc.check_file(str(p), select={"D005"}) == []
+
+
+def test_slow_tier_patterns_exist():
+    """Every _SLOW_PATTERNS entry refers to a real file (and test
+    function) so the quick-tier list cannot rot silently."""
+    import re
+
+    import conftest
+    here = os.path.dirname(__file__)
+    for p in conftest._SLOW_PATTERNS:
+        fname = p.split("::")[0]
+        path = os.path.join(here, fname)
+        assert os.path.exists(path), f"slow-tier file missing: {p}"
+        if "::" in p:
+            name = p.split("::", 1)[1]
+            src = open(path).read()
+            assert re.search(rf"^def {re.escape(name)}\(", src,
+                             re.M), f"slow-tier test missing: {p}"

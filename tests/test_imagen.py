@@ -539,3 +539,33 @@ def test_full_cascade_sample():
     with pytest.raises(ValueError, match="text"):
         model.apply(variables, method="sample",
                     rngs={"diffusion": jax.random.key(7)})
+
+
+def test_sample_skip_steps():
+    """skip_steps drops the noisiest timestep pairs (reference
+    p_sample_loop timesteps[skip_steps:]): fewer denoise iterations,
+    same shapes; skipping everything but one step still returns a
+    valid [0, 1] image."""
+    model = tiny_imagen()
+    images = jnp.asarray(
+        np.random.default_rng(0).uniform(0, 1, (2, 3, 16, 16)),
+        jnp.float32)
+    emb = jnp.asarray(np.random.default_rng(1).normal(size=(2, 6, 32)),
+                      jnp.float32)
+    mask = jnp.ones((2, 6), jnp.int32)
+    variables = model.init(
+        {"params": jax.random.key(0), "diffusion": jax.random.key(1)},
+        images, emb, mask)
+    n_steps = model.config.timesteps if isinstance(
+        model.config.timesteps, int) else model.config.timesteps[0]
+    full = model.apply(
+        variables, 1, (2, 16, 16, 3), emb, mask,
+        method="sample_stage", rngs={"diffusion": jax.random.key(2)})
+    skipped = model.apply(
+        variables, 1, (2, 16, 16, 3), emb, mask,
+        skip_steps=n_steps - 1,
+        method="sample_stage", rngs={"diffusion": jax.random.key(2)})
+    assert skipped.shape == full.shape == (2, 16, 16, 3)
+    for out in (full, skipped):
+        assert 0.0 <= float(out.min()) and float(out.max()) <= 1.0
+    assert not np.array_equal(np.asarray(full), np.asarray(skipped))
