@@ -140,3 +140,27 @@ def test_is_transient_classification():
     assert bench._is_transient("Unable to initialize backend 'axon'")
     assert not bench._is_transient("ValueError: bad shape")
     assert not bench._is_transient("ImportError: no module")
+
+
+def test_measure_train_bf16_accum_tracks_fp32():
+    """Smoke both gradient-accumulation dtypes of the bench step (the
+    6.7B ladder's bf16 memory knob and the default fp32): the shared
+    step math must compile and run on the same tiny config."""
+    import jax.numpy as jnp
+
+    from paddlefleetx_tpu.models.gpt import GPTConfig
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_attention_heads=4, max_position_embeddings=32,
+                    hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0,
+                    scan_layers=False)
+    # _measure_train returns throughput; numerics are pinned by
+    # monkeypatching nothing — instead run both variants and assert
+    # they complete (the shared step math is exercised; exact loss
+    # equality across dtypes is not expected)
+    tps32 = bench._measure_train(cfg, 2, 16, 4, 2, False,
+                                 grad_dtype=jnp.float32)
+    tps16 = bench._measure_train(cfg, 2, 16, 4, 2, False,
+                                 grad_dtype=jnp.bfloat16)
+    assert tps32 > 0 and tps16 > 0
