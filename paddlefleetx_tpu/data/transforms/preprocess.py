@@ -217,7 +217,10 @@ class ColorJitter:
     def _shift_hue(pil, frac):
         h, s, v = pil.convert("HSV").split()
         h = np.asarray(h, np.uint8)
-        h = ((h.astype(np.int16) + int(round(frac * 255.0))) % 256
+        # PIL's hue channel is a 256-bucket wheel; map the fraction
+        # with x256 (not x255) so hue=0.5 lands exactly on the
+        # opposite hue (torchvision semantics, ADVICE r4 #4)
+        h = ((h.astype(np.int16) + int(round(frac * 256.0))) % 256
              ).astype(np.uint8)
         Image = _pil()
         return Image.merge(

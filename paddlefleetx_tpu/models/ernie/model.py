@@ -184,7 +184,12 @@ class ErnieEncoderLayer(nn.Module):
     (not call args) so they survive ``nn.scan``/``nn.remat`` without
     touching the traced signature; the scanned form emits per-layer
     ``(hidden?, attention?)`` as scan ys, which the model splits into
-    the reference's tuples."""
+    the reference's tuples.
+
+    Return type (non-scanned): a bare ``[b, s, h]`` array — the
+    original public contract — unless ``output_attentions=True``, in
+    which case ``(x, probs)`` (opt-in, so existing callers are
+    unaffected)."""
     config: ErnieConfig
     scanned: bool = False
     collect_hidden: bool = False
@@ -213,7 +218,9 @@ class ErnieEncoderLayer(nn.Module):
         x = with_logical_constraint(x, ("batch", "seq", "act_embed"))
         if self.scanned:
             return x, (x if self.collect_hidden else None, probs)
-        return x, probs
+        if self.output_attentions:
+            return x, probs
+        return x
 
 
 class ErniePooler(nn.Module):
@@ -297,9 +304,10 @@ class ErnieModel(nn.Module):
                             for i in range(cfg.num_hidden_layers)]
         else:
             for i in range(cfg.num_hidden_layers):
-                x, probs = block(
+                out = block(
                     cfg, output_attentions=output_attentions,
                     name=f"encoder_{i}")(x, bias, deterministic)
+                x, probs = out if output_attentions else (out, None)
                 if output_hidden_states:
                     all_hidden.append(x)
                 if output_attentions:

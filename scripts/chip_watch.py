@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Watch for the TPU tunnel to come up, without ever hanging.
+
+Reuses ``bench.probe_once`` — PJRT client creation in a KILLABLE
+subprocess (the tunnel's known failure shape is an indefinite hang at
+client init; an in-process ``jax.devices()`` would wedge the watcher
+itself) — every ``--interval`` seconds, for at most ``--budget``
+seconds. Exits 0 the moment a probe reaches a real TPU (printing its
+device_kind), 3 if the budget expires without one. Used by the builder
+to trigger opportunistic ``bench.py`` runs (VERDICT r4 next #1) the
+moment a chip window opens.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from bench import probe_once  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--budget", type=float, default=540.0,
+                   help="total seconds to watch before giving up")
+    p.add_argument("--interval", type=float, default=60.0)
+    p.add_argument("--probe-timeout", type=float, default=75.0)
+    args = p.parse_args()
+    deadline = time.monotonic() + args.budget
+    n = 0
+    while time.monotonic() < deadline:
+        n += 1
+        info, err, _hang = probe_once(
+            min(args.probe_timeout,
+                max(10.0, deadline - time.monotonic())))
+        if info is not None and info.get("platform") == "tpu":
+            print(json.dumps({"up": True, "probes": n, **info}))
+            return 0
+        detail = (err.splitlines()[-1] if err
+                  else f"non-tpu platform {info}")
+        sys.stderr.write(f"probe {n}: {detail}\n")
+        time.sleep(min(args.interval,
+                       max(0.0, deadline - time.monotonic())))
+    print(json.dumps({"up": False, "probes": n}))
+    return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
