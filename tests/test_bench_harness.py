@@ -256,3 +256,27 @@ def test_convergence_oracle_passes_offline(capsys):
     assert rec["pass"] is True
     assert rec["loss_at_25"] > rec["value"]  # descent
     assert rec["value"] >= rec["bigram_entropy_floor"] - 0.05
+
+
+def test_measure_train_dropout_rng_threading():
+    """The reference-workload point (dropout 0.1, dense attention)
+    threads a per-microbatch folded dropout key through all three loss
+    branches; that plumbing must compile and run offline, not for the
+    first time inside bench_train's on-chip try/except."""
+    from paddlefleetx_tpu.models.gpt import GPTConfig
+
+    common = dict(vocab_size=64, hidden_size=32, num_layers=2,
+                  num_attention_heads=4, max_position_embeddings=32,
+                  hidden_dropout_prob=0.1,
+                  attention_probs_dropout_prob=0.1,
+                  use_flash_attention=False, scan_layers=False)
+    # plain CE, accumulation scan (acc>1) + single (acc=1)
+    cfg = GPTConfig(**common)
+    assert bench._measure_train(cfg, 2, 16, 4, 2, False) > 0
+    assert bench._measure_train(cfg, 2, 16, 1, 2, False) > 0
+    # chunked CE branch
+    cfg = GPTConfig(**common, loss_chunks=4)
+    assert bench._measure_train(cfg, 2, 16, 2, 2, False) > 0
+    # MoE branch (router aux losses under non-deterministic apply)
+    cfg = GPTConfig(**common, moe_num_experts=4, moe_top_k=2)
+    assert bench._measure_train(cfg, 2, 16, 2, 2, False) > 0
