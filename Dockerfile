@@ -1,0 +1,32 @@
+# Container recipe for a Cloud TPU VM (counterpart of the reference's
+# CUDA image build, reference `Dockerfile`; see
+# docs/environment_install.md for the non-container path and
+# docs/cluster_deployment.md for multi-host usage).
+#
+# Build:  docker build -t paddlefleetx-tpu .
+# Run  :  sudo docker run -it --rm --privileged --network host \
+#             paddlefleetx-tpu bash
+# `--privileged --network host` exposes the TPU device files and the
+# other hosts of a multi-host slice to the container (the equivalent
+# of the reference's nvidia-container-runtime step; no device runtime
+# is installed inside the image — the TPU driver lives on the VM).
+
+FROM python:3.11-slim
+
+WORKDIR /workspace
+
+# native toolchain for the C++ data-index helpers (data_tools/cpp)
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && rm -rf /var/lib/apt/lists/*
+
+RUN python -m pip install --no-cache-dir -U \
+        "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+        flax optax orbax-checkpoint chex einops numpy pyyaml pytest
+
+COPY . /workspace
+RUN python -m pip install --no-cache-dir -e .
+
+# sanity: import the package; TPU check happens at run time on the VM
+RUN python -c "import paddlefleetx_tpu"
+
+CMD ["python", "-c", "import jax; print(jax.devices())"]
