@@ -28,6 +28,48 @@ import jax.numpy as jnp
 from ...ops.attention import dot_product_attention
 
 
+def _conv(features: int, kernel, name: str, *, strides=None,
+          padding="SAME", kernel_init=None, shard: bool = True):
+    """``nn.Conv`` whose OUT-channel dim carries the logical ``embed``
+    axis (→ fsdp under ZeRO-3, ``parallel/sharding.py:43``): the SR
+    U-Nets' wide channel dims (up to dim x8 = 1024) shard instead of
+    replicating per device (VERDICT r4 #7; reference SR zoo
+    ``modeling.py:796-827`` relies on its sharding stage for the same
+    models). ``shard=False`` for tiny fan-outs (RGB head)."""
+    k_init = kernel_init or nn.linear.default_kernel_init
+    b_init = nn.initializers.zeros_init()
+    if shard:
+        k_init = nn.with_logical_partitioning(
+            k_init, (None, None, None, "embed"))
+        b_init = nn.with_logical_partitioning(b_init, ("embed",))
+    return nn.Conv(features, kernel, strides=strides, padding=padding,
+                   kernel_init=k_init, bias_init=b_init, name=name)
+
+
+def _attn_dense(features, name: str, axis=-1, use_bias: bool = False,
+                logical=("embed", "heads", "kv")):
+    """``nn.DenseGeneral`` with logical param axes (same idiom as
+    ``models/vit/vit.py:91-112``): ``heads`` → mp, and any ``embed``
+    axis → fsdp under ZeRO-3."""
+    return nn.DenseGeneral(
+        features, axis=axis, use_bias=use_bias, name=name,
+        kernel_init=nn.with_logical_partitioning(
+            nn.linear.default_kernel_init, logical))
+
+
+def _cond_dense(features: int, name: str):
+    """Dense for the time/text conditioning paths: the OUT dim carries
+    ``embed`` (fsdp under ZeRO-3); the IN dim stays unsharded — it can
+    be narrow (the 33-wide learned-sinusoidal embedding) where an fsdp
+    split would be uneven."""
+    return nn.Dense(
+        features, name=name,
+        kernel_init=nn.with_logical_partitioning(
+            nn.linear.default_kernel_init, (None, "embed")),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), ("embed",)))
+
+
 def _t(v, n: int) -> Tuple:
     """cast_tuple: scalar-or-seq -> length-n tuple."""
     if isinstance(v, (list, tuple)):
@@ -117,11 +159,11 @@ class PerceiverAttention(nn.Module):
         h, dh = cfg.attn_heads, cfg.attn_dim_head
         x = nn.LayerNorm(name="norm_media")(x)
         latents = nn.LayerNorm(name="norm_latents")(latents)
-        q = nn.DenseGeneral((h, dh), use_bias=False, name="to_q")(latents)
+        q = _attn_dense((h, dh), "to_q")(latents)
         # keys/values attend over media AND latents (reference :116)
         kv_in = jnp.concatenate([x, latents], axis=1)
-        k = nn.DenseGeneral((h, dh), use_bias=False, name="to_k")(kv_in)
-        v = nn.DenseGeneral((h, dh), use_bias=False, name="to_v")(kv_in)
+        k = _attn_dense((h, dh), "to_k")(kv_in)
+        v = _attn_dense((h, dh), "to_v")(kv_in)
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * dh ** -0.5
         if mask is not None:
             full_mask = jnp.concatenate(
@@ -132,15 +174,24 @@ class PerceiverAttention(nn.Module):
         attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1) \
             .astype(scores.dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
-        return nn.DenseGeneral(dim, axis=(-2, -1), use_bias=False,
-                               name="to_out")(out)
+        return _attn_dense(dim, "to_out", axis=(-2, -1),
+                           logical=("heads", "kv", "embed"))(out)
 
 
 def _ff(dim: int, mult: float, name: str):
+    zeros = nn.initializers.zeros_init()
     return nn.Sequential([
-        nn.Dense(int(dim * mult), name=f"{name}_in"),
+        nn.Dense(int(dim * mult), name=f"{name}_in",
+                 kernel_init=nn.with_logical_partitioning(
+                     nn.linear.default_kernel_init, ("embed", "mlp")),
+                 bias_init=nn.with_logical_partitioning(
+                     zeros, ("mlp",))),
         nn.gelu,
-        nn.Dense(dim, name=f"{name}_out"),
+        nn.Dense(dim, name=f"{name}_out",
+                 kernel_init=nn.with_logical_partitioning(
+                     nn.linear.default_kernel_init, ("mlp", "embed")),
+                 bias_init=nn.with_logical_partitioning(
+                     zeros, ("embed",))),
     ])
 
 
@@ -157,9 +208,9 @@ class CrossAttention(nn.Module):
         b = x.shape[0]
         xn = nn.LayerNorm(name="norm")(x)
         cn = nn.LayerNorm(name="norm_context")(context)
-        q = nn.DenseGeneral((h, dh), use_bias=False, name="to_q")(xn)
-        k = nn.DenseGeneral((h, dh), use_bias=False, name="to_k")(cn)
-        v = nn.DenseGeneral((h, dh), use_bias=False, name="to_v")(cn)
+        q = _attn_dense((h, dh), "to_q")(xn)
+        k = _attn_dense((h, dh), "to_k")(cn)
+        v = _attn_dense((h, dh), "to_v")(cn)
         null_kv = self.param("null_kv", nn.initializers.normal(0.02),
                              (2, dh))
         nk = jnp.broadcast_to(null_kv[0], (b, 1, h, dh))
@@ -174,8 +225,8 @@ class CrossAttention(nn.Module):
         attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1) \
             .astype(scores.dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
-        return nn.DenseGeneral(self.dim, axis=(-2, -1), use_bias=False,
-                               name="to_out")(out)
+        return _attn_dense(self.dim, "to_out", axis=(-2, -1),
+                           logical=("heads", "kv", "embed"))(out)
 
 
 class SelfAttention(nn.Module):
@@ -189,14 +240,14 @@ class SelfAttention(nn.Module):
         cfg = self.config
         h, dh = cfg.attn_heads, cfg.attn_dim_head
         xn = nn.LayerNorm(name="norm")(x)
-        q = nn.DenseGeneral((h, dh), use_bias=False, name="to_q")(xn)
-        k = nn.DenseGeneral((h, dh), use_bias=False, name="to_k")(xn)
-        v = nn.DenseGeneral((h, dh), use_bias=False, name="to_v")(xn)
+        q = _attn_dense((h, dh), "to_q")(xn)
+        k = _attn_dense((h, dh), "to_k")(xn)
+        v = _attn_dense((h, dh), "to_v")(xn)
         out = dot_product_attention(
             q, k, v, causal=False,
             use_flash=cfg.use_flash_attention)
-        return nn.DenseGeneral(self.dim, axis=(-2, -1), use_bias=False,
-                               name="to_out")(out)
+        return _attn_dense(self.dim, "to_out", axis=(-2, -1),
+                           logical=("heads", "kv", "embed"))(out)
 
 
 class TransformerBlock(nn.Module):
@@ -227,14 +278,18 @@ class ResnetBlock(nn.Module):
         groups = min(8, self.dim_out)
         scale_shift = None
         if time_emb is not None:
-            t = nn.Dense(self.dim_out * 2, name="time_mlp")(
-                nn.silu(time_emb))
+            t = nn.Dense(self.dim_out * 2, name="time_mlp",
+                         kernel_init=nn.with_logical_partitioning(
+                             nn.linear.default_kernel_init,
+                             (None, "embed")),
+                         bias_init=nn.with_logical_partitioning(
+                             nn.initializers.zeros_init(), ("embed",))
+                         )(nn.silu(time_emb))
             scale_shift = jnp.split(t[:, None, None, :], 2, axis=-1)
 
         h = nn.GroupNorm(num_groups=groups, name="norm1")(x)
         h = nn.silu(h)
-        h = nn.Conv(self.dim_out, (3, 3), padding="SAME",
-                    name="conv1")(h)
+        h = _conv(self.dim_out, (3, 3), "conv1")(h)
 
         if self.use_cross_attn:
             assert context is not None
@@ -249,11 +304,10 @@ class ResnetBlock(nn.Module):
             scale, shift = scale_shift
             h = h * (scale + 1) + shift
         h = nn.silu(h)
-        h = nn.Conv(self.dim_out, (3, 3), padding="SAME",
-                    name="conv2")(h)
+        h = _conv(self.dim_out, (3, 3), "conv2")(h)
 
         if x.shape[-1] != self.dim_out:
-            x = nn.Conv(self.dim_out, (1, 1), name="res_conv")(x)
+            x = _conv(self.dim_out, (1, 1), "res_conv")(x)
         return h + x
 
 
@@ -268,20 +322,20 @@ class CrossEmbedLayer(nn.Module):
         dims = [self.dim_out // (2 ** (i + 1)) for i in range(n)]
         dims[-1] = self.dim_out - sum(dims[:-1])
         outs = [
-            nn.Conv(d, (k, k), padding="SAME", name=f"conv_{k}")(x)
+            _conv(d, (k, k), f"conv_{k}")(x)
             for d, k in zip(dims, sorted(self.kernel_sizes))]
         return jnp.concatenate(outs, axis=-1)
 
 
 def _downsample(x, dim, name):
-    return nn.Conv(dim, (4, 4), strides=(2, 2), padding=((1, 1), (1, 1)),
-                   name=name)(x)
+    return _conv(dim, (4, 4), name, strides=(2, 2),
+                 padding=((1, 1), (1, 1)))(x)
 
 
 def _upsample(x, dim, name):
     b, h, w, c = x.shape
     x = jax.image.resize(x, (b, h * 2, w * 2, c), "nearest")
-    return nn.Conv(dim, (3, 3), padding="SAME", name=name)(x)
+    return _conv(dim, (3, 3), name)(x)
 
 
 class Unet(nn.Module):
@@ -311,22 +365,22 @@ class Unet(nn.Module):
         # -- time conditioning -----------------------------------------
         t = LearnedSinusoidalPosEmb(cfg.learned_sinu_dim,
                                     name="sinu_pos_emb")(time)
-        t = nn.Dense(time_cond_dim, name="time_mlp_in")(t)
+        t = _cond_dense(time_cond_dim, "time_mlp_in")(t)
         t = nn.silu(t)
-        t = nn.Dense(time_cond_dim, name="time_mlp_out")(t)
+        t = _cond_dense(time_cond_dim, "time_mlp_out")(t)
         if cfg.lowres_cond:
             lt = LearnedSinusoidalPosEmb(
                 cfg.learned_sinu_dim, name="lowres_sinu_pos_emb")(
                 lowres_noise_times)
-            lt = nn.Dense(time_cond_dim, name="lowres_time_in")(lt)
+            lt = _cond_dense(time_cond_dim, "lowres_time_in")(lt)
             lt = nn.silu(lt)
-            lt = nn.Dense(time_cond_dim, name="lowres_time_out")(lt)
+            lt = _cond_dense(time_cond_dim, "lowres_time_out")(lt)
             t = t + lt
 
         # -- text conditioning (+ null embeddings for CFG) --------------
         context = None
         if text_embeds is not None:
-            te = nn.Dense(cond_dim, name="text_to_cond")(text_embeds)
+            te = _cond_dense(cond_dim, "text_to_cond")(text_embeds)
             tokens = PerceiverResampler(cfg, name="resampler")(
                 te, text_mask)
             null_tokens = self.param(
@@ -343,8 +397,8 @@ class Unet(nn.Module):
             else:
                 pooled = jnp.mean(te, axis=1)
             pooled = nn.LayerNorm(name="text_pool_norm")(pooled)
-            pooled = nn.Dense(time_cond_dim, name="text_pool_proj")(
-                pooled)
+            pooled = _cond_dense(time_cond_dim,
+                                 "text_pool_proj")(pooled)
             if cond_drop_mask is not None:
                 keep = (~cond_drop_mask)[:, None]
                 tokens = jnp.where(keep[..., None], tokens,
@@ -397,9 +451,9 @@ class Unet(nn.Module):
 
         x = ResnetBlock(cfg, cfg.dim, name="final_block")(x, t)
         out_ch = cfg.channels_out or cfg.channels
-        return nn.Conv(out_ch, (3, 3), padding="SAME",
-                       kernel_init=nn.initializers.zeros_init(),
-                       name="final_conv")(x)
+        return _conv(out_ch, (3, 3), "final_conv",
+                     kernel_init=nn.initializers.zeros_init(),
+                     shard=False)(x)
 
 
 # reference zoo (modeling.py:32-88)

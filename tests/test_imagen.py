@@ -569,3 +569,91 @@ def test_sample_skip_steps():
     for out in (full, skipped):
         assert 0.0 <= float(out.min()) and float(out.max()) <= 1.0
     assert not np.array_equal(np.asarray(full), np.asarray(skipped))
+
+
+def test_imagen_trains_fsdp_sharded(tmp_path):
+    """ZeRO-3 over the U-Net (VERDICT r4 #7): with sharding_degree=4
+    stage 3, the wide conv/dense params must actually SHARD over the
+    fsdp mesh axis (not replicate), and training must still step.
+    The annotations live in models/imagen/unet.py (_conv/_attn_dense/
+    _ff/_cond_dense -> logical "embed"/"mlp"/"heads" axes)."""
+    from paddlefleetx_tpu.core import Engine
+    from paddlefleetx_tpu.data import build_dataloader
+    from paddlefleetx_tpu.models import build_module
+    from paddlefleetx_tpu.utils.config import AttrDict, process_configs
+
+    filelist = _write_imagen_corpus(tmp_path, n=16)
+    cfg = AttrDict({
+        "Global": AttrDict({"device": "cpu", "seed": 2022,
+                            "global_batch_size": None,
+                            "local_batch_size": 2,
+                            "micro_batch_size": 2}),
+        "Engine": AttrDict({
+            "max_steps": 2, "logging_freq": 1, "eval_freq": 1000,
+            "mix_precision": AttrDict({}),
+            "save_load": AttrDict({"save_steps": 1000,
+                                   "output_dir": str(tmp_path / "o")}),
+        }),
+        "Model": AttrDict({
+            "module": "ImagenModule",
+            "name": "imagen_397M_text2im_64",
+            "unet_number": 1,
+            "image_sizes": (16,),
+            "text_embed_dim": 32,
+            "timesteps": 8,
+            "unet_overrides": tuple(TINY_UNET.items()),
+        }),
+        "Loss": AttrDict({"name": "mse_loss", "p2_loss_weight_k": 1}),
+        "Distributed": AttrDict({
+            "dp_degree": 2, "mp_degree": 1, "pp_degree": 1,
+            "sharding": AttrDict({"sharding_degree": 4,
+                                  "sharding_stage": 3})}),
+        "Optimizer": AttrDict({
+            "name": "Adam",
+            "lr": AttrDict({"name": "CosineAnnealingWithWarmupDecay",
+                            "decay_steps": 100, "warmup_rate": 0.1,
+                            "max_lr": 1e-3, "min_lr": 1e-4}),
+            "grad_clip": AttrDict({"clip_norm": 1.0}),
+        }),
+        "Data": AttrDict({"Train": AttrDict({
+            "dataset": AttrDict({
+                "name": "ImagenDataset", "input_path": filelist,
+                "input_resolution": 16, "max_seq_len": 8}),
+            "sampler": AttrDict({"name": "DistributedBatchSampler",
+                                 "batch_size": 2, "shuffle": False,
+                                 "drop_last": True}),
+            "loader": AttrDict({"collate_fn": "imagen_collate_fn",
+                                "num_workers": 1}),
+        })}),
+    })
+    process_configs(cfg, nranks=8)
+    module = build_module(cfg)
+    engine = Engine(cfg, module, mode="train")
+    assert dict(engine.mesh.shape)["fsdp"] == 4
+
+    # the wide params are REALLY sharded: some 4-D conv kernel and
+    # some dense kernel must carry fsdp in their sharding spec, and
+    # their per-device shard must be smaller than the global shape
+    leaves = jax.tree.leaves(engine.state["params"])
+    fsdp_sharded = [
+        x for x in leaves
+        if hasattr(x, "sharding") and "fsdp" in str(x.sharding.spec)]
+    assert fsdp_sharded, "no param sharded over fsdp"
+    conv_kernels = [x for x in fsdp_sharded if x.ndim == 4]
+    assert conv_kernels, "no conv kernel sharded over fsdp"
+    x = conv_kernels[0]
+    shard_shape = x.sharding.shard_shape(x.shape)
+    assert np.prod(shard_shape) < np.prod(x.shape)
+
+    loader = build_dataloader(cfg.Data, "Train", num_replicas=1, rank=0)
+    loader.batch_sampler.batch_size = cfg.Global.global_batch_size
+    losses = []
+    orig = module.training_step_end
+
+    def capture(log):
+        losses.append(log["loss"])
+        orig(log)
+
+    module.training_step_end = capture
+    engine.fit(epoch=1, train_data_loader=loader)
+    assert losses and all(np.isfinite(x) for x in losses)
