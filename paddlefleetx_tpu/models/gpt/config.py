@@ -117,19 +117,24 @@ class GPTConfig:
                     "supports dropout).",
                     self.attention_probs_dropout_prob)
             elif self.use_flash_attention:
-                from ...utils.log import logger
-                logger.warning(
-                    "use_flash_attention=True with "
-                    "attention_probs_dropout_prob=%s: TRAINING "
-                    "attention takes the dense XLA path (the kernel "
-                    "implements no prob dropout); eval/generation "
-                    "still use the kernel. Set the prob to 0.0 to "
-                    "train through the flash kernel.%s",
-                    self.attention_probs_dropout_prob,
-                    " At max_position_embeddings >= 4096 the dense "
-                    "[b, h, s, s] scores will not fit and the "
-                    "training module refuses to start."
-                    if self.max_position_embeddings >= 4096 else "")
+                # with in-kernel dropout enabled the kernel path holds
+                # under training dropout — nothing to warn about
+                from ...ops.attention import _kernel_dropout_enabled
+                if not _kernel_dropout_enabled():
+                    from ...utils.log import logger
+                    logger.warning(
+                        "use_flash_attention=True with "
+                        "attention_probs_dropout_prob=%s: TRAINING "
+                        "attention takes the dense XLA path (in-kernel "
+                        "dropout is gated behind PFX_FLASH_DROPOUT=1 "
+                        "until chip-certified); eval/generation "
+                        "still use the kernel. Set the prob to 0.0 to "
+                        "train through the flash kernel.%s",
+                        self.attention_probs_dropout_prob,
+                        " At max_position_embeddings >= 4096 the dense "
+                        "[b, h, s, s] scores will not fit and the "
+                        "training module refuses to start."
+                        if self.max_position_embeddings >= 4096 else "")
         if self.moe_num_experts:
             if not 1 <= self.moe_top_k <= self.moe_num_experts:
                 raise ValueError(

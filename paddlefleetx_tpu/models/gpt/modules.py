@@ -71,10 +71,34 @@ class GPTModule(LanguageModule):
             # keyed on the ACTUAL training sequence length, not the
             # position-table size: fine-tuning a long-context
             # checkpoint at s=1024 is the benign short-seq case even
-            # when max_position_embeddings is 8192
+            # when max_position_embeddings is 8192. With in-kernel
+            # dropout enabled (PFX_FLASH_DROPOUT=1, ops/attention.py)
+            # AND the kernel actually able to take this shape on this
+            # backend, the kernel handles the dropout itself — no
+            # dense fallback, nothing to refuse. The env var alone is
+            # NOT enough: a shape the kernel rejects at dispatch
+            # (head_dim, block alignment, non-TPU backend) would
+            # silently fall back to dense and re-open the OOM trap.
+            kernel_dropout_ok = False
+            from ...ops.attention import _kernel_dropout_enabled
+            if _kernel_dropout_enabled():
+                try:
+                    import jax
+
+                    from ...ops.pallas.flash_attention import (
+                        check_shapes,
+                    )
+                    check_shapes(
+                        tokens.shape[1], tokens.shape[1],
+                        mc.hidden_size // mc.num_attention_heads)
+                    kernel_dropout_ok = \
+                        jax.default_backend() == "tpu"
+                except (ImportError, NotImplementedError):
+                    kernel_dropout_ok = False
             if mc.use_flash_attention and \
                     tokens.shape[1] >= 4096 and \
-                    not mc.context_parallel:
+                    not mc.context_parallel and \
+                    not kernel_dropout_ok:
                 raise ValueError(
                     "training with use_flash_attention=True and "
                     "attention_probs_dropout_prob="

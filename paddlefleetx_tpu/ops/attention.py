@@ -17,6 +17,7 @@ relayout of the (large) cache happens on this path.
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
@@ -24,6 +25,16 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
 NEG_INF = -1e9
+
+
+def _kernel_dropout_enabled() -> bool:
+    """Opt-in gate for IN-KERNEL flash attention dropout
+    (``PFX_FLASH_DROPOUT=1``). Off by default until the implementation
+    is certified on a live chip (tests/test_flash_dropout_tpu.py —
+    ``pltpu.prng_seed`` has no CPU interpret lowering, so the dropout
+    path cannot even compile offline); flipping the default is the
+    chip-session follow-up."""
+    return os.environ.get("PFX_FLASH_DROPOUT") == "1"
 
 # Non-causal dispatch crossover: below this KV length the dense XLA
 # batched matmul beats the flash kernel (measured on a v5e at ERNIE
@@ -80,6 +91,20 @@ def dot_product_attention(
     (the reference's ``attn_mask`` convention, additive -1e4 style).
     """
     skv = k.shape[3] if kv_cache_layout else k.shape[1]
+    # training dropout on the kernel path: in-kernel philox masks
+    # (reference fused softmax-with-dropout, hybrid_model.py:277-285)
+    if (use_flash and dropout_rate > 0.0 and not deterministic
+            and dropout_rng is not None and bias is None
+            and not kv_cache_layout and causal
+            and _kernel_dropout_enabled()):
+        try:
+            from .pallas import flash_attention as fa
+            return fa.flash_attention(q, k, v, causal=causal,
+                                      query_offset=query_offset,
+                                      dropout_rate=dropout_rate,
+                                      dropout_rng=dropout_rng)
+        except (ImportError, NotImplementedError):
+            pass
     # deterministic makes a configured dropout_rate inert, so eval and
     # generation may take the kernel even when training cannot
     if use_flash and (deterministic or dropout_rate == 0.0):

@@ -44,8 +44,39 @@ def _interpret() -> bool:
     """Interpreter mode lets CPU tests validate kernel semantics
     (``PFX_PALLAS_INTERPRET=1``)."""
     return os.environ.get("PFX_PALLAS_INTERPRET") == "1"
+
+
+def _bf16_exp() -> bool:
+    """Opt-in bf16 exp in the online softmax (perf playbook lever #2):
+    halves the VPU transcendental work that bounds the kernel at
+    d=64/short-s. Numerics: the exp argument ``s - m_new`` is in
+    [-inf, 0] where bf16's 8-bit mantissa costs ~2^-8 relative — the
+    fp32 accumulation of l/acc is unchanged. Only enable with
+    TPU-validated tolerances (tests/test_flash_attention.py on chip);
+    interpret mode cannot certify TPU VPU numerics."""
+    return os.environ.get("PFX_FLASH_BF16_EXP") == "1"
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_KV = 1024
+
+
+def _dropout_threshold(rate: float):
+    """uint32 comparison threshold: keep a lane iff its random bits
+    fall below ``(1-rate) * 2^32`` (clamped — a tiny nonzero rate must
+    keep nearly everything, not wrap to zero)."""
+    return jnp.uint32(min(4294967295,
+                          int(round((1.0 - rate) * 4294967296.0))))
+
+
+def _block_keep_mask(seed_ref, b, qi, ki, rate, block_q, block_kv):
+    """Regenerable [block_q, block_kv] keep mask for score block
+    (b, qi, ki): the per-core PRNG is reseeded from (run seed, block
+    coordinates) so forward and every backward kernel reproduce the
+    SAME mask for the same block regardless of their grid iteration
+    order (the backward grids iterate (ki, qi))."""
+    pltpu.prng_seed(seed_ref[0], b, qi, ki)
+    bits = pltpu.bitcast(pltpu.prng_random_bits((block_q, block_kv)),
+                         jnp.uint32)
+    return bits < _dropout_threshold(rate)
 
 
 def _auto_block(s: int, target: int, align: int) -> int:
@@ -78,18 +109,31 @@ def _dot(a, b, trans_a=False, trans_b=False):
 # -- forward -----------------------------------------------------------
 
 
-def _online_update(s, v, m_scr, l_scr, acc_scr):
+def _online_update(s, v, m_scr, l_scr, acc_scr, drop_fn=None):
     """One online-softmax accumulator step over a masked score block
     (the training forward's MXU formulation; the decode kernel
     vectorizes the same recurrence over heads with VPU reduces —
     semantic parity between the two is pinned by
-    ``tests/test_flash_attention.py`` decode-vs-XLA cases)."""
+    ``tests/test_flash_attention.py`` decode-vs-XLA cases).
+
+    ``drop_fn`` (in-kernel attention dropout): the normalizer ``l``
+    accumulates the FULL ``p`` — dropout multiplies the normalized
+    probabilities, and the row division by ``l`` is uniform, so
+    ``dropout(softmax(s)) @ v == (sum keep*p/keep_prob @ v) / l`` —
+    while only the value-matmul operand is masked+rescaled."""
     m_prev = m_scr[:]                              # [bq, 1]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
     alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)
-    l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * alpha + _dot(p.astype(v.dtype), v)
+    if _bf16_exp():
+        # bf16 transcendental, fp32 accumulate (lever #2; opt-in)
+        p = jnp.exp((s - m_new).astype(jnp.bfloat16))
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(
+            p.astype(jnp.float32), axis=1, keepdims=True)
+    else:
+        p = jnp.exp(s - m_new)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    pv = p if drop_fn is None else drop_fn(p)
+    acc_scr[:] = acc_scr[:] * alpha + _dot(pv.astype(v.dtype), v)
     m_scr[:] = m_new
 
 
@@ -126,7 +170,7 @@ def _masked_dispatch(block_fn, qi, ki, block_q, block_kv, causal,
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
                 acc_scr, *, sm_scale, causal, block_q, block_kv, num_kv,
-                query_offset):
+                query_offset, dropout_rate=0.0, seed_ref=None):
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -145,7 +189,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
             s = jnp.where(
                 _causal_mask(qi, ki, block_q, block_kv, query_offset),
                 s, NEG_INF)
-        _online_update(s, v, m_scr, l_scr, acc_scr)
+        drop_fn = None
+        if dropout_rate > 0.0:
+            def drop_fn(p):
+                keep = _block_keep_mask(
+                    seed_ref, pl.program_id(0), qi, ki, dropout_rate,
+                    block_q, block_kv)
+                return jnp.where(keep, p / (1.0 - dropout_rate),
+                                 jnp.zeros_like(p))
+        _online_update(s, v, m_scr, l_scr, acc_scr, drop_fn)
 
     _masked_dispatch(_block, qi, ki, block_q, block_kv, causal,
                      query_offset)
@@ -157,6 +209,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         lse_ref[0] = (m_scr[:] + jnp.log(l))
 
 
+def _fwd_kernel_seeded(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                       m_scr, l_scr, acc_scr, **kw):
+    """Scalar-prefetch wrapper: PrefetchScalarGridSpec delivers the
+    dropout seed as the leading ref."""
+    _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                acc_scr, seed_ref=seed_ref, **kw)
+
+
 def _vma(x):
     """Varying-across-mesh axes of a traced value — pallas out_shapes
     must carry them for shard_map's vma checker to accept the call
@@ -165,35 +225,56 @@ def _vma(x):
 
 
 def _flash_forward(q, k, v, sm_scale, causal, query_offset, block_q,
-                   block_kv):
+                   block_kv, dropout_rate=0.0, seed=None):
     bh, sq, d = q.shape
     skv = k.shape[1]
     num_q, num_kv = sq // block_q, skv // block_kv
+    out_shape = [
+        jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=_vma(q)),
+        jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32, vma=_vma(q)),
+    ]
+    scratch = [
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, d), jnp.float32),
+    ]
+    # ONE spec set for both paths (the dropout path lifts the index
+    # maps for the prefetched scalar, _lift_spec)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, block_kv, d), lambda b, qi, ki: (b, ki, 0)),
+        pl.BlockSpec((1, block_kv, d), lambda b, qi, ki: (b, ki, 0)),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
+    ]
+    if dropout_rate > 0.0:
+        kernel = functools.partial(
+            _fwd_kernel_seeded, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_kv=block_kv, num_kv=num_kv,
+            query_offset=query_offset, dropout_rate=dropout_rate)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, num_q, num_kv),
+            in_specs=[_lift_spec(s) for s in in_specs],
+            out_specs=[_lift_spec(s) for s in out_specs],
+            scratch_shapes=scratch,
+        )
+        return pl.pallas_call(
+            kernel, grid_spec=grid_spec, out_shape=out_shape,
+            interpret=_interpret(),
+        )(seed, q, k, v)
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
         block_kv=block_kv, num_kv=num_kv, query_offset=query_offset)
     return pl.pallas_call(
         kernel,
         grid=(bh, num_q, num_kv),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_kv, d), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, block_kv, d), lambda b, qi, ki: (b, ki, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=_vma(q)),
-            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32,
-                                 vma=_vma(q)),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, d), jnp.float32),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
         interpret=_interpret(),
     )(q, k, v)
 
@@ -203,12 +284,19 @@ def _flash_forward(q, k, v, sm_scale, causal, query_offset, block_q,
 
 def _bwd_block_math(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     masked, qi, ki, sm_scale, block_q, block_kv,
-                    query_offset):
+                    query_offset, dropout_rate=0.0, seed_ref=None):
     """Score-block recomputation shared by all backward kernels:
-    ``(q_s, p, ds)`` with q pre-scaled (so dk = ds^T @ q_s absorbs one
-    sm_scale factor and the OTHER stays pending on dq — the caller
+    ``(q_s, p_dv, ds)`` with q pre-scaled (so dk = ds^T @ q_s absorbs
+    one sm_scale factor and the OTHER stays pending on dq — the caller
     applies it once on [bq, d]). Single definition so the backward
-    kernels cannot diverge (same contract as ``_masked_dispatch``)."""
+    kernels cannot diverge (same contract as ``_masked_dispatch``).
+
+    With dropout the SAME per-block keep mask as the forward is
+    regenerated from (seed, b, qi, ki). Writing the dropped
+    probabilities p~ = keep*p/keep_prob, the chain rule gives
+    ``dv = p~^T @ do`` and ``ds = p * (keep*dp/keep_prob - delta)``
+    with ``delta = rowsum(do*o) = rowsum(p~ * dp)`` — the caller's
+    delta needs no change."""
     q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
     lse, delta = lse_ref[0], delta_ref[0]               # [bq, 1]
     q_s = (q.astype(jnp.float32) * sm_scale).astype(q.dtype)
@@ -219,13 +307,21 @@ def _bwd_block_math(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s, NEG_INF)
     p = jnp.exp(s - lse)                                # [bq, bkv]
     dp = _dot(do, v, trans_b=True)                      # [bq, bkv]
+    p_dv = p
+    if dropout_rate > 0.0:
+        keep = _block_keep_mask(seed_ref, pl.program_id(0), qi, ki,
+                                dropout_rate, block_q, block_kv)
+        inv = 1.0 / (1.0 - dropout_rate)
+        p_dv = jnp.where(keep, p * inv, jnp.zeros_like(p))
+        dp = jnp.where(keep, dp * inv, jnp.zeros_like(dp))
     ds = p * (dp - delta)
-    return q_s, p, ds
+    return q_s, p_dv, ds
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
-                    block_q, block_kv, num_q, query_offset):
+                    block_q, block_kv, num_q, query_offset,
+                    dropout_rate=0.0, seed_ref=None):
     ki, qi = pl.program_id(1), pl.program_id(2)
 
     @pl.when(qi == 0)
@@ -234,10 +330,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     def _block(masked: bool):
-        q_s, p, ds = _bwd_block_math(
+        q_s, p_dv, ds = _bwd_block_math(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, masked,
-            qi, ki, sm_scale, block_q, block_kv, query_offset)
-        dv_scr[:] += _dot(p.astype(do_ref.dtype), do_ref[0],
+            qi, ki, sm_scale, block_q, block_kv, query_offset,
+            dropout_rate, seed_ref)
+        dv_scr[:] += _dot(p_dv.astype(do_ref.dtype), do_ref[0],
                           trans_a=True)
         dk_scr[:] += _dot(ds.astype(q_s.dtype), q_s, trans_a=True)
 
@@ -252,7 +349,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_scr, *, sm_scale, causal, block_q,
-                   block_kv, num_kv, query_offset):
+                   block_kv, num_kv, query_offset, dropout_rate=0.0,
+                   seed_ref=None):
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -262,7 +360,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _block(masked: bool):
         _, _, ds = _bwd_block_math(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, masked,
-            qi, ki, sm_scale, block_q, block_kv, query_offset)
+            qi, ki, sm_scale, block_q, block_kv, query_offset,
+            dropout_rate, seed_ref)
         dq_scr[:] += _dot(ds.astype(k_ref.dtype), k_ref[0])
 
     _masked_dispatch(_block, qi, ki, block_q, block_kv, causal,
@@ -276,7 +375,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_combined_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
                          delta_ref, dq_ref, dk_ref, dv_ref, dq_scr, *,
                          sm_scale, causal, block_q, block_kv, num_kv,
-                         query_offset):
+                         query_offset, dropout_rate=0.0,
+                         seed_ref=None):
     """Combined backward for the ``num_q == 1`` regime (the training
     hot path: s <= block_q, and every ring-attention shard): ONE pass
     over the ki blocks produces dq, dk, AND dv — the split kernel
@@ -292,10 +392,11 @@ def _bwd_combined_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     def _block(masked: bool):
-        q_s, p, ds = _bwd_block_math(
+        q_s, p_dv, ds = _bwd_block_math(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, masked,
-            0, ki, sm_scale, block_q, block_kv, query_offset)
-        dv_ref[0] = _dot(p.astype(do_ref.dtype), do_ref[0],
+            0, ki, sm_scale, block_q, block_kv, query_offset,
+            dropout_rate, seed_ref)
+        dv_ref[0] = _dot(p_dv.astype(do_ref.dtype), do_ref[0],
                          trans_a=True).astype(dv_ref.dtype)
         dk_ref[0] = _dot(ds.astype(q_s.dtype), q_s,
                          trans_a=True).astype(dk_ref.dtype)
@@ -447,8 +548,26 @@ def _flash_backward_fused(q, k, v, g, lse, delta, sm_scale, causal,
     return (dq32 * sm_scale).astype(q.dtype), dk, dv
 
 
+def _seeded(kernel):
+    """Scalar-prefetch adapter: reorder the leading seed ref into the
+    kernel's ``seed_ref`` kwarg."""
+    def wrapped(seed_ref, *refs, **kw):
+        kernel(*refs, seed_ref=seed_ref, **kw)
+    return wrapped
+
+
+def _lift_spec(spec):
+    """BlockSpec adapter for PrefetchScalarGridSpec: the index map
+    gains a trailing scalar-ref arg it ignores. Shared by the forward
+    and backward dropout paths so specs cannot diverge from their
+    non-dropout twins."""
+    f = spec.index_map
+    return pl.BlockSpec(spec.block_shape,
+                        lambda *idx, _f=f: _f(*idx[:-1]))
+
+
 def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
-                    block_kv, g_lse=None):
+                    block_kv, g_lse=None, dropout_rate=0.0, seed=None):
     q, k, v, out, lse = res
     bh, sq, d = q.shape
     skv = k.shape[1]
@@ -460,17 +579,42 @@ def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
         # so it folds into the kernels' existing ds = p * (dp - delta)
         # as delta' = delta - g_lse — no kernel change needed
         delta = delta - g_lse.astype(jnp.float32)
+    dropout = dropout_rate > 0.0
+
+    def _call(kernel_fn, grid, in_specs, out_specs, out_shape,
+              scratch_shapes, **kernel_kw):
+        """One backward pallas_call; with dropout the seed rides as a
+        prefetched scalar and every index map gains the trailing
+        scalar-ref arg."""
+        if dropout:
+            kernel = functools.partial(
+                _seeded(kernel_fn), dropout_rate=dropout_rate,
+                **kernel_kw)
+            grid_spec = pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1, grid=grid,
+                in_specs=[_lift_spec(s) for s in in_specs],
+                out_specs=([_lift_spec(s) for s in out_specs]
+                           if isinstance(out_specs, list)
+                           else _lift_spec(out_specs)),
+                scratch_shapes=scratch_shapes)
+            return pl.pallas_call(
+                kernel, grid_spec=grid_spec, out_shape=out_shape,
+                interpret=_interpret(),
+            )(seed, q, k, v, g, lse, delta)
+        kernel = functools.partial(kernel_fn, **kernel_kw)
+        return pl.pallas_call(
+            kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
+            out_shape=out_shape, scratch_shapes=scratch_shapes,
+            interpret=_interpret(),
+        )(q, k, v, g, lse, delta)
 
     if num_q == 1:
         q_spec = pl.BlockSpec((1, block_q, d), lambda b, i: (b, 0, 0))
         r_spec = pl.BlockSpec((1, block_q, 1), lambda b, i: (b, 0, 0))
         kv_spec = pl.BlockSpec((1, block_kv, d),
                                lambda b, i: (b, i, 0))
-        dq, dk, dv = pl.pallas_call(
-            functools.partial(
-                _bwd_combined_kernel, sm_scale=sm_scale, causal=causal,
-                block_q=block_q, block_kv=block_kv, num_kv=num_kv,
-                query_offset=query_offset),
+        dq, dk, dv = _call(
+            _bwd_combined_kernel,
             grid=(bh, num_kv),
             in_specs=[q_spec, kv_spec, kv_spec, q_spec, r_spec,
                       r_spec],
@@ -482,23 +626,25 @@ def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
                        jax.ShapeDtypeStruct((bh, skv, d), v.dtype,
                                             vma=_vma(q))],
             scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-            interpret=_interpret(),
-        )(q, k, v, g, lse, delta)
+            sm_scale=sm_scale, causal=causal, block_q=block_q,
+            block_kv=block_kv, num_kv=num_kv,
+            query_offset=query_offset)
         return dq, dk, dv
 
-    fused = _flash_backward_fused(q, k, v, g, lse, delta, sm_scale,
-                                  causal, query_offset)
-    if fused is not None:
-        return fused
+    if not dropout:
+        # the fused kernel tiles at its own internal block sizes, so
+        # its regenerated dropout masks could not match the forward's —
+        # dropout uses the split pair below instead
+        fused = _flash_backward_fused(q, k, v, g, lse, delta, sm_scale,
+                                      causal, query_offset)
+        if fused is not None:
+            return fused
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
     r_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
     kv_spec = pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, i, 0))
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_kv=block_kv, num_q=num_q,
-            query_offset=query_offset),
+    dk, dv = _call(
+        _bwd_dkv_kernel,
         grid=(bh, num_kv, num_q),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, r_spec, r_spec],
         out_specs=[kv_spec, kv_spec],
@@ -508,17 +654,14 @@ def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
                                         vma=_vma(q))],
         scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32),
                         pltpu.VMEM((block_kv, d), jnp.float32)],
-        interpret=_interpret(),
-    )(q, k, v, g, lse, delta)
+        sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_kv=block_kv, num_q=num_q, query_offset=query_offset)
 
     q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     r_spec2 = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
     kv_spec2 = pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0))
-    dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_kv=block_kv, num_kv=num_kv,
-            query_offset=query_offset),
+    dq = _call(
+        _bwd_dq_kernel,
         grid=(bh, num_q, num_kv),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, r_spec2,
                   r_spec2],
@@ -526,8 +669,8 @@ def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype,
                                        vma=_vma(q)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        interpret=_interpret(),
-    )(q, k, v, g, lse, delta)
+        sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_kv=block_kv, num_kv=num_kv, query_offset=query_offset)
     return dq, dk, dv
 
 
@@ -566,6 +709,43 @@ def _flash_lse_bwd(sm_scale, causal, block_q, block_kv, res, g):
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_lse_dropout(q, k, v, seed, sm_scale, causal, block_q,
+                       block_kv, dropout_rate):
+    """Dropout twin of ``_flash_lse``: the [1] int32 ``seed`` is a
+    TRACED operand (a fresh dropout pattern per step must not
+    retrace), delivered to the kernels by scalar prefetch; the keep
+    mask is regenerated per score block from (seed, b, qi, ki) in
+    both directions, so nothing beyond the standard residuals is
+    saved."""
+    return _flash_forward(q, k, v, sm_scale, causal, 0, block_q,
+                          block_kv, dropout_rate, seed)
+
+
+def _flash_lse_dropout_fwd(q, k, v, seed, sm_scale, causal, block_q,
+                           block_kv, dropout_rate):
+    out, lse = _flash_forward(q, k, v, sm_scale, causal, 0, block_q,
+                              block_kv, dropout_rate, seed)
+    out = checkpoint_name(out, "attn")
+    lse = checkpoint_name(lse, "attn")
+    return (out, lse), (q, k, v, out, lse, seed)
+
+
+def _flash_lse_dropout_bwd(sm_scale, causal, block_q, block_kv,
+                           dropout_rate, res, g):
+    q, k, v, out, lse, seed = res
+    g_out, g_lse = g
+    dq, dk, dv = _flash_backward(
+        (q, k, v, out, lse), g_out, sm_scale, causal, 0, block_q,
+        block_kv, g_lse=g_lse, dropout_rate=dropout_rate, seed=seed)
+    import numpy as np
+    return dq, dk, dv, np.zeros(seed.shape, jax.dtypes.float0)
+
+
+_flash_lse_dropout.defvjp(_flash_lse_dropout_fwd,
+                          _flash_lse_dropout_bwd)
+
+
 def check_shapes(sq, skv, d, block_q: int = None,
                  block_kv: int = None):
     """(block_q, block_kv) after clamping, or NotImplementedError —
@@ -598,10 +778,18 @@ def _to_bh(x):
 
 
 def flash_attention(q, k, v, causal: bool = True, query_offset=0,
-                    block_q: int = None, block_kv: int = None):
+                    block_q: int = None, block_kv: int = None,
+                    dropout_rate: float = 0.0, dropout_rng=None):
     """``[b, s, h, d]`` causal attention; raises NotImplementedError
     when the shape/backend can't take the kernel (caller falls back to
-    the XLA path in ``ops.attention``)."""
+    the XLA path in ``ops.attention``).
+
+    ``dropout_rate > 0`` runs IN-KERNEL attention-probs dropout (the
+    reference's fused softmax-with-dropout training path,
+    ``hybrid_model.py:277-285``): the per-core PRNG generates the keep
+    mask inside each score block from (seed, block coords) — no
+    [b, h, s, s] mask tensor ever exists, in either direction.
+    TPU-only: ``pltpu.prng_seed`` has no interpret lowering."""
     if jax.default_backend() != "tpu" and not _interpret():
         raise NotImplementedError("flash kernel targets TPU")
     if not isinstance(query_offset, int) or query_offset != 0:
@@ -609,6 +797,20 @@ def flash_attention(q, k, v, causal: bool = True, query_offset=0,
     b, sq, h, d = q.shape
     block_q, block_kv = check_shapes(sq, k.shape[1], d, block_q,
                                      block_kv)
+    if dropout_rate > 0.0:
+        if dropout_rng is None:
+            raise NotImplementedError(
+                "flash dropout needs a dropout_rng")
+        if _interpret():
+            raise NotImplementedError(
+                "in-kernel dropout has no interpret lowering "
+                "(pltpu.prng_seed is TPU-only)")
+        seed = jax.random.randint(dropout_rng, (1,), 0, 2 ** 31 - 1,
+                                  dtype=jnp.int32)
+        out, _ = _flash_lse_dropout(
+            _to_bh(q), _to_bh(k), _to_bh(v), seed, d ** -0.5, causal,
+            block_q, block_kv, float(dropout_rate))
+        return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     # lse discarded: its cotangent is then symbolically zero and the
     # backward's delta adjustment is a no-op — one custom_vjp serves
     # both the plain and the with-lse surface

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Chip certification for in-kernel flash-attention dropout + the
+bf16-exp lever (perf playbook levers #2/#3). MUST run on a real TPU:
+``pltpu.prng_seed`` has no CPU interpret lowering, so this path cannot
+even compile offline.
+
+Checks, strongest last:
+1. rate=0 equivalence: the dropout custom_vjp with rate 0 bit-matches
+   the plain kernel (plumbing sanity).
+2. determinism: same rng -> identical output; different rng ->
+   different output.
+3. expectation: averaging dropout outputs over many keys approaches
+   the no-dropout output (dropout is identity in expectation), and
+   the zero-fraction of the probability mass matches the rate.
+4. gradient consistency: finite-difference vs jax.grad THROUGH the
+   kernel at fixed seed — if the backward regenerated different masks
+   than the forward, this fails loudly.
+5. bf16-exp: with PFX_FLASH_BF16_EXP=1 the forward stays within bf16
+   tolerance of the fp32-exp forward.
+
+Exit 0 = certified (then flip _kernel_dropout_enabled's default);
+nonzero = keep the gate closed.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    if jax.devices()[0].platform != "tpu":
+        print("SKIP: needs a real TPU")
+        return 2
+    from paddlefleetx_tpu.ops.pallas.flash_attention import (
+        flash_attention,
+    )
+
+    from paddlefleetx_tpu.ops.pallas.flash_attention import (
+        _flash_lse_dropout, _to_bh, check_shapes,
+    )
+
+    b, s, h, d = 2, 512, 4, 64
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, d)),
+                           jnp.float32) for _ in range(3))
+    base = flash_attention(q, k, v, causal=True)
+
+    # 1. rate-0 plumbing equivalence THROUGH the dropout custom_vjp:
+    # same kernels, seed ignored — must bit-match the plain kernel
+    bq, bkv = check_shapes(s, s, d)
+    out0, _ = _flash_lse_dropout(
+        _to_bh(q), _to_bh(k), _to_bh(v),
+        jnp.zeros((1,), jnp.int32), d ** -0.5, True, bq, bkv, 0.0)
+    np.testing.assert_array_equal(
+        np.asarray(out0.reshape(b, h, s, d).transpose(0, 2, 1, 3)),
+        np.asarray(base))
+    print("rate-0 plumbing equivalence OK")
+
+    key = jax.random.key(7)
+    out_drop = flash_attention(q, k, v, causal=True, dropout_rate=0.1,
+                               dropout_rng=key)
+    assert out_drop.shape == base.shape
+    assert bool(jnp.isfinite(out_drop).all()), "non-finite dropout out"
+
+    # 1b. dropped-mass fraction: with v = ones, each no-dropout output
+    # entry is exactly 1 (softmax rows sum to 1); with dropout the
+    # kept-mass fraction is out*(1-rate), whose mean must equal
+    # 1-rate -> mean(1 - out*(1-rate)) == rate up to MC noise
+    rate = 0.3
+    ones_v = jnp.ones_like(v)
+    fracs = []
+    for i in range(16):
+        o = flash_attention(q, k, ones_v, causal=True,
+                            dropout_rate=rate,
+                            dropout_rng=jax.random.key(500 + i))
+        fracs.append(1.0 - float(jnp.mean(o)) * (1.0 - rate))
+    measured = float(np.mean(fracs))
+    print(f"dropped-mass fraction {measured:.4f} (target {rate})")
+    assert abs(measured - rate) < 0.02, measured
+
+    # 2. determinism
+    out_drop2 = flash_attention(q, k, v, causal=True, dropout_rate=0.1,
+                                dropout_rng=key)
+    np.testing.assert_array_equal(np.asarray(out_drop),
+                                  np.asarray(out_drop2))
+    out_other = flash_attention(q, k, v, causal=True, dropout_rate=0.1,
+                                dropout_rng=jax.random.key(8))
+    assert not np.array_equal(np.asarray(out_drop),
+                              np.asarray(out_other)), \
+        "different rngs produced identical dropout"
+    print("determinism OK")
+
+    # 3. expectation: mean over N independent masks -> no-dropout out
+    N = 64
+    acc = np.zeros(base.shape, np.float64)
+    for i in range(N):
+        acc += np.asarray(flash_attention(
+            q, k, v, causal=True, dropout_rate=0.3,
+            dropout_rng=jax.random.key(100 + i)), np.float64)
+    mean = acc / N
+    # row magnitudes vary; compare normalized error over all entries
+    err = np.abs(mean - np.asarray(base, np.float64)).mean() / \
+        (np.abs(np.asarray(base, np.float64)).mean() + 1e-9)
+    print(f"expectation: mean rel err {err:.4f} over {N} masks")
+    assert err < 0.08, err  # ~1/sqrt(N*keep-ish) Monte-Carlo noise
+
+    # 4. gradient consistency (fwd/bwd mask identity) by central
+    # finite differences on a scalar loss, small shape
+    bs, ss, hs, ds = 1, 256, 2, 64
+    q2, k2, v2 = (jnp.asarray(rng.standard_normal((bs, ss, hs, ds)),
+                              jnp.float32) for _ in range(3))
+    key2 = jax.random.key(42)
+    co = jnp.asarray(rng.standard_normal(
+        (bs, ss, hs, ds)), jnp.float32)  # fixed cotangent direction
+
+    def loss(qq):
+        out = flash_attention(qq, k2, v2, causal=True,
+                              dropout_rate=0.2, dropout_rng=key2)
+        return jnp.vdot(out, co)
+
+    g = jax.grad(loss)(q2)
+    # probe a handful of coordinates
+    eps = 1e-2
+    idxs = [(0, 3, 0, 5), (0, 100, 1, 10), (0, 255, 0, 63),
+            (0, 17, 1, 31)]
+    for idx in idxs:
+        e = jnp.zeros_like(q2).at[idx].set(eps)
+        fd = (loss(q2 + e) - loss(q2 - e)) / (2 * eps)
+        an = g[idx]
+        denom = max(abs(float(fd)), abs(float(an)), 1e-3)
+        rel = abs(float(fd) - float(an)) / denom
+        print(f"grad check {idx}: fd {float(fd):+.5f} "
+              f"analytic {float(an):+.5f} rel {rel:.4f}")
+        assert rel < 0.05, (idx, float(fd), float(an))
+    print("gradient consistency OK")
+
+    # 5. bf16-exp tolerance (forward only; flag read at trace time)
+    os.environ["PFX_FLASH_BF16_EXP"] = "1"
+    try:
+        out_bf16 = jax.jit(lambda a, b_, c: flash_attention(
+            a, b_, c, causal=True))(q, k, v)
+    finally:
+        del os.environ["PFX_FLASH_BF16_EXP"]
+    rel = float(jnp.abs(out_bf16 - base).max() /
+                (jnp.abs(base).max() + 1e-9))
+    print(f"bf16-exp max rel dev {rel:.5f}")
+    assert rel < 0.02, rel  # bf16 mantissa ~2^-8
+
+    print("ALL CHECKS PASSED — in-kernel dropout certified")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -340,3 +340,55 @@ def test_decode_with_leftpad_bias_matches_xla():
                          kv_cache_layout=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-6, rtol=2e-6)
+
+
+def test_kernel_dropout_gate_and_fallback(monkeypatch):
+    """The in-kernel dropout dispatch (PFX_FLASH_DROPOUT=1) must fall
+    back to the XLA dense path on CPU (prng has no interpret
+    lowering), and with the gate off behave exactly as before. The
+    on-chip certification lives in scripts/validate_flash_dropout.py."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddlefleetx_tpu.ops.attention import dot_product_attention
+
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((1, 64, 2, 64)),
+                           jnp.float32) for _ in range(3))
+    key = jax.random.key(0)
+    kw = dict(causal=True, dropout_rate=0.2, dropout_rng=key,
+              deterministic=False, use_flash=True)
+    monkeypatch.delenv("PFX_FLASH_DROPOUT", raising=False)
+    off = dot_product_attention(q, k, v, **kw)
+    monkeypatch.setenv("PFX_FLASH_DROPOUT", "1")
+    on = dot_product_attention(q, k, v, **kw)
+    # same platform, same rng -> the CPU fallback path is identical
+    np.testing.assert_allclose(np.asarray(off), np.asarray(on),
+                               rtol=1e-6)
+    assert np.isfinite(np.asarray(on)).all()
+
+
+def test_flash_dropout_requires_rng(monkeypatch):
+    """Under interpret mode the backend check passes, so the missing-
+    rng check is the one that fires — pin its message (a bare
+    NotImplementedError would also come from the CPU-backend check,
+    making the assertion vacuous)."""
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    from paddlefleetx_tpu.ops.pallas.flash_attention import (
+        flash_attention,
+    )
+    monkeypatch.setenv("PFX_PALLAS_INTERPRET", "1")
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((1, 128, 2, 64)), jnp.float32)
+    with pytest.raises(NotImplementedError, match="dropout_rng"):
+        flash_attention(q, q, q, causal=True, dropout_rate=0.1)
+    # with an rng, interpret mode still refuses (prng has no CPU
+    # lowering) — with ITS message
+    import jax
+    with pytest.raises(NotImplementedError, match="interpret"):
+        flash_attention(q, q, q, causal=True, dropout_rate=0.1,
+                        dropout_rng=jax.random.key(0))
