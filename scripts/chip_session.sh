@@ -1,0 +1,49 @@
+#!/bin/sh
+# One-shot chip-session protocol (perf playbook "first 20 minutes"),
+# safe to re-run. Each stage logs under bench_log/; successful bench
+# runs also append their JSON + device_kind to bench_log/runs.jsonl
+# (the audit trail). Stages are individually timed out so a dying
+# tunnel cannot wedge the session; later stages still get their shot.
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p bench_log
+log() { echo "[$(date -u +%FT%TZ)] $*" >> bench_log/session.log; }
+
+log "chip session start"
+# keep per-stage probe budgets short: the chip was just probed up
+export PFX_BENCH_MAX_WAIT=600
+
+log "stage: tune_flash"
+timeout 1500 python scripts/tune_flash.py \
+    >> bench_log/tune_flash.log 2>&1
+log "tune_flash rc=$?"
+
+log "stage: bench train (cold, decomp)"
+PFX_BENCH_DECOMP=1 timeout 2400 python bench.py \
+    >> bench_log/bench_train.log 2>&1
+log "bench train cold rc=$?"
+
+log "stage: bench train (warm)"
+timeout 1500 python bench.py >> bench_log/bench_train.log 2>&1
+log "bench train warm rc=$?"
+
+log "stage: dropout certification"
+timeout 1200 python scripts/validate_flash_dropout.py \
+    >> bench_log/dropout_cert.log 2>&1
+log "dropout cert rc=$?"
+
+log "stage: convergence oracle"
+timeout 1200 python bench.py --mode convergence \
+    >> bench_log/bench_convergence.log 2>&1
+log "convergence rc=$?"
+
+log "stage: moe"
+timeout 1200 python bench.py --mode moe \
+    >> bench_log/bench_moe.log 2>&1
+log "moe rc=$?"
+
+log "stage: generation"
+timeout 1200 python bench.py --mode generation \
+    >> bench_log/bench_generation.log 2>&1
+log "generation rc=$?"
+
+log "chip session end"
