@@ -392,3 +392,37 @@ def test_flash_dropout_requires_rng(monkeypatch):
     with pytest.raises(NotImplementedError, match="interpret"):
         flash_attention(q, q, q, causal=True, dropout_rate=0.1,
                         dropout_rng=jax.random.key(0))
+
+
+def test_flash_dropout_traces_offline():
+    """The dropout custom_vjp cannot COMPILE off-TPU (Mosaic-only
+    prng), but it must TRACE: jax.eval_shape exercises kernel ref
+    counts, scalar-prefetch index-map arity, grid/spec plumbing, and
+    the float0 seed cotangent — catching structural regressions
+    without a chip. Numerics are certified on-chip by
+    scripts/validate_flash_dropout.py."""
+    from paddlefleetx_tpu.ops.pallas.flash_attention import (
+        _flash_lse_dropout, _to_bh, check_shapes,
+    )
+
+    d = 64
+    seed = jnp.zeros((1,), jnp.int32)
+
+    def run(s, rate):
+        q = jnp.zeros((2, s, 4, d), jnp.float32)
+        bq, bkv = check_shapes(s, s, d)
+
+        def loss(q_, k_, v_, s_):
+            o, lse = _flash_lse_dropout(
+                _to_bh(q_), _to_bh(k_), _to_bh(v_), s_, d ** -0.5,
+                True, bq, bkv, rate)
+            return jnp.sum(o) + jnp.sum(lse)
+
+        return jax.eval_shape(
+            lambda a, b, c, s_: jax.grad(loss, argnums=(0, 1, 2))(
+                a, b, c, s_), q, q, q, seed)
+
+    # combined-backward regime (num_q == 1) and split-pair regime
+    for s in (512, 2048):
+        grads = run(s, 0.2)
+        assert all(g.shape == (2, s, 4, d) for g in grads)
