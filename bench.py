@@ -53,10 +53,39 @@ METRIC_BY_MODE = {
     "moe": "gpt345m_moe8_top2_pretrain_tokens_per_sec_per_chip",
     "generation": "gpt345m_generation_decode_tokens_per_sec",
     "convergence": "gpt345m_convergence_loss_at_300",
+    "67b": "gpt3_6p7b_geometry_mfu",
+    "longctx": "gpt345m_long_context_s8192_mfu",
 }
 # which metric a failure is reported against — set from --mode so a
 # crashed `--mode moe` run cannot blame the pretrain headline number
 _active_metric = HEADLINE_METRIC
+# the headline record, stashed the moment it is measured: a SIGTERM or
+# crash AFTER that point (e.g. while the secondary-metric child
+# processes run) must emit the measured number, not a failure record —
+# the headline is never hostage to the secondaries
+_headline_result = None
+# in-flight secondary-metric child (subprocess.Popen) — the SIGTERM
+# path must kill it before exiting, or an orphan keeps holding the
+# single-client chip for the driver's next run
+_child_proc = None
+
+
+def _kill_child() -> str:
+    """Kill + REAP any in-flight child; returns its stderr tail (the
+    child's last words are the only diagnostic for a wedged native
+    compile — and an unreaped kill leaves a zombie holding its pipes
+    for the rest of the parent's run)."""
+    global _child_proc
+    tail = ""
+    if _child_proc is not None and _child_proc.poll() is None:
+        try:
+            _child_proc.kill()
+            _, err = _child_proc.communicate(timeout=15)
+            tail = (err or "")[-1500:]
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+    _child_proc = None
+    return tail
 
 # -- backend acquisition hardening ------------------------------------
 #
@@ -118,6 +147,8 @@ def _is_transient(text: str) -> bool:
 
 UNIT_BY_METRIC = {
     METRIC_BY_MODE["convergence"]: "nll_nats",
+    METRIC_BY_MODE["67b"]: "mfu",
+    METRIC_BY_MODE["longctx"]: "mfu",
 }
 
 
@@ -131,6 +162,18 @@ def _failure_record(kind: str, detail: str) -> str:
 
 
 def _emit_failure(kind: str, detail: str, rc: int = 1):
+    _kill_child()
+    if _headline_result is not None:
+        # the headline was already measured — ship it (with whatever
+        # secondaries made it) instead of a failure record; note the
+        # interruption so the record is honest about the nulls, and
+        # append it to the audit trail like any other on-chip result
+        rec = dict(_headline_result)
+        rec["secondaries_interrupted"] = detail[-300:]
+        _log_success(rec)
+        print(json.dumps(rec))
+        sys.stdout.flush()
+        sys.exit(0)
     print(_failure_record(kind, detail))
     sys.stdout.flush()
     sys.exit(rc)
@@ -151,6 +194,14 @@ def _install_sigterm_reporter():
     import signal
 
     def _on_term(signum, frame):
+        _kill_child()
+        if _headline_result is not None:
+            rec = dict(_headline_result)
+            rec["secondaries_interrupted"] = (
+                f"killed by signal {signum} during {_phase}")
+            _log_success(rec)  # device identity is cached by now
+            print(json.dumps(rec), flush=True)
+            os._exit(0)
         kind = ("backend_unavailable"
                 if _phase == "backend probing" else "exception")
         print(_failure_record(
@@ -293,6 +344,21 @@ def _init_main_backend(probe_timeout: float = None):
         done.set()
 
 
+_device_identity_cache = None
+
+
+def _device_identity():
+    """(platform, device_kind), cached at first use — callers that
+    run AFTER ``_release_backend`` (the audit-trail append for the
+    assembled headline record) must not re-initialize a PJRT client
+    just to stamp the device name."""
+    global _device_identity_cache
+    if _device_identity_cache is None:
+        d = jax.devices()[0]
+        _device_identity_cache = (d.platform, d.device_kind)
+    return _device_identity_cache
+
+
 def _log_success(record: dict):
     """Append a timestamped copy of a successful on-chip result to
     ``bench_log/runs.jsonl`` — the builder-side audit trail the
@@ -300,8 +366,8 @@ def _log_success(record: dict):
     (VERDICT r4 weak #1). CPU runs are not logged (they are offline
     smoke, not evidence)."""
     import datetime
-    d = jax.devices()[0]
-    if d.platform != "tpu":
+    platform, device_kind = _device_identity()
+    if platform != "tpu":
         return
     try:
         log_dir = os.path.join(
@@ -310,7 +376,7 @@ def _log_success(record: dict):
         entry = dict(record)
         entry["ts"] = datetime.datetime.now(
             datetime.timezone.utc).isoformat(timespec="seconds")
-        entry["device_kind"] = d.device_kind
+        entry["device_kind"] = device_kind
         with open(os.path.join(log_dir, "runs.jsonl"), "a") as f:
             f.write(json.dumps(entry) + "\n")
     except OSError as e:  # the audit trail must never kill the bench
@@ -607,9 +673,131 @@ def long_context_mfu(peak) -> float:
     return tps * model_flops_per_token(cfg, s) / peak
 
 
+def bench_67b():
+    """``--mode 67b``: the 6.7B-geometry MFU proxy, standalone."""
+    if jax.devices()[0].platform != "tpu":
+        print(json.dumps({"metric": METRIC_BY_MODE["67b"],
+                          "value": None, "unit": "mfu",
+                          "vs_baseline": None,
+                          "error": "requires a TPU backend"}))
+        return
+    out = mfu_6p7b(peak_flops())
+    if out is None:
+        _emit_failure("exception",
+                      "no 6.7B ladder rung fits this chip")
+    mfu, layers = out
+    result = {
+        "metric": METRIC_BY_MODE["67b"],
+        "value": round(mfu, 4),
+        "unit": "mfu",
+        # north star: >=45% MFU at the 6.7B geometry (BASELINE.json)
+        "vs_baseline": round(mfu / 0.45, 3),
+        "layers_measured": layers,
+    }
+    _log_success(result)
+    print(json.dumps(result))
+
+
+def bench_longctx():
+    """``--mode longctx``: the s=8192 long-context MFU, standalone."""
+    if jax.devices()[0].platform != "tpu":
+        print(json.dumps({"metric": METRIC_BY_MODE["longctx"],
+                          "value": None, "unit": "mfu",
+                          "vs_baseline": None,
+                          "error": "requires a TPU backend"}))
+        return
+    mfu = long_context_mfu(peak_flops())
+    result = {
+        "metric": METRIC_BY_MODE["longctx"],
+        "value": round(mfu, 4),
+        "unit": "mfu",
+        "vs_baseline": None,  # the reference cannot run this shape
+    }
+    _log_success(result)
+    print(json.dumps(result))
+
+
+def _release_backend() -> bool:
+    """Best-effort: drop this process's PJRT client so the secondary
+    child benches can own the chip. On single-client TPU runtimes a
+    held client makes every child probe RESOURCE_EXHAUSTED until its
+    budget burns out — the fresh-process isolation only works if the
+    parent lets go first. Clears the jit caches (compiled executables
+    pin the client) and the backend registry, then collects. After
+    this returns the parent must not touch jax again."""
+    import gc
+    try:
+        jax.clear_caches()
+        from jax._src import xla_bridge as xb
+        xb._clear_backends()
+        gc.collect()
+        return True
+    except Exception as e:
+        sys.stderr.write(f"warning: backend release failed "
+                         f"({type(e).__name__}: {e}); child benches "
+                         f"may find the chip busy\n")
+        return False
+
+
+def _sub_bench(mode: str, timeout: float = 2400.0):
+    """Run a secondary metric in a FRESH process (its own PJRT client
+    and HBM arena) and parse its JSON line.
+
+    The near-capacity configs (6.7B L=8 at ~96% of a 16G v5e,
+    s=8192 long-context) must not have their fit depend on what the
+    headline + reference-workload stages left behind in THIS process
+    (allocator fragmentation, cached executables' scratch) — in the
+    r5 chip session both hit RESOURCE_EXHAUSTED in-process right
+    after those stages. A child process re-acquires the backend
+    (seconds while the chip is up) and measures from a clean arena.
+    Returns the parsed result dict, or None (with the child's stderr
+    tail surfaced) on any failure."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--mode", mode]
+    env = dict(os.environ)
+    # the chip was up seconds ago: the child must not inherit the
+    # parent's multi-hour probe budget (nor re-time the decomp)
+    env["PFX_BENCH_MAX_WAIT"] = str(min(
+        600.0, float(env.get("PFX_BENCH_MAX_WAIT", "600"))))
+    env.pop("PFX_BENCH_DECOMP", None)
+    global _child_proc
+    try:
+        _child_proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env)
+        out, err = _child_proc.communicate(timeout=timeout)
+        rc = _child_proc.returncode
+    except subprocess.TimeoutExpired:
+        tail = _kill_child()
+        sys.stderr.write(f"{mode} subprocess timed out "
+                         f"(>{timeout:.0f}s); child stderr tail:\n"
+                         f"{tail}\n")
+        return None
+    finally:
+        _child_proc = None
+    proc = subprocess.CompletedProcess(cmd, rc, out, err)
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(rec, dict):
+            continue
+        if proc.returncode != 0 or rec.get("error_kind") \
+                or rec.get("value") is None:
+            sys.stderr.write(
+                f"{mode} subprocess failed (rc={proc.returncode}): "
+                f"{json.dumps(rec)[:300]}\n"
+                f"{proc.stderr[-1500:]}\n")
+            return None
+        return rec
+    sys.stderr.write(f"{mode} subprocess produced no JSON "
+                     f"(rc={proc.returncode}):\n{proc.stderr[-1500:]}\n")
+    return None
+
+
 def bench_train():
     """Headline 345M pretraining throughput + the secondary MFUs."""
-    on_tpu = jax.devices()[0].platform == "tpu"
+    on_tpu = _device_identity()[0] == "tpu"
     batch, seq = (8, 1024) if on_tpu else (2, 256)
     # gradient accumulation amortizes the ~24 ms memory-bound optimizer
     # update over more tokens (engine semantics: one jitted step with a
@@ -651,12 +839,12 @@ def bench_train():
     peak = peak_flops() if on_tpu else None
     mfu = (tokens_per_sec * model_flops_per_token(cfg, seq) / peak) \
         if peak else None
-    mfu_67b = longctx = ref_tps = None
+    ref_tps = ref_flash_tps = None
     if on_tpu:
         # secondary apples-to-apples point (VERDICT r4 weak #3): the
         # reference's published 16.2k tokens/s ran its DEFAULT config —
-        # both dropouts 0.1, which forces the dense attention path (the
-        # flash kernel has no in-kernel dropout yet). The headline
+        # both dropouts 0.1, which forces the dense attention path when
+        # in-kernel dropout is not certified/enabled. The headline
         # above deviates (dropout 0.0 + flash); this point does not.
         try:
             ref_cfg = _gpt345m(True, hidden_dropout_prob=0.1,
@@ -669,29 +857,38 @@ def bench_train():
         except Exception as e:
             sys.stderr.write(
                 f"warning: reference-workload bench failed: {e}\n")
-    if peak:
-        try:
-            mfu_67b = mfu_6p7b(peak)  # (mfu, layers) or None
-        except Exception as e:  # secondary metric must not kill the
-            sys.stderr.write(   # headline number (e.g. OOM on <16G)
-                f"warning: 6.7B-geometry bench failed: {e}\n")
-        try:
-            longctx = long_context_mfu(peak)
-        except Exception as e:
-            sys.stderr.write(
-                f"warning: long-context bench failed: {e}\n")
+        # same workload on OUR best path: the reference's published
+        # number ran its own fused softmax+dropout kernel (reference
+        # ``hybrid_model.py:277-285``), so dense-XLA above handicaps
+        # this side; with chip-certified in-kernel dropout the flash
+        # kernel runs the identical dropout-0.1 workload. Only
+        # measured when the kernel-dropout gate is on.
+        from paddlefleetx_tpu.ops.attention import (
+            _kernel_dropout_enabled,
+        )
+        if _kernel_dropout_enabled():
+            try:
+                rf_cfg = _gpt345m(True, hidden_dropout_prob=0.1,
+                                  attention_probs_dropout_prob=0.1,
+                                  use_flash_attention=True,
+                                  use_recompute=True,
+                                  recompute_granularity="save_dots",
+                                  loss_chunks=8, scan_layers=False)
+                ref_flash_tps = _measure_train(rf_cfg, batch, seq,
+                                               acc, 6, True)
+            except Exception as e:
+                sys.stderr.write(
+                    f"warning: flash reference-workload bench "
+                    f"failed: {e}\n")
     result = {
         "metric": HEADLINE_METRIC,
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 3),
         "mfu": round(mfu, 4) if mfu is not None else None,
-        "mfu_6p7b":
-            round(mfu_67b[0], 4) if mfu_67b is not None else None,
-        "mfu_6p7b_layers_measured":
-            mfu_67b[1] if mfu_67b is not None else None,
-        "mfu_long_context_s8192":
-            round(longctx, 4) if longctx is not None else None,
+        "mfu_6p7b": None,
+        "mfu_6p7b_layers_measured": None,
+        "mfu_long_context_s8192": None,
         # reference workload (dropout 0.1, dense attention) vs the same
         # published 16.2k baseline — the strict apples-to-apples ratio
         "ref_workload_tokens_per_sec":
@@ -699,7 +896,38 @@ def bench_train():
         "ref_workload_vs_baseline":
             round(ref_tps / BASELINE_TOKENS_PER_SEC, 3)
             if ref_tps is not None else None,
+        # dropout-0.1 workload on the certified flash-dropout kernel
+        "ref_workload_flash_tokens_per_sec":
+            round(ref_flash_tps, 1)
+            if ref_flash_tps is not None else None,
+        "ref_workload_flash_vs_baseline":
+            round(ref_flash_tps / BASELINE_TOKENS_PER_SEC, 3)
+            if ref_flash_tps is not None else None,
     }
+    # the headline is banked from here: any kill/crash during the
+    # secondaries emits THIS record instead of a failure
+    global _headline_result
+    _headline_result = result
+    skip = os.environ.get("PFX_BENCH_SKIP_SECONDARIES") == "1"
+    if peak and not skip:
+        # fresh-process isolation for the near-capacity configs (see
+        # _sub_bench); the parent releases its PJRT client first — on
+        # single-client runtimes a held client would make every child
+        # probe RESOURCE_EXHAUSTED. A child failure costs the
+        # secondary metric, never the headline number.
+        if _release_backend():
+            rec = _sub_bench("67b")
+            if rec is not None:
+                result["mfu_6p7b"] = rec["value"]
+                result["mfu_6p7b_layers_measured"] = \
+                    rec.get("layers_measured")
+            rec = _sub_bench("longctx")
+            if rec is not None:
+                result["mfu_long_context_s8192"] = rec["value"]
+        else:
+            sys.stderr.write(
+                "skipping secondary children: parent still holds the "
+                "chip, they would only burn probe budget\n")
     _log_success(result)
     print(json.dumps(result))
 
@@ -836,8 +1064,12 @@ def bench_convergence():
     contain — so the oracle certifies the same three properties on a
     deterministic synthetic corpus whose entropy is EXACTLY known:
 
-    1. init sanity: early loss sits at ln(V) + init noise (the
-       reference's 11.03 vs ln(50304)=10.83);
+    1. init sanity: FIRST-step loss sits at ln(V) + init noise (the
+       reference's 11.03-at-batch-25 vs ln(50304)=10.83 — but its
+       curve ran real OpenWebText, where batch 25 is still near init;
+       on this strongly-structured synthetic corpus the model has
+       already dropped >3 nats by batch 25, so the init check must
+       read step 1, r5 chip run);
     2. the model learns: loss at batch 300 drops below batch-25 loss
        by >= 0.12 nats — the drop the reference curve itself shows
        (we use a faster GPT-3-style warmup, so the bar is easier to
@@ -905,11 +1137,12 @@ def bench_convergence():
                                        jnp.asarray(data[i]))
         curve.append(float(loss))  # sync; also simplest host capture
 
+    at1 = curve[0]  # loss BEFORE the first update = init loss
     at25 = curve[min(24, n_steps - 1)]
     at300 = curve[-1]
     lnv = float(np.log(cfg.vocab_size))
     ok = (np.isfinite(at300)
-          and abs(at25 - lnv) < 0.7          # property 1
+          and abs(at1 - lnv) < 0.7           # property 1
           and (at25 - at300) >= 0.12          # property 2
           and at300 >= bi_h - 0.05)           # property 3
     result = {
@@ -917,6 +1150,7 @@ def bench_convergence():
         "value": round(at300, 4),
         "unit": "nll_nats",
         "vs_baseline": None,  # reference curve is corpus-specific
+        "loss_at_init": round(at1, 4),
         "loss_at_25": round(at25, 4),
         "ln_vocab": round(lnv, 4),
         "bigram_entropy_floor": round(bi_h, 4),
@@ -937,7 +1171,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--mode",
                    choices=["train", "generation", "moe",
-                            "convergence"],
+                            "convergence", "67b", "longctx"],
                    default="train")
     args = p.parse_args()
     global _active_metric
@@ -971,6 +1205,10 @@ def main():
         bench_moe()
     elif args.mode == "convergence":
         bench_convergence()
+    elif args.mode == "67b":
+        bench_67b()
+    elif args.mode == "longctx":
+        bench_longctx()
     else:
         bench_generation()
 

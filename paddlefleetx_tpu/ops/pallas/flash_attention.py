@@ -67,13 +67,21 @@ def _dropout_threshold(rate: float):
                           int(round((1.0 - rate) * 4294967296.0))))
 
 
-def _block_keep_mask(seed_ref, b, qi, ki, rate, block_q, block_kv):
+def _block_keep_mask(seed_ref, b, qi, ki, n_q, n_kv, rate, block_q,
+                     block_kv):
     """Regenerable [block_q, block_kv] keep mask for score block
     (b, qi, ki): the per-core PRNG is reseeded from (run seed, block
     coordinates) so forward and every backward kernel reproduce the
     SAME mask for the same block regardless of their grid iteration
-    order (the backward grids iterate (ki, qi))."""
-    pltpu.prng_seed(seed_ref[0], b, qi, ki)
+    order (the backward grids iterate (ki, qi)).
+
+    The coordinates are folded mixed-radix into ONE value — Mosaic's
+    ``prng_set_seed_32`` rejects more than two seed operands on v5e
+    libtpu ("Setting seed with more than 2 values is not supported",
+    r5 chip cert) — using the STATIC block counts (n_q, n_kv) shared
+    by the forward and backward pallas_calls, so the fold is injective
+    and kernel-order independent. Callers assert the fold fits i32."""
+    pltpu.prng_seed(seed_ref[0], (b * n_q + qi) * n_kv + ki)
     bits = pltpu.bitcast(pltpu.prng_random_bits((block_q, block_kv)),
                          jnp.uint32)
     return bits < _dropout_threshold(rate)
@@ -170,7 +178,8 @@ def _masked_dispatch(block_fn, qi, ki, block_q, block_kv, causal,
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
                 acc_scr, *, sm_scale, causal, block_q, block_kv, num_kv,
-                query_offset, dropout_rate=0.0, seed_ref=None):
+                query_offset, dropout_rate=0.0, seed_ref=None,
+                num_q=None):
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -193,8 +202,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         if dropout_rate > 0.0:
             def drop_fn(p):
                 keep = _block_keep_mask(
-                    seed_ref, pl.program_id(0), qi, ki, dropout_rate,
-                    block_q, block_kv)
+                    seed_ref, pl.program_id(0), qi, ki, num_q, num_kv,
+                    dropout_rate, block_q, block_kv)
                 return jnp.where(keep, p / (1.0 - dropout_rate),
                                  jnp.zeros_like(p))
         _online_update(s, v, m_scr, l_scr, acc_scr, drop_fn)
@@ -250,10 +259,14 @@ def _flash_forward(q, k, v, sm_scale, causal, query_offset, block_q,
         pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
     ]
     if dropout_rate > 0.0:
+        # the mixed-radix (b, qi, ki) seed fold must stay within i32
+        assert bh * num_q * num_kv < 2 ** 31, (
+            "dropout seed fold overflows i32 for this grid")
         kernel = functools.partial(
             _fwd_kernel_seeded, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_kv=block_kv, num_kv=num_kv,
-            query_offset=query_offset, dropout_rate=dropout_rate)
+            query_offset=query_offset, dropout_rate=dropout_rate,
+            num_q=num_q)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(bh, num_q, num_kv),
@@ -284,7 +297,8 @@ def _flash_forward(q, k, v, sm_scale, causal, query_offset, block_q,
 
 def _bwd_block_math(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     masked, qi, ki, sm_scale, block_q, block_kv,
-                    query_offset, dropout_rate=0.0, seed_ref=None):
+                    query_offset, dropout_rate=0.0, seed_ref=None,
+                    num_q=None, num_kv=None):
     """Score-block recomputation shared by all backward kernels:
     ``(q_s, p_dv, ds)`` with q pre-scaled (so dk = ds^T @ q_s absorbs
     one sm_scale factor and the OTHER stays pending on dq — the caller
@@ -310,7 +324,8 @@ def _bwd_block_math(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     p_dv = p
     if dropout_rate > 0.0:
         keep = _block_keep_mask(seed_ref, pl.program_id(0), qi, ki,
-                                dropout_rate, block_q, block_kv)
+                                num_q, num_kv, dropout_rate, block_q,
+                                block_kv)
         inv = 1.0 / (1.0 - dropout_rate)
         p_dv = jnp.where(keep, p * inv, jnp.zeros_like(p))
         dp = jnp.where(keep, dp * inv, jnp.zeros_like(dp))
@@ -321,7 +336,7 @@ def _bwd_block_math(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
                     block_q, block_kv, num_q, query_offset,
-                    dropout_rate=0.0, seed_ref=None):
+                    dropout_rate=0.0, seed_ref=None, num_kv=None):
     ki, qi = pl.program_id(1), pl.program_id(2)
 
     @pl.when(qi == 0)
@@ -333,7 +348,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q_s, p_dv, ds = _bwd_block_math(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, masked,
             qi, ki, sm_scale, block_q, block_kv, query_offset,
-            dropout_rate, seed_ref)
+            dropout_rate, seed_ref, num_q, num_kv)
         dv_scr[:] += _dot(p_dv.astype(do_ref.dtype), do_ref[0],
                           trans_a=True)
         dk_scr[:] += _dot(ds.astype(q_s.dtype), q_s, trans_a=True)
@@ -350,7 +365,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_scr, *, sm_scale, causal, block_q,
                    block_kv, num_kv, query_offset, dropout_rate=0.0,
-                   seed_ref=None):
+                   seed_ref=None, num_q=None):
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -361,7 +376,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         _, _, ds = _bwd_block_math(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, masked,
             qi, ki, sm_scale, block_q, block_kv, query_offset,
-            dropout_rate, seed_ref)
+            dropout_rate, seed_ref, num_q, num_kv)
         dq_scr[:] += _dot(ds.astype(k_ref.dtype), k_ref[0])
 
     _masked_dispatch(_block, qi, ki, block_q, block_kv, causal,
@@ -395,7 +410,7 @@ def _bwd_combined_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         q_s, p_dv, ds = _bwd_block_math(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, masked,
             0, ki, sm_scale, block_q, block_kv, query_offset,
-            dropout_rate, seed_ref)
+            dropout_rate, seed_ref, 1, num_kv)
         dv_ref[0] = _dot(p_dv.astype(do_ref.dtype), do_ref[0],
                          trans_a=True).astype(dv_ref.dtype)
         dk_ref[0] = _dot(ds.astype(q_s.dtype), q_s,
@@ -580,6 +595,10 @@ def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
         # as delta' = delta - g_lse — no kernel change needed
         delta = delta - g_lse.astype(jnp.float32)
     dropout = dropout_rate > 0.0
+    if dropout:
+        # the mixed-radix (b, qi, ki) seed fold must stay within i32
+        assert bh * num_q * num_kv < 2 ** 31, (
+            "dropout seed fold overflows i32 for this grid")
 
     def _call(kernel_fn, grid, in_specs, out_specs, out_shape,
               scratch_shapes, **kernel_kw):
@@ -655,7 +674,8 @@ def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
         scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32),
                         pltpu.VMEM((block_kv, d), jnp.float32)],
         sm_scale=sm_scale, causal=causal, block_q=block_q,
-        block_kv=block_kv, num_q=num_q, query_offset=query_offset)
+        block_kv=block_kv, num_q=num_q, num_kv=num_kv,
+        query_offset=query_offset)
 
     q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     r_spec2 = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
@@ -670,7 +690,8 @@ def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
                                        vma=_vma(q)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         sm_scale=sm_scale, causal=causal, block_q=block_q,
-        block_kv=block_kv, num_kv=num_kv, query_offset=query_offset)
+        block_kv=block_kv, num_kv=num_kv, num_q=num_q,
+        query_offset=query_offset)
     return dq, dk, dv
 
 

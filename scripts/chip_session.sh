@@ -4,6 +4,9 @@
 # runs also append their JSON + device_kind to bench_log/runs.jsonl
 # (the audit trail). Stages are individually timed out so a dying
 # tunnel cannot wedge the session; later stages still get their shot.
+# timeout -k: a stage wedged inside a native PJRT/compile call cannot
+# run Python signal handlers, so TERM alone can hang the whole session
+# (observed r5: moe stage 22 min past deadline) — KILL follows.
 cd "$(dirname "$0")/.." || exit 1
 mkdir -p bench_log
 log() { echo "[$(date -u +%FT%TZ)] $*" >> bench_log/session.log; }
@@ -13,36 +16,51 @@ log "chip session start"
 export PFX_BENCH_MAX_WAIT=600
 
 log "stage: tune_flash"
-timeout 1500 python scripts/tune_flash.py \
+timeout -k 60 1500 python scripts/tune_flash.py \
     >> bench_log/tune_flash.log 2>&1
 log "tune_flash rc=$?"
 
-log "stage: bench train (cold, decomp)"
-PFX_BENCH_DECOMP=1 timeout 2400 python bench.py \
+log "stage: bench train (cold, decomp, headline only)"
+PFX_BENCH_DECOMP=1 PFX_BENCH_SKIP_SECONDARIES=1 \
+    timeout -k 60 2400 python bench.py \
     >> bench_log/bench_train.log 2>&1
 log "bench train cold rc=$?"
 
-log "stage: bench train (warm)"
-timeout 1500 python bench.py >> bench_log/bench_train.log 2>&1
+log "stage: bench train (warm, headline only)"
+PFX_BENCH_SKIP_SECONDARIES=1 timeout -k 60 1500 python bench.py \
+    >> bench_log/bench_train.log 2>&1
 log "bench train warm rc=$?"
 
+# the secondaries get DEDICATED stages with their own budgets (cold
+# compiles of the 6.7B L=8 / s=8192 configs take minutes each): inside
+# the train stage they would share its timeout and be TERM'd away
+log "stage: 67b"
+timeout -k 60 2400 python bench.py --mode 67b \
+    >> bench_log/bench_67b.log 2>&1
+log "67b rc=$?"
+
+log "stage: longctx"
+timeout -k 60 1800 python bench.py --mode longctx \
+    >> bench_log/bench_longctx.log 2>&1
+log "longctx rc=$?"
+
 log "stage: dropout certification"
-timeout 1200 python scripts/validate_flash_dropout.py \
+timeout -k 60 1200 python scripts/validate_flash_dropout.py \
     >> bench_log/dropout_cert.log 2>&1
 log "dropout cert rc=$?"
 
 log "stage: convergence oracle"
-timeout 1200 python bench.py --mode convergence \
+timeout -k 60 1200 python bench.py --mode convergence \
     >> bench_log/bench_convergence.log 2>&1
 log "convergence rc=$?"
 
 log "stage: moe"
-timeout 1200 python bench.py --mode moe \
+timeout -k 60 1200 python bench.py --mode moe \
     >> bench_log/bench_moe.log 2>&1
 log "moe rc=$?"
 
 log "stage: generation"
-timeout 1200 python bench.py --mode generation \
+timeout -k 60 1200 python bench.py --mode generation \
     >> bench_log/bench_generation.log 2>&1
 log "generation rc=$?"
 

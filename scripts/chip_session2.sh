@@ -1,0 +1,39 @@
+#!/bin/sh
+# Follow-up chip-session: the stages the first r5 session failed, after
+# their fixes — dropout cert (seed-fold for Mosaic's 2-operand
+# prng_seed limit), convergence oracle (init check at step 1), the
+# near-capacity secondaries in fresh processes, and the tune sweep
+# with data-dependency-chained timing. Safe to re-run.
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p bench_log
+log() { echo "[$(date -u +%FT%TZ)] $*" >> bench_log/session2.log; }
+
+log "session2 start"
+export PFX_BENCH_MAX_WAIT=600
+
+log "stage: dropout certification (fixed seed fold)"
+timeout -k 60 1200 python scripts/validate_flash_dropout.py \
+    >> bench_log/dropout_cert2.log 2>&1
+log "cert rc=$?"
+
+log "stage: convergence (init check at step 1)"
+timeout -k 60 1200 python bench.py --mode convergence \
+    >> bench_log/bench_convergence2.log 2>&1
+log "convergence rc=$?"
+
+log "stage: 67b fresh-process"
+timeout -k 60 2400 python bench.py --mode 67b \
+    >> bench_log/bench_67b.log 2>&1
+log "67b rc=$?"
+
+log "stage: longctx fresh-process"
+timeout -k 60 1800 python bench.py --mode longctx \
+    >> bench_log/bench_longctx.log 2>&1
+log "longctx rc=$?"
+
+log "stage: tune_flash (chained timing)"
+timeout -k 60 1500 python scripts/tune_flash.py \
+    >> bench_log/tune_flash2.log 2>&1
+log "tune rc=$?"
+
+log "session2 end"

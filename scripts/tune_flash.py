@@ -28,14 +28,34 @@ POINTS = {
 }
 
 
-def _time(fn, *args, reps=20):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / reps * 1e3
+def _time(fn, q, k, v, reps=20):
+    """Median-of-3 per-iteration ms, with reps CHAINED through a data
+    dependency inside one jitted scan.
+
+    Independent back-to-back dispatches under-measure badly here (the
+    r5 chip session recorded 0.018 ms "forwards" at s=8192 — 40x the
+    chip's peak FLOPs — because nothing forces iteration i to wait for
+    i-1). Feeding a tiny function of output i into input i+1 makes the
+    chain sequential on device; 1e-30*out is numerically negligible
+    but cannot be dead-code-eliminated."""
+    def body(qq, _):
+        out = fn(qq, k, v)
+        lead = out[0] if isinstance(out, tuple) else out
+        bump = (1e-30 * lead.ravel()[0]).astype(qq.dtype)
+        return qq + bump, None
+
+    @jax.jit
+    def run(q):
+        final, _ = jax.lax.scan(body, q, None, length=reps)
+        return final
+
+    jax.block_until_ready(run(q))  # compile + warm
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(q))
+        times.append((time.perf_counter() - t0) / reps * 1e3)
+    return sorted(times)[1]
 
 
 def sweep(point: str, b: int, h: int, s: int, d: int):

@@ -280,3 +280,66 @@ def test_measure_train_dropout_rng_threading():
     # MoE branch (router aux losses under non-deterministic apply)
     cfg = GPTConfig(**common, moe_num_experts=4, moe_top_k=2)
     assert bench._measure_train(cfg, 2, 16, 2, 2, False) > 0
+
+
+class _FakePopen:
+    """Stand-in for the secondary-metric child process; _sub_bench
+    must kill a timed-out child itself (Popen, unlike subprocess.run,
+    leaves that to the caller — the SIGTERM path needs the handle)."""
+    killed = False
+
+    def __init__(self, rc=0, out="", err="", hang=False):
+        self.returncode = rc
+        self._out, self._err, self._hang = out, err, hang
+
+    def __call__(self, *a, **k):  # Popen(...) construction
+        return self
+
+    def communicate(self, timeout=None):
+        if self._hang:
+            raise subprocess.TimeoutExpired(cmd="bench", timeout=timeout)
+        return self._out, self._err
+
+    def poll(self):
+        return None if self._hang and not self.killed \
+            else self.returncode
+
+    def kill(self):
+        self.killed = True
+
+
+def test_sub_bench_parses_last_json_line(monkeypatch):
+    """Secondary metrics run in fresh processes (r5: the 6.7B/longctx
+    configs are near-capacity and must not depend on the headline
+    stage's leftover HBM state); the parent parses the child's LAST
+    JSON stdout line, skipping decomp/log noise and non-dict JSON."""
+    rec = {"metric": "gpt3_6p7b_geometry_mfu", "value": 0.47,
+           "unit": "mfu", "layers_measured": 8}
+    out = "decomp[fwd]: 1.0 ms\n" + json.dumps(rec) + "\n1.0\n"
+    monkeypatch.setattr(bench.subprocess, "Popen",
+                        _FakePopen(0, out))
+    got = bench._sub_bench("67b")
+    assert got == rec
+
+
+def test_sub_bench_failure_returns_none(monkeypatch, capsys):
+    cases = [
+        _FakePopen(1, json.dumps({"metric": "m", "value": None,
+                                  "error_kind": "exception"})),
+        _FakePopen(0, json.dumps({"metric": "m", "value": None})),
+        _FakePopen(0, "no json at all\n"),
+    ]
+    for fake in cases:
+        monkeypatch.setattr(bench.subprocess, "Popen", fake)
+        assert bench._sub_bench("longctx") is None
+    err = capsys.readouterr().err
+    assert "longctx subprocess" in err
+
+
+def test_sub_bench_timeout_kills_child(monkeypatch, capsys):
+    fake = _FakePopen(hang=True)
+    monkeypatch.setattr(bench.subprocess, "Popen", fake)
+    assert bench._sub_bench("67b", timeout=1.0) is None
+    assert fake.killed, "timed-out child must be killed, not orphaned"
+    assert "timed out" in capsys.readouterr().err
+    assert bench._child_proc is None
