@@ -125,11 +125,15 @@ class GPTConfig:
                     logger.warning(
                         "use_flash_attention=True with "
                         "attention_probs_dropout_prob=%s: TRAINING "
-                        "attention takes the dense XLA path (in-kernel "
-                        "dropout is gated behind PFX_FLASH_DROPOUT=1 "
-                        "until chip-certified); eval/generation "
-                        "still use the kernel. Set the prob to 0.0 to "
-                        "train through the flash kernel.%s",
+                        "attention takes the dense XLA path — "
+                        "in-kernel dropout is enabled by the "
+                        "chip-certification artifact "
+                        "(ops/pallas/dropout_cert.json, written by "
+                        "scripts/validate_flash_dropout.py on a "
+                        "passing live-chip run), which is absent or "
+                        "overridden here; eval/generation still use "
+                        "the kernel. Set the prob to 0.0 to train "
+                        "through the flash kernel.%s",
                         self.attention_probs_dropout_prob,
                         " At max_position_embeddings >= 4096 the dense "
                         "[b, h, s, s] scores will not fit and the "

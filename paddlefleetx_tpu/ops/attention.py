@@ -27,14 +27,34 @@ from jax.ad_checkpoint import checkpoint_name
 NEG_INF = -1e9
 
 
+#: certification artifact written by scripts/validate_flash_dropout.py
+#: on a PASSING live-chip run (rate-0 bit-equivalence, determinism,
+#: dropped-mass fraction, finite-difference fwd/bwd mask identity) and
+#: committed as evidence — its presence flips the gate default on
+DROPOUT_CERT_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "pallas",
+    "dropout_cert.json")
+
+
 def _kernel_dropout_enabled() -> bool:
-    """Opt-in gate for IN-KERNEL flash attention dropout
-    (``PFX_FLASH_DROPOUT=1``). Off by default until the implementation
-    is certified on a live chip (tests/test_flash_dropout_tpu.py —
-    ``pltpu.prng_seed`` has no CPU interpret lowering, so the dropout
-    path cannot even compile offline); flipping the default is the
-    chip-session follow-up."""
-    return os.environ.get("PFX_FLASH_DROPOUT") == "1"
+    """Gate for IN-KERNEL flash attention dropout. Self-certifying:
+
+    - ``PFX_FLASH_DROPOUT=1`` / ``=0`` force it on / off;
+    - otherwise it is on iff the chip-certification artifact
+      (``DROPOUT_CERT_PATH``) exists. ``pltpu.prng_seed`` has no CPU
+      interpret lowering, so the dropout path cannot even compile
+      offline — certification requires a live chip, and the artifact
+      records the device it passed on."""
+    env = os.environ.get("PFX_FLASH_DROPOUT")
+    if env is not None:
+        v = env.strip().lower()
+        if v in ("1", "true", "yes", "on"):
+            return True
+        if v in ("0", "false", "no", "off"):
+            return False
+        # unrecognized (including empty) must not silently veto a
+        # valid certification — fall through to the artifact
+    return os.path.exists(DROPOUT_CERT_PATH)
 
 # Non-causal dispatch crossover: below this KV length the dense XLA
 # batched matmul beats the flash kernel (measured on a v5e at ERNIE

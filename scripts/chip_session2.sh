@@ -36,4 +36,21 @@ timeout -k 60 1500 python scripts/tune_flash.py \
     >> bench_log/tune_flash2.log 2>&1
 log "tune rc=$?"
 
+# the stages session 1 lost to the tunnel outage
+log "stage: moe"
+timeout -k 60 1500 python bench.py --mode moe \
+    >> bench_log/bench_moe.log 2>&1
+log "moe rc=$?"
+
+log "stage: generation"
+timeout -k 60 1200 python bench.py --mode generation \
+    >> bench_log/bench_generation.log 2>&1
+log "generation rc=$?"
+
+# one COMPLETE headline record (train + fresh-process secondaries)
+log "stage: bench train complete"
+timeout -k 60 3600 python bench.py \
+    >> bench_log/bench_train.log 2>&1
+log "bench train complete rc=$?"
+
 log "session2 end"

@@ -18,8 +18,10 @@ Checks, strongest last:
 5. bf16-exp: with PFX_FLASH_BF16_EXP=1 the forward stays within bf16
    tolerance of the fp32-exp forward.
 
-Exit 0 = certified (then flip _kernel_dropout_enabled's default);
-nonzero = keep the gate closed.
+Exit 0 = certified — the script writes the certification artifact
+(``ops/pallas/dropout_cert.json``) whose presence flips
+``_kernel_dropout_enabled``'s default on (commit it as evidence);
+nonzero = the gate stays closed.
 """
 
 import os
@@ -153,6 +155,26 @@ def main():
     assert rel < 0.02, rel  # bf16 mantissa ~2^-8
 
     print("ALL CHECKS PASSED — in-kernel dropout certified")
+    # write the certification artifact: its presence flips
+    # _kernel_dropout_enabled's default on (self-certifying gate;
+    # commit it as evidence). PFX_FLASH_DROPOUT=0 still force-disables.
+    import datetime
+    import json
+
+    from paddlefleetx_tpu.ops.attention import DROPOUT_CERT_PATH
+    d = jax.devices()[0]
+    with open(DROPOUT_CERT_PATH, "w") as f:
+        json.dump({
+            "device_kind": d.device_kind,
+            "ts": datetime.datetime.now(
+                datetime.timezone.utc).isoformat(timespec="seconds"),
+            "checks": ["rate0_bitmatch", "determinism",
+                       "expectation", "zero_fraction",
+                       "grad_finite_difference", "bf16_exp_tolerance"],
+            "grad_rel_tol": 0.05,
+            "bf16_exp_rel_tol": 0.02,
+        }, f, indent=1)
+    print(f"certification artifact written: {DROPOUT_CERT_PATH}")
     return 0
 
 

@@ -426,3 +426,22 @@ def test_flash_dropout_traces_offline():
     for s in (512, 2048):
         grads = run(s, 0.2)
         assert all(g.shape == (2, s, 4, d) for g in grads)
+
+
+def test_kernel_dropout_gate_self_certifying(monkeypatch, tmp_path):
+    """The gate is ON iff the chip-cert artifact exists (written by
+    scripts/validate_flash_dropout.py on a passing live-chip run);
+    PFX_FLASH_DROPOUT overrides in both directions."""
+    from paddlefleetx_tpu.ops import attention
+
+    missing = tmp_path / "dropout_cert.json"
+    monkeypatch.setattr(attention, "DROPOUT_CERT_PATH", str(missing))
+    monkeypatch.delenv("PFX_FLASH_DROPOUT", raising=False)
+    assert not attention._kernel_dropout_enabled()
+    missing.write_text("{}")
+    assert attention._kernel_dropout_enabled()
+    monkeypatch.setenv("PFX_FLASH_DROPOUT", "0")
+    assert not attention._kernel_dropout_enabled()
+    missing.unlink()
+    monkeypatch.setenv("PFX_FLASH_DROPOUT", "1")
+    assert attention._kernel_dropout_enabled()

@@ -293,14 +293,17 @@ def test_ring_cp_dropout_training_refused_any_length():
     m._pp_setup(tokens, train=False)
 
 
-def test_flash_dropout_short_seq_warns_but_constructs():
+def test_flash_dropout_short_seq_warns_but_constructs(monkeypatch):
     """The reference's 345M recipe (dropout 0.1, s=1024) stays valid:
     dense fallback is a documented, benign operating point there —
     but it must WARN (the project logger has propagate=False, so
-    assert on the call itself)."""
+    assert on the call itself). Pin the kernel-dropout gate OFF: the
+    gate is self-certifying (a committed chip-cert artifact flips it
+    on), and this test asserts the UNcertified behavior."""
     from unittest import mock
 
     from paddlefleetx_tpu.utils.log import logger
+    monkeypatch.setenv("PFX_FLASH_DROPOUT", "0")
     with mock.patch.object(logger, "warning") as warn:
         cfg = GPTConfig(use_flash_attention=True,
                         attention_probs_dropout_prob=0.1,
@@ -308,6 +311,20 @@ def test_flash_dropout_short_seq_warns_but_constructs():
     assert cfg.use_flash_attention
     assert warn.called
     assert "dense XLA path" in warn.call_args[0][0]
+
+
+def test_flash_dropout_certified_gate_silences_warning(monkeypatch):
+    """With in-kernel dropout certified (gate on) there is no dense
+    fallback at the kernel-capable shapes and nothing to warn about."""
+    from unittest import mock
+
+    from paddlefleetx_tpu.utils.log import logger
+    monkeypatch.setenv("PFX_FLASH_DROPOUT", "1")
+    with mock.patch.object(logger, "warning") as warn:
+        GPTConfig(use_flash_attention=True,
+                  attention_probs_dropout_prob=0.1,
+                  max_position_embeddings=1024)
+    assert not warn.called
 
 
 def test_ulysses_cp_dropout_allowed_long_seq():
