@@ -608,8 +608,13 @@ def mfu_6p7b(peak):
       over acc=16 microbatches) + bf16 gradient accumulation — fp32
       params 6.9G + bf16 grad accum 3.5G fit; fp32 moments would not.
     - L=6: same offload, smaller prefix.
-    - L=3: everything resident (the round-3 operating point, now at
-      real vocab), fp32 accumulation.
+    - L=3: same offload — the bottom rung must be the LEANEST
+      config (~5G resident), not the heaviest: the r3-era
+      fp32-resident L=3 point was sized for the truncated vocab, and
+      at the real 50304 vocab its fp32 moments + fp32 accumulation
+      (~15G) made the SAFETY rung heavier than the offloaded L=8 it
+      was backstopping (every rung RESOURCE_EXHAUSTED on the r5
+      chip session).
 
     Returns ``(mfu, layers_measured)`` from the deepest config that
     fits, or None if none do."""
@@ -617,7 +622,7 @@ def mfu_6p7b(peak):
     ladder = [
         dict(L=8, b=1, acc=16, offload=True, gdtype=jnp.bfloat16),
         dict(L=6, b=1, acc=16, offload=True, gdtype=jnp.bfloat16),
-        dict(L=3, b=2, acc=4, offload=False, gdtype=jnp.float32),
+        dict(L=3, b=1, acc=16, offload=True, gdtype=jnp.bfloat16),
     ]
     for rung in ladder:
         L = rung["L"]

@@ -72,10 +72,12 @@ class GPTModule(LanguageModule):
             # position-table size: fine-tuning a long-context
             # checkpoint at s=1024 is the benign short-seq case even
             # when max_position_embeddings is 8192. With in-kernel
-            # dropout enabled (PFX_FLASH_DROPOUT=1, ops/attention.py)
-            # AND the kernel actually able to take this shape on this
+            # dropout enabled (self-certifying gate: chip-cert
+            # artifact or PFX_FLASH_DROPOUT override — see
+            # _kernel_dropout_enabled, ops/attention.py) AND the
+            # kernel actually able to take this shape on this
             # backend, the kernel handles the dropout itself — no
-            # dense fallback, nothing to refuse. The env var alone is
+            # dense fallback, nothing to refuse. The gate alone is
             # NOT enough: a shape the kernel rejects at dispatch
             # (head_dim, block alignment, non-TPU backend) would
             # silently fall back to dense and re-open the OOM trap.

@@ -41,10 +41,14 @@ def _kernel_dropout_enabled() -> bool:
 
     - ``PFX_FLASH_DROPOUT=1`` / ``=0`` force it on / off;
     - otherwise it is on iff the chip-certification artifact
-      (``DROPOUT_CERT_PATH``) exists. ``pltpu.prng_seed`` has no CPU
-      interpret lowering, so the dropout path cannot even compile
-      offline — certification requires a live chip, and the artifact
-      records the device it passed on."""
+      (``DROPOUT_CERT_PATH``) exists AND its recorded ``device_kind``
+      matches the attached TPU. Certification is per TPU generation —
+      Mosaic PRNG semantics differ across libtpu/device kinds (the r5
+      session hit a v5e-specific two-operand ``prng_seed`` limit), so
+      a v5e cert must not flip the default on a v3/v4 fleet; mismatch
+      falls back to dense with the documented warning. ``pltpu.
+      prng_seed`` has no CPU interpret lowering, so off-TPU the gate
+      is artifact-irrelevant anyway (dispatch refuses the kernel)."""
     env = os.environ.get("PFX_FLASH_DROPOUT")
     if env is not None:
         v = env.strip().lower()
@@ -54,7 +58,19 @@ def _kernel_dropout_enabled() -> bool:
             return False
         # unrecognized (including empty) must not silently veto a
         # valid certification — fall through to the artifact
-    return os.path.exists(DROPOUT_CERT_PATH)
+    try:
+        import json
+        with open(DROPOUT_CERT_PATH) as f:
+            kind = json.load(f).get("device_kind")
+    except (OSError, ValueError):
+        return False
+    if not kind:
+        return False
+    try:
+        d = jax.devices()[0]
+    except Exception:  # backend unavailable — claim nothing
+        return False
+    return d.platform == "tpu" and d.device_kind == kind
 
 # Non-causal dispatch crossover: below this KV length the dense XLA
 # batched matmul beats the flash kernel (measured on a v5e at ERNIE

@@ -53,4 +53,12 @@ timeout -k 60 3600 python bench.py \
     >> bench_log/bench_train.log 2>&1
 log "bench train complete rc=$?"
 
+# refresh the non-GPT family numbers if the window is still open
+for fam in vit imagen ernie; do
+    log "stage: family smoke $fam"
+    timeout -k 60 900 python scripts/smoke_family_tpu.py "$fam" \
+        >> "bench_log/family_$fam.log" 2>&1
+    log "family $fam rc=$?"
+done
+
 log "session2 end"

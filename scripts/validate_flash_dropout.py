@@ -163,7 +163,11 @@ def main():
 
     from paddlefleetx_tpu.ops.attention import DROPOUT_CERT_PATH
     d = jax.devices()[0]
-    with open(DROPOUT_CERT_PATH, "w") as f:
+    # atomic: a kill mid-write must not leave a truncated file that
+    # still flips the gate (the gate reads and validates the JSON,
+    # but a half-written valid prefix is cheap to rule out entirely)
+    tmp = DROPOUT_CERT_PATH + ".tmp"
+    with open(tmp, "w") as f:
         json.dump({
             "device_kind": d.device_kind,
             "ts": datetime.datetime.now(
@@ -174,6 +178,9 @@ def main():
             "grad_rel_tol": 0.05,
             "bf16_exp_rel_tol": 0.02,
         }, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, DROPOUT_CERT_PATH)
     print(f"certification artifact written: {DROPOUT_CERT_PATH}")
     return 0
 

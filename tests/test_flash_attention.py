@@ -430,18 +430,63 @@ def test_flash_dropout_traces_offline():
 
 def test_kernel_dropout_gate_self_certifying(monkeypatch, tmp_path):
     """The gate is ON iff the chip-cert artifact exists (written by
-    scripts/validate_flash_dropout.py on a passing live-chip run);
-    PFX_FLASH_DROPOUT overrides in both directions."""
+    scripts/validate_flash_dropout.py on a passing live-chip run)
+    AND its device_kind matches the attached TPU — certification is
+    per TPU generation, and off-TPU (this CPU test platform) the
+    artifact can never enable the kernel. PFX_FLASH_DROPOUT
+    overrides in both directions; empty/garbage values fall through
+    to the artifact; a truncated/invalid artifact is OFF."""
+    import json
+
+    import jax
+
     from paddlefleetx_tpu.ops import attention
 
-    missing = tmp_path / "dropout_cert.json"
-    monkeypatch.setattr(attention, "DROPOUT_CERT_PATH", str(missing))
+    cert = tmp_path / "dropout_cert.json"
+    monkeypatch.setattr(attention, "DROPOUT_CERT_PATH", str(cert))
     monkeypatch.delenv("PFX_FLASH_DROPOUT", raising=False)
+    assert not attention._kernel_dropout_enabled()  # no artifact
+    cert.write_text("{\"devi")  # truncated write
     assert not attention._kernel_dropout_enabled()
-    missing.write_text("{}")
-    assert attention._kernel_dropout_enabled()
+    cert.write_text("{}")  # no device_kind recorded
+    assert not attention._kernel_dropout_enabled()
+    # kind matches the attached device, but this platform is cpu —
+    # still off (the kernel cannot run here at all)
+    cert.write_text(json.dumps(
+        {"device_kind": jax.devices()[0].device_kind}))
+    assert not attention._kernel_dropout_enabled()
+    cert.write_text(json.dumps({"device_kind": "TPU v5 lite"}))
+    assert not attention._kernel_dropout_enabled()  # platform != tpu
+    # env forces both ways regardless of artifact state
     monkeypatch.setenv("PFX_FLASH_DROPOUT", "0")
     assert not attention._kernel_dropout_enabled()
-    missing.unlink()
     monkeypatch.setenv("PFX_FLASH_DROPOUT", "1")
     assert attention._kernel_dropout_enabled()
+    cert.unlink()
+    assert attention._kernel_dropout_enabled()  # env=1 needs no file
+    # unrecognized/empty env falls through to the (absent) artifact
+    monkeypatch.setenv("PFX_FLASH_DROPOUT", "")
+    assert not attention._kernel_dropout_enabled()
+
+
+def test_kernel_dropout_gate_matches_tpu_device(monkeypatch,
+                                                tmp_path):
+    """On a TPU whose device_kind matches the artifact the gate is
+    on; on a different TPU generation it stays off (simulated — the
+    test platform is CPU, so jax.devices is stubbed)."""
+    import json
+
+    from paddlefleetx_tpu.ops import attention
+
+    class _Dev:
+        platform = "tpu"
+        device_kind = "TPU v5 lite"
+
+    cert = tmp_path / "dropout_cert.json"
+    monkeypatch.setattr(attention, "DROPOUT_CERT_PATH", str(cert))
+    monkeypatch.delenv("PFX_FLASH_DROPOUT", raising=False)
+    monkeypatch.setattr(attention.jax, "devices", lambda: [_Dev()])
+    cert.write_text(json.dumps({"device_kind": "TPU v5 lite"}))
+    assert attention._kernel_dropout_enabled()
+    cert.write_text(json.dumps({"device_kind": "TPU v4"}))
+    assert not attention._kernel_dropout_enabled()
