@@ -396,6 +396,14 @@ PEAK_FLOPS_BY_KIND = {
 }
 
 
+def causal_attn_flops(b: int, h: int, s: int, d: int) -> float:
+    """Model FLOPs of one causal-attention forward at [b, h, s, d]:
+    QK^T + PV matmuls (2 each per element), half the square live.
+    Shared by the tuning/profiling scripts so the roofline accounting
+    cannot drift between them."""
+    return 4.0 * b * h * s * s * d * 0.5
+
+
 def peak_flops() -> float:
     d = jax.devices()[0]
     if d.platform != "tpu":

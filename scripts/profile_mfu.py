@@ -84,7 +84,8 @@ def bench_attn():
     q = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
     k = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
     v = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
-    fwd_flops = 4 * B * NH * S * S * D / 2  # causal halves live work
+    from bench import causal_attn_flops
+    fwd_flops = causal_attn_flops(B, NH, S, D)
     for bq, bkv in [(256, 256), (256, 512), (512, 512), (512, 1024),
                     (1024, 512), (1024, 1024), (512, 256)]:
         if bq > S or bkv > S:

@@ -80,7 +80,21 @@ def sweep(point: str, b: int, h: int, s: int, d: int):
                            True, kv_cache_layout=False)
         return jnp.sum(o.astype(jnp.float32) ** 2)
 
+    try:
+        from bench import causal_attn_flops, peak_flops
+        floor_ms = causal_attn_flops(b, h, s, d) / peak_flops() * 1e3
+    except Exception as e:
+        floor_ms = None
+        floor_err = f"{type(e).__name__}: {e}"
     print(f"== {point}: b={b} h={h} s={s} d={d} (bf16) ==")
+    if floor_ms is not None:
+        # self-check: any fwd below this is a measurement artifact
+        # (the r5 session's unchained timing read 40x past peak)
+        print(f"  roofline floor     : fwd {floor_ms:7.3f} ms "
+              f"(peak-bound; trust nothing faster)")
+    else:
+        print(f"  roofline floor unavailable ({floor_err[:80]}) — "
+              f"timings below are UNCHECKED against peak")
     blocks = sorted({min(512, s), min(1024, s), min(2048, s)})
     for bq in blocks:
         for bkv in blocks:

@@ -343,3 +343,48 @@ def test_sub_bench_timeout_kills_child(monkeypatch, capsys):
     assert fake.killed, "timed-out child must be killed, not orphaned"
     assert "timed out" in capsys.readouterr().err
     assert bench._child_proc is None
+
+
+class _TpuDev:
+    platform = "tpu"
+    device_kind = "TPU v5 lite"
+
+
+def test_bench_67b_emits_record(monkeypatch, capsys):
+    logged = []
+    monkeypatch.setattr(bench.jax, "devices", lambda: [_TpuDev()])
+    monkeypatch.setattr(bench, "peak_flops", lambda: 197e12)
+    monkeypatch.setattr(bench, "mfu_6p7b", lambda peak: (0.47, 8))
+    monkeypatch.setattr(bench, "_log_success", logged.append)
+    bench.bench_67b()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == "gpt3_6p7b_geometry_mfu"
+    assert rec["value"] == 0.47 and rec["unit"] == "mfu"
+    assert rec["layers_measured"] == 8
+    # vs_baseline is against the 0.45-MFU north star
+    assert abs(rec["vs_baseline"] - 0.47 / 0.45) < 1e-3
+    assert logged, "audit trail must receive the record"
+
+
+def test_bench_67b_no_rung_fits_is_failure(monkeypatch, capsys):
+    monkeypatch.setattr(bench.jax, "devices", lambda: [_TpuDev()])
+    monkeypatch.setattr(bench, "peak_flops", lambda: 197e12)
+    monkeypatch.setattr(bench, "mfu_6p7b", lambda peak: None)
+    # main() routes failure identity from --mode before dispatching
+    bench._active_metric = bench.METRIC_BY_MODE["67b"]
+    with pytest.raises(SystemExit) as e:
+        bench.bench_67b()
+    assert e.value.code == 1
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] is None and rec["unit"] == "mfu"
+
+
+def test_bench_longctx_emits_record(monkeypatch, capsys):
+    monkeypatch.setattr(bench.jax, "devices", lambda: [_TpuDev()])
+    monkeypatch.setattr(bench, "peak_flops", lambda: 197e12)
+    monkeypatch.setattr(bench, "long_context_mfu", lambda peak: 0.467)
+    monkeypatch.setattr(bench, "_log_success", lambda r: None)
+    bench.bench_longctx()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == "gpt345m_long_context_s8192_mfu"
+    assert rec["value"] == 0.467
