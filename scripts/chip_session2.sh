@@ -31,6 +31,15 @@ timeout -k 60 1800 python bench.py --mode longctx \
     >> bench_log/bench_longctx.log 2>&1
 log "longctx rc=$?"
 
+# lever #3 A/B: headline with bf16 exp in the online softmax — compare
+# against the warm headline in bench_train.log; flip _bf16_exp's
+# default only on a measured win (cert already bounds the numerics)
+log "stage: bench train bf16-exp probe (headline only)"
+PFX_FLASH_BF16_EXP=1 PFX_BENCH_SKIP_SECONDARIES=1 \
+    timeout -k 60 1500 python bench.py \
+    >> bench_log/bench_bf16exp.log 2>&1
+log "bf16exp rc=$?"
+
 log "stage: tune_flash (chained timing)"
 timeout -k 60 1500 python scripts/tune_flash.py \
     >> bench_log/tune_flash2.log 2>&1
