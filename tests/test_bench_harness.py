@@ -388,3 +388,109 @@ def test_bench_longctx_emits_record(monkeypatch, capsys):
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["metric"] == "gpt345m_long_context_s8192_mfu"
     assert rec["value"] == 0.467
+
+
+def test_bench_train_orchestration_on_tpu(monkeypatch, capsys):
+    """End-to-end (mocked) pin of the train-mode orchestration that
+    runs unattended in a chip window: headline measured and BANKED
+    (stashed for the SIGTERM path) before any secondary child runs,
+    parent releases the backend exactly once, child records merge
+    into the final JSON, and the audit trail gets the merged record."""
+    calls = []
+    logged = []
+    monkeypatch.setattr(bench, "_device_identity_cache",
+                        ("tpu", "TPU v5 lite"))
+    monkeypatch.setattr(bench, "_measure_train",
+                        lambda *a, **k: 50000.0)
+    monkeypatch.setattr(bench, "peak_flops", lambda: 197e12)
+    monkeypatch.setattr(bench, "_log_success", logged.append)
+
+    def release():
+        calls.append("release")
+        assert bench._headline_result is not None, \
+            "headline must be banked before the backend is dropped"
+        return True
+    monkeypatch.setattr(bench, "_release_backend", release)
+
+    def sub(mode, timeout=0):
+        calls.append(mode)
+        return {"value": 0.47, "layers_measured": 8} \
+            if mode == "67b" else {"value": 0.467}
+    monkeypatch.setattr(bench, "_sub_bench", sub)
+    monkeypatch.delenv("PFX_BENCH_SKIP_SECONDARIES", raising=False)
+    try:
+        bench.bench_train()
+    finally:
+        bench._headline_result = None  # don't leak into other tests
+    assert calls == ["release", "67b", "longctx"]
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 50000.0
+    assert rec["mfu_6p7b"] == 0.47
+    assert rec["mfu_6p7b_layers_measured"] == 8
+    assert rec["mfu_long_context_s8192"] == 0.467
+    assert logged and logged[-1]["mfu_6p7b"] == 0.47
+
+
+def test_bench_train_skip_secondaries_env(monkeypatch, capsys):
+    monkeypatch.setattr(bench, "_device_identity_cache",
+                        ("tpu", "TPU v5 lite"))
+    monkeypatch.setattr(bench, "_measure_train",
+                        lambda *a, **k: 50000.0)
+    monkeypatch.setattr(bench, "peak_flops", lambda: 197e12)
+    monkeypatch.setattr(bench, "_log_success", lambda r: None)
+    monkeypatch.setattr(bench, "_release_backend",
+                        lambda: (_ for _ in ()).throw(
+                            AssertionError("must not release")))
+    monkeypatch.setenv("PFX_BENCH_SKIP_SECONDARIES", "1")
+    try:
+        bench.bench_train()
+    finally:
+        bench._headline_result = None
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 50000.0 and rec["mfu_6p7b"] is None
+
+
+def test_banked_headline_emitted_on_failure(monkeypatch, capsys):
+    """A failure/kill AFTER the headline is banked must emit the
+    measured record (rc 0, with the interruption noted) — never a
+    failure record. This is the 'headline is never hostage to the
+    secondaries' guarantee a real chip window depends on."""
+    logged = []
+    monkeypatch.setattr(bench, "_log_success", logged.append)
+    monkeypatch.setattr(bench, "_headline_result",
+                        {"metric": bench.HEADLINE_METRIC,
+                         "value": 50178.1, "unit": "tokens/s"})
+    with pytest.raises(SystemExit) as e:
+        bench._emit_failure("backend_unavailable", "tunnel dropped")
+    assert e.value.code == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 50178.1
+    assert "tunnel dropped" in rec["secondaries_interrupted"]
+    assert "error_kind" not in rec
+    assert logged and logged[-1]["value"] == 50178.1
+
+
+def test_bench_train_release_failure_skips_children(monkeypatch,
+                                                    capsys):
+    """If the parent cannot release its PJRT client, the children
+    would only burn probe budget against a busy chip — they must be
+    skipped and the headline must still print."""
+    monkeypatch.setattr(bench, "_device_identity_cache",
+                        ("tpu", "TPU v5 lite"))
+    monkeypatch.setattr(bench, "_measure_train",
+                        lambda *a, **k: 50000.0)
+    monkeypatch.setattr(bench, "peak_flops", lambda: 197e12)
+    monkeypatch.setattr(bench, "_log_success", lambda r: None)
+    monkeypatch.setattr(bench, "_release_backend", lambda: False)
+    monkeypatch.setattr(bench, "_sub_bench",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            AssertionError("children must be skipped")))
+    monkeypatch.delenv("PFX_BENCH_SKIP_SECONDARIES", raising=False)
+    try:
+        bench.bench_train()
+    finally:
+        bench._headline_result = None
+    out = capsys.readouterr()
+    rec = json.loads(out.out.strip().splitlines()[-1])
+    assert rec["value"] == 50000.0 and rec["mfu_6p7b"] is None
+    assert "parent still holds the chip" in out.err
