@@ -23,6 +23,9 @@ def maybe_virtual_cpu_mesh() -> None:
 
 
 def train_main(argv=None):
+    """``tools/train.py`` entry: config parse -> mesh -> module ->
+    dataloaders -> ``Engine.fit`` (reference ``tools/train.py:37-67``
+    call stack, SURVEY.md section 3.1)."""
     maybe_virtual_cpu_mesh()
     from .core import Engine
     from .data import build_dataloader
@@ -70,6 +73,9 @@ def auto_main(argv=None):
 
 
 def eval_main(argv=None):
+    """``tools/eval.py`` entry: offline WikiText/LAMBADA evaluation
+    through ``GPTEvalModule`` (reference ``tools/eval.py:33-53``);
+    returns the metrics dict."""
     maybe_virtual_cpu_mesh()
     from .core import Engine
     from .data import build_dataloader
@@ -87,6 +93,10 @@ def eval_main(argv=None):
 
 
 def export_main(argv=None):
+    """``tools/export.py`` entry: jit + ``jax.export`` of the
+    inference forward into a re-partitionable artifact (replaces the
+    reference's ``to_static`` + per-rank dirs, ``tools/export.py:
+    32-49``)."""
     maybe_virtual_cpu_mesh()
     from .core import Engine
     from .models import build_module
@@ -117,6 +127,8 @@ def export_script(argv=None):
 
 
 def inference_main(argv=None):
+    """``tools/inference.py`` entry: load the exported artifact and
+    run batch prediction (reference ``tools/inference.py:37-59``)."""
     maybe_virtual_cpu_mesh()
     import numpy as np
 

@@ -55,6 +55,8 @@ class ErnieModule(LanguageModule):
         return ErnieForPretraining(self.model_config)
 
     def loss_fn(self, params, batch, rng, train: bool = True):
+        """MLM+NSP pretraining loss on dynamically masked GPTDataset
+        batches (reference ``ernie_module.py:56-102`` semantics)."""
         tokens, _position_ids, _labels, _loss_mask = batch
         cfg = self.model_config
         mask_rng, dropout_rng = jax.random.split(rng)

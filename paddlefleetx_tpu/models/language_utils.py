@@ -12,6 +12,9 @@ from __future__ import annotations
 
 
 def process_model_configs(config) -> None:
+    """Derive/validate model-section defaults in place — ffn=4h,
+    recompute granularity, virtual-pp divisibility (reference
+    ``models/language_model/utils.py:39-110``)."""
     model = config.Model
     if model.get("ffn_hidden_size") is None:
         model["ffn_hidden_size"] = 4 * model["hidden_size"]
@@ -84,6 +87,8 @@ def process_model_configs(config) -> None:
 
 
 def process_data_configs(config) -> None:
+    """Derive per-mode ``num_samples`` from the step/eval cadence
+    (reference ``models/language_model/utils.py:113-150``)."""
     g = config.Global
     engine = config.Engine
     max_steps = engine.get("max_steps", 500000)

@@ -91,6 +91,8 @@ class GeneralClsModule(BasicModule):
             log_dict["loss"], log_dict["eval_cost"])
 
     def validation_epoch_end(self, log_dict: Dict[str, Any]) -> None:
+        """Aggregate epoch top-k accuracy and track the best metric
+        (reference ``general_classification_module.py:86-127``)."""
         msg = ""
         if self.acc_list:
             keys = [k for k in self.acc_list[0] if k != "metric"]

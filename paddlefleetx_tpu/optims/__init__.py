@@ -19,6 +19,10 @@ from .optimizer import OPTIMIZERS, adam, fused_adamw, momentum  # noqa: F401
 
 
 def build_lr_scheduler(lr_config) -> Callable:
+    """Name-driven LR schedule factory (reference
+    ``optims/__init__.py:29-43``, without the ``eval()``): returns a
+    ``step -> lr`` callable; a config with no ``name`` yields a
+    constant rate."""
     lr_config = copy.deepcopy(dict(lr_config))
     name = lr_config.pop("name", None)
     if name is None:
@@ -34,6 +38,10 @@ def build_lr_scheduler(lr_config) -> Callable:
 
 def build_optimizer(config, lr_scheduler: Optional[Callable] = None
                     ) -> optax.GradientTransformation:
+    """Optimizer factory from the ``Optimizer`` config section
+    (reference ``optims/__init__.py:44-62``): global-norm grad clip +
+    FusedAdamW semantics; ``tensor_fusion``/``multi_precision`` knobs
+    are accepted and documented no-ops under XLA."""
     config = copy.deepcopy(dict(config))
     config.pop("lr", None)
     config.pop("tensor_fusion", None)       # subsumed by XLA fusion

@@ -19,6 +19,9 @@ import jax.numpy as jnp
 def cosine_annealing_with_warmup_decay(max_lr: float, min_lr: float,
                                        warmup_rate: float,
                                        decay_steps: int, **_):
+    """Linear warmup -> cosine decay -> ``min_lr`` floor (reference
+    ``optims/lr_scheduler.py:22-50``), as a jit-safe ``step -> lr``
+    schedule."""
     warmup_step = warmup_rate * decay_steps
 
     def schedule(step):
@@ -37,6 +40,9 @@ def cosine_annealing_with_warmup_decay(max_lr: float, min_lr: float,
 def vit_lr_scheduler(learning_rate: float, step_each_epoch: int, epochs: int,
                      decay_type: str = "cosine", linear_end: float = 1e-5,
                      warmup_steps: int = 0, **_):
+    """ViT schedule: warmup then cosine or linear decay (reference
+    ``optims/lr_scheduler.py:54-91``), epoch-count parameterized like
+    the reference's config surface."""
     t_max = epochs * step_each_epoch
     if warmup_steps >= t_max:
         warmup_steps = t_max - 1

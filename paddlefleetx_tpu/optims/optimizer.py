@@ -37,6 +37,11 @@ def fused_adamw(learning_rate: Callable, beta1: float = 0.9,
                 grad_clip_norm: Optional[float] = None,
                 state_dtype: Optional[str] = None,
                 **_) -> optax.GradientTransformation:
+    """AdamW with the reference's decay-exclusion semantics (bias and
+    norm params skip weight decay, reference
+    ``optims/optimizer.py:29-50``) plus optional global-norm clipping
+    and a moment-dtype knob for the ZeRO-offload path; XLA fuses the
+    update, so no hand-written fused kernel is needed."""
     txs = []
     if grad_clip_norm:
         txs.append(optax.clip_by_global_norm(grad_clip_norm))

@@ -68,6 +68,9 @@ class TopologyConfig:
 
     @classmethod
     def from_config(cls, config) -> "TopologyConfig":
+        """Build the topology from a parsed YAML config's
+        ``Distributed``/``Model`` sections (degree semantics of
+        reference ``utils/config.py:30-65``)."""
         dist = config.get("Distributed", {}) if hasattr(config, "get") else {}
         sharding = dist.get("sharding", {}) or {}
         model = config.get("Model", {}) if hasattr(config, "get") else {}
