@@ -1,3 +1,3 @@
-"""core subpackage."""
+"""Core subpackage."""
 from .engine import BasicEngine, Engine  # noqa: F401
 from .module import BasicModule, LanguageModule  # noqa: F401

@@ -1,4 +1,4 @@
-"""ctypes loader for the C++ index-map builders.
+"""Ctypes loader for the C++ index-map builders.
 
 Importing this module compiles ``fast_index_map.cpp`` on first use
 (one process builds under an exclusive file lock while concurrent

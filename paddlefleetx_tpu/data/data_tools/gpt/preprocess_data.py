@@ -1,4 +1,4 @@
-"""jsonl corpus -> memory-mapped token arrays for GPTDataset.
+"""Jsonl corpus -> memory-mapped token arrays for GPTDataset.
 
 Parity: reference ``data_tools/gpt/preprocess_data.py`` — a
 multiprocessing pool tokenizes ``{json_key: text}`` lines (optionally

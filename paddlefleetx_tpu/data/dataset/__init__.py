@@ -1,1 +1,1 @@
-"""dataset subpackage."""
+"""Dataset subpackage."""

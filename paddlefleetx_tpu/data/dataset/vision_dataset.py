@@ -21,7 +21,8 @@ from ..transforms import build_transforms
 
 
 class GeneralClsDataset:
-    """image_root + "path label" list file (reference :26-103)."""
+    """List-file dataset: image_root + "path label" lines (reference
+    :26-103)."""
 
     def __init__(self, image_root: str, cls_label_path: str,
                  transform_ops=None, delimiter: Optional[str] = None,

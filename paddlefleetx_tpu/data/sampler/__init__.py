@@ -1,1 +1,1 @@
-"""sampler subpackage."""
+"""Sampler subpackage."""

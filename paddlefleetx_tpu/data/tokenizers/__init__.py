@@ -1,1 +1,1 @@
-"""tokenizers subpackage."""
+"""Tokenizers subpackage."""

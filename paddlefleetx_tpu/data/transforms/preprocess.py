@@ -44,7 +44,7 @@ def _to_pil(img):
 
 
 class DecodeImage:
-    """bytes/ndarray -> RGB (or raw) HWC uint8 array."""
+    """Bytes/ndarray -> RGB (or raw) HWC uint8 array."""
 
     def __init__(self, to_rgb: bool = True, channel_first: bool = False,
                  backend: str = "pil"):
@@ -138,7 +138,7 @@ class RandCropImage:
 
 
 class RandFlipImage:
-    """flip_code 1 = horizontal (the reference's cv2 convention),
+    """Flip_code 1 = horizontal (the reference's cv2 convention),
     0 = vertical, -1 = both."""
 
     def __init__(self, flip_code: int = 1):

@@ -69,7 +69,7 @@ def _dense(cfg: ErnieConfig, features, name: str, in_axes, out_axes,
 
 
 class ErnieEmbeddings(nn.Module):
-    """word + position + token-type (+ task-type) embeddings, LN,
+    """Word + position + token-type (+ task-type) embeddings, LN,
     dropout (reference ``single_model.py:37-118``)."""
     config: ErnieConfig
 
@@ -224,7 +224,7 @@ class ErnieEncoderLayer(nn.Module):
 
 
 class ErniePooler(nn.Module):
-    """dense + tanh over the first ([CLS]) token (reference :120-133)."""
+    """Dense + tanh over the first ([CLS]) token (reference :120-133)."""
     config: ErnieConfig
 
     @nn.compact
@@ -333,7 +333,7 @@ class ErnieModel(nn.Module):
 
 
 class ErnieLMPredictionHead(nn.Module):
-    """transform -> act -> LN -> tied-embedding decoder + bias
+    """Transform -> act -> LN -> tied-embedding decoder + bias
     (reference :419-459)."""
     config: ErnieConfig
 

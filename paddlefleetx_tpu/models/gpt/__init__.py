@@ -1,4 +1,4 @@
-"""gpt subpackage."""
+"""Gpt subpackage."""
 from .config import GPTConfig  # noqa: F401
 from .model import (  # noqa: F401
     GPTEmbeddings, GPTForPretraining, GPTModel, MultiHeadAttention,

@@ -417,7 +417,7 @@ def tied_logits(x: jax.Array, word_emb: jax.Array) -> jax.Array:
 
 def masked_nll_sums(logits: jax.Array, labels: jax.Array,
                     loss_mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """fp32 masked token NLL: ``(sum of nll over unmasked, mask sum)``.
+    """Fp32 masked token NLL: ``(sum of nll over unmasked, mask sum)``.
 
     The shared core of the pretraining criterion and the offline-eval
     scorer; with vocab-sharded logits GSPMD turns the log-sum-exp and

@@ -79,7 +79,7 @@ def drop_path(x: jax.Array, rate: float, deterministic: bool,
 
 
 class ViTAttention(nn.Module):
-    """qkv (optional bias) -> scaled softmax -> proj (reference
+    """Qkv (optional bias) -> scaled softmax -> proj (reference
     ``layers/attention.py:21-60``)."""
     config: ViTConfig
 

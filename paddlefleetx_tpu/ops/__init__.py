@@ -1,2 +1,2 @@
-"""ops subpackage."""
+"""Ops subpackage."""
 from .attention import dot_product_attention  # noqa: F401

@@ -1,1 +1,1 @@
-"""pallas subpackage."""
+"""Pallas subpackage."""

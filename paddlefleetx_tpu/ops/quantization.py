@@ -88,7 +88,7 @@ def quantize_params(params, bits: int = 8,
 
 
 def activation_quant_interceptor(bits: int = 8):
-    """flax interceptor quantizing the input of every Dense/Conv."""
+    """Flax interceptor quantizing the input of every Dense/Conv."""
     targets = (nn.Dense, nn.DenseGeneral, nn.Conv)
 
     def interceptor(next_fn, args, kwargs, context):

@@ -190,7 +190,7 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                            batch_axes=None, heads_axis: str = None,
                            causal: bool = True,
                            use_flash: Optional[bool] = None) -> jax.Array:
-    """shard_map wrapper: global ``[b, s, h, d]`` -> global attention
+    """A ``shard_map`` wrapper: global ``[b, s, h, d]`` -> global attention
     output, with s sharded over ``axis_name`` and the ring running
     inside. Axis defaults come from the mesh convention
     (``parallel/mesh.py``), not re-spelled strings."""

@@ -1,4 +1,4 @@
-"""parallel subpackage."""
+"""Parallel subpackage."""
 from .mesh import (  # noqa: F401
     DP_AXIS, FSDP_AXIS, MP_AXIS, PP_AXIS, DATA_AXES,
     TopologyConfig, build_mesh, get_mesh, set_mesh, batch_spec,

@@ -1,1 +1,1 @@
-"""tools subpackage."""
+"""Tools subpackage."""

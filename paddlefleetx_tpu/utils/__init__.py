@@ -1,4 +1,4 @@
-"""utils subpackage."""
+"""Utils subpackage."""
 from .config import (  # noqa: F401
     AttrDict, get_config, parse_config, override_config, process_configs,
     parse_args, print_config,

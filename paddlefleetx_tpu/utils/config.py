@@ -49,7 +49,7 @@ def bf16_enabled(config) -> bool:
 
 
 class AttrDict(dict):
-    """dict with attribute access; missing keys raise AttributeError."""
+    """Dict with attribute access; missing keys raise AttributeError."""
 
     def __getattr__(self, key):
         try:
