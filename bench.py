@@ -954,14 +954,21 @@ def bench_moe():
     against ``bench_train``'s dense MFU."""
     on_tpu = jax.devices()[0].platform == "tpu"
     batch, seq, acc = (4, 1024, 8) if on_tpu else (2, 128, 1)
+    # off-TPU: machinery smoke only — shrink the stack (the full
+    # h=1024/8-expert fp32 stack is multi-GB and minutes on CPU)
+    shrink = {} if on_tpu else dict(
+        vocab_size=512, hidden_size=64, num_attention_heads=4,
+        ffn_hidden_size=128, max_position_embeddings=128)
     cfg = _gpt345m(
         on_tpu, use_recompute=on_tpu,
         recompute_granularity="save_dots" if on_tpu else "full",
         loss_chunks=8 if on_tpu else 1,
-        num_layers=8,
-        moe_num_experts=8, moe_top_k=2, moe_capacity_factor=1.25,
+        num_layers=8 if on_tpu else 2,
+        moe_num_experts=8 if on_tpu else 4,
+        moe_top_k=2, moe_capacity_factor=1.25,
         moe_z_loss_weight=1e-3,
-        scan_layers=not on_tpu)   # unrolled: 45.8k -> 53.1k tokens/s
+        scan_layers=not on_tpu,   # unrolled: 45.8k -> 53.1k tokens/s
+        **shrink)
     tokens_per_sec = _measure_train(cfg, batch, seq, acc,
                                     6 if on_tpu else 2, on_tpu)
     peak = peak_flops() if on_tpu else None

@@ -80,6 +80,9 @@ _SLOW_PATTERNS = (
     "test_engine.py::test_sigterm_during_eval_breaks_out_and_saves",
     "test_engine.py::test_profiler_summary_printed",
     "test_moe.py::test_moe_generation_decodes",
+    # r5: full offline executions of the decode/MoE bench paths
+    "test_bench_harness.py::test_bench_generation_runs_offline",
+    "test_bench_harness.py::test_bench_moe_runs_offline",
 )
 
 

@@ -494,3 +494,23 @@ def test_bench_train_release_failure_skips_children(monkeypatch,
     rec = json.loads(out.out.strip().splitlines()[-1])
     assert rec["value"] == 50000.0 and rec["mfu_6p7b"] is None
     assert "parent still holds the chip" in out.err
+
+
+def test_bench_generation_runs_offline(capsys):
+    """The decode bench's tiny CPU path must execute end to end and
+    emit a finite tokens/s record (the on-chip number reuses exactly
+    this code at 345M shapes)."""
+    bench.bench_generation()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == bench.METRIC_BY_MODE["generation"]
+    assert rec["value"] > 0 and rec["unit"] == "tokens/s"
+
+
+def test_bench_moe_runs_offline(capsys):
+    """The MoE bench's tiny CPU path must execute end to end; MFU is
+    None off-TPU (no calibrated peak), throughput finite."""
+    bench.bench_moe()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == bench.METRIC_BY_MODE["moe"]
+    assert rec["value"] > 0
+    assert rec["mfu_active_flops"] is None
