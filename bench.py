@@ -943,6 +943,10 @@ def bench_train():
                 "chip, they would only burn probe budget\n")
     _log_success(result)
     print(json.dumps(result))
+    # the final record is out: un-bank it so a late signal (e.g. the
+    # driver's cleanup SIGTERM racing process exit) cannot emit the
+    # success record a second time
+    _headline_result = None
 
 
 def bench_moe():

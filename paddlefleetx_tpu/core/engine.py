@@ -26,6 +26,7 @@ import os
 import signal
 import sys
 import time
+from collections import deque
 from typing import Any, Dict, Optional
 
 import flax.linen as nn
@@ -106,6 +107,11 @@ class Engine(BasicEngine):
         # periodic checkpoint, SURVEY.md §5.3)
         self.save_on_preemption = bool(
             save_load.get("save_on_preemption", True))
+        # TPU-native extra: batches staged ahead of the consuming step
+        # (host->device transfer overlapped with compute; 2 = classic
+        # double buffering, 0 = synchronous _put_batch between steps).
+        # See _prefetch_iter and docs/standard.md.
+        self.prefetch_depth = int(eng.get("prefetch_depth", 2))
         self.output_dir = save_load.get("output_dir", "./output")
         self.ckpt_dir = save_load.get("ckpt_dir")
 
@@ -146,6 +152,10 @@ class Engine(BasicEngine):
         #: ``_print_summary``, eager_engine.py:684-721 — device-time
         #: tables live in the XProf trace; this is the host view)
         self._step_costs = []
+        #: per-step host time spent staging the NEXT batch's
+        #: host->device transfer (_prefetch_iter); near-zero means the
+        #: transfer is fully hidden behind the jitted step
+        self._h2d_waits = []
         self._init_state()
         self._build_steps()
         if self.ckpt_dir:
@@ -407,6 +417,70 @@ class Engine(BasicEngine):
 
         return jax.tree.map(put, batch)
 
+    def _prefetch_iter(self, loader, depth=None):
+        """Double-buffered device staging: yields
+        ``(device_batch, h2d_wait_seconds)`` with up to ``depth``
+        batches' host->device transfers in flight ahead of the
+        consumer, so batch N+1's transfer is ISSUED before the
+        consumer ever blocks on step N's result — the transfer rides
+        under the jitted step instead of serializing after it
+        (``jax.device_put`` dispatches asynchronously).
+
+        ``h2d_wait_seconds`` is the host time this iterator spent
+        staging (collation + pretreating + the device-put dispatch)
+        per yielded batch — the step loop's observable input stall.
+
+        Correctness notes:
+
+        - ``pretreating_batch`` and ``_put_batch`` move inside the
+          iterator and keep the loader's order (a FIFO deque), so the
+          multi-host collective assembly in ``_put_batch``
+          (``make_array_from_process_local_data``) happens in the
+          SAME sequence on every process.
+        - Preemption/resume accounting is untouched: batches staged
+          but never consumed are simply dropped, and
+          ``consumed_samples`` is derived from the trained step count
+          (``save()``: step * global_batch_size), never from loader
+          position — a resume replays the staged-but-untrained
+          batches.
+        - ``depth <= 0`` degrades to the synchronous per-step put.
+        """
+        if depth is None:
+            depth = self.prefetch_depth
+        buf = deque()
+        it = iter(loader)
+
+        def stage():
+            try:
+                batch = next(it)
+            except StopIteration:
+                return False
+            batch = self.module.pretreating_batch(batch)
+            buf.append(self._put_batch(batch))
+            return True
+
+        if depth <= 0:
+            while True:
+                t0 = time.time()
+                if not stage():
+                    return
+                yield buf.popleft(), time.time() - t0
+            return
+        prime = time.time()
+        for _ in range(depth):
+            if not stage():
+                break
+        prime = time.time() - prime
+        first = True
+        while buf:
+            t0 = time.time()
+            stage()          # issue batch N+depth before handing out N
+            wait = time.time() - t0
+            # the pipeline fill is the first yield's wait: it is real
+            # input latency the first step pays
+            yield buf.popleft(), (wait + prime if first else wait)
+            first = False
+
     # -- loops ----------------------------------------------------------
 
     def _finalize_vit_schedule(self, train_data_loader) -> None:
@@ -433,6 +507,7 @@ class Engine(BasicEngine):
             valid_data_loader=None):
         self._finalize_vit_schedule(train_data_loader)
         self._step_costs = []   # per-fit summary samples
+        self._h2d_waits = []
         self._preempt_signum = None
         prev_handler, installed = None, False
         if self.save_on_preemption:
@@ -508,13 +583,14 @@ class Engine(BasicEngine):
         # every iteration would sync and kill async dispatch
         step = self._host_step
         with self.mesh, nn.logical_axis_rules(self.rules):
-            for batch in train_data_loader:
+            for batch, h2d_wait in self._prefetch_iter(
+                    train_data_loader):
                 if step >= self.max_steps:
                     return
                 self._profiler_step(step)
-                batch = self.module.pretreating_batch(batch)
                 self.state, metrics = self._train_step(
-                    self.state, self._put_batch(batch))
+                    self.state, batch)
+                self._h2d_waits.append(h2d_wait)
                 step += 1
                 self._host_step = step
                 if step % self.logging_freq == 0:
@@ -566,6 +642,13 @@ class Engine(BasicEngine):
         logger.info("  steady state: mean %.4f / min %.4f / max %.4f "
                     "s/step (%.2f step/s)", mean, min(steady),
                     max(steady), 1.0 / mean if mean else 0.0)
+        if self._h2d_waits:
+            # first wait carries the pipeline fill; report it apart
+            waits = self._h2d_waits[1:] or self._h2d_waits
+            logger.info("  h2d input wait: mean %.4f / max %.4f s/step "
+                        "after fill %.4f s (prefetch depth %d)",
+                        sum(waits) / len(waits), max(waits),
+                        self._h2d_waits[0], self.prefetch_depth)
         if (self.configs.get("Profiler", {}) or {}).get("detailed"):
             # reference Profiler.detailed prints the full table views;
             # the host-side analogue is every window's timing
@@ -614,7 +697,8 @@ class Engine(BasicEngine):
         walks the whole loader (reference ``_evaluate_one_epoch``)."""
         losses = []
         t0 = time.time()
-        for i, batch in enumerate(valid_data_loader):
+        for i, (batch, _h2d) in enumerate(
+                self._prefetch_iter(valid_data_loader)):
             if max_iters is not None and i >= max_iters:
                 break
             if self._preempt_signum is not None:
@@ -622,8 +706,7 @@ class Engine(BasicEngine):
                 # eval pass outlive them — the preemption checkpoint
                 # in _fit_epochs is what matters
                 break
-            batch = self.module.pretreating_batch(batch)
-            out = self._eval_step(self.state, self._put_batch(batch))
+            out = self._eval_step(self.state, batch)
             losses.append(float(out["loss"]))
             extra = {k: float(v) for k, v in out.items() if k != "loss"}
             self.module.validation_step_end({
@@ -646,14 +729,13 @@ class Engine(BasicEngine):
         outs = []
         t0 = time.time()
         with self.mesh, nn.logical_axis_rules(self.rules):
-            for i, batch in enumerate(test_data_loader):
+            for i, (batch, _h2d) in enumerate(
+                    self._prefetch_iter(test_data_loader)):
                 if i >= self.test_iters:
                     logger.info("The predicting process is complete.")
                     break
-                batch = self.module.pretreating_batch(batch)
                 out = jax.device_get(
-                    self._predict_step(self.state,
-                                       self._put_batch(batch)))
+                    self._predict_step(self.state, batch))
                 outs.append(out)
                 arr = out.get("loss") if isinstance(out, dict) else out
                 self.module.test_step_end({

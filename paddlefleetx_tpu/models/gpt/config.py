@@ -117,10 +117,15 @@ class GPTConfig:
                     "supports dropout).",
                     self.attention_probs_dropout_prob)
             elif self.use_flash_attention:
-                # with in-kernel dropout enabled the kernel path holds
-                # under training dropout — nothing to warn about
-                from ...ops.attention import _kernel_dropout_enabled
-                if not _kernel_dropout_enabled():
+                # with in-kernel dropout configured the kernel path
+                # holds under training dropout — nothing to warn
+                # about. The CONFIGURED check (env var + artifact
+                # presence only) is deliberate: config construction
+                # must not probe jax.devices() and initialize the
+                # PJRT backend as a side effect; the device-kind
+                # match happens at kernel-dispatch time
+                from ...ops.attention import _kernel_dropout_configured
+                if not _kernel_dropout_configured():
                     from ...utils.log import logger
                     logger.warning(
                         "use_flash_attention=True with "

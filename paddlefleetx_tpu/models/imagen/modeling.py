@@ -264,10 +264,10 @@ class ImagenModel(nn.Module):
                     f"{time_pairs.shape[0]} sampling steps")
             time_pairs = time_pairs[skip_steps:]
 
-        def step(carry, tp):
+        def step(mdl, carry, tp):
             x, k = carry
             t, t_next = tp[0], tp[1]
-            pred = self._pred_with_cond_scale(
+            pred = mdl._pred_with_cond_scale(
                 i, x, scheduler.get_condition(t), text_embeds,
                 text_masks, lowres_noisy, lowres_times, cond_scale)
             if self.objectives[i] == "noise":
@@ -291,7 +291,15 @@ class ImagenModel(nn.Module):
             x = mean + not_last * jnp.exp(0.5 * log_var) * noise
             return (x, k), None
 
-        (x, _), _ = jax.lax.scan(step, (x0, loop_rng), time_pairs)
+        # nn.scan, not jax.lax.scan: the body calls bound submodules
+        # (the stage U-Net), whose scope must be threaded through the
+        # scan legally — a raw lax.scan trips flax's trace-level check
+        # (linen scopes are pinned to the trace they were bound at).
+        # Params broadcast (read-only per step); no rng is drawn inside
+        # the body — the noise keys ride in the carry.
+        scanned = nn.scan(step, variable_broadcast="params",
+                          split_rngs={}, in_axes=0, out_axes=0)
+        (x, _), _ = scanned(self, (x0, loop_rng), time_pairs)
         return self._unnormalize(jnp.clip(x, -1.0, 1.0))
 
     def sample(self, text_embeds=None, text_masks=None,

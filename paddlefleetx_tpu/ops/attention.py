@@ -36,6 +36,62 @@ DROPOUT_CERT_PATH = os.path.join(
     "dropout_cert.json")
 
 
+def _dropout_env_force():
+    """The ``PFX_FLASH_DROPOUT`` tri-state: True/False when forced,
+    None to fall through to the certification artifact."""
+    env = os.environ.get("PFX_FLASH_DROPOUT")
+    if env is not None:
+        v = env.strip().lower()
+        if v in ("1", "true", "yes", "on"):
+            return True
+        if v in ("0", "false", "no", "off"):
+            return False
+        # unrecognized (including empty) must not silently veto a
+        # valid certification — fall through to the artifact
+    return None
+
+
+#: mtime-keyed cache of the certification artifact read, so the gate
+#: decision does not re-read the file on every dispatch trace; tests
+#: that rewrite the artifact invalidate it naturally via mtime
+_cert_cache: dict = {}
+
+
+def _dropout_cert_kind():
+    """``device_kind`` recorded in the certification artifact, or None
+    when absent/unreadable. Pure file I/O — never touches the jax
+    backend."""
+    try:
+        mtime = os.path.getmtime(DROPOUT_CERT_PATH)
+    except OSError:
+        return None
+    hit = _cert_cache.get(DROPOUT_CERT_PATH)
+    if hit is not None and hit[0] == mtime:
+        return hit[1]
+    try:
+        import json
+        with open(DROPOUT_CERT_PATH) as f:
+            kind = json.load(f).get("device_kind") or None
+    except (OSError, ValueError):
+        kind = None
+    _cert_cache[DROPOUT_CERT_PATH] = (mtime, kind)
+    return kind
+
+
+def _kernel_dropout_configured() -> bool:
+    """Whether in-kernel dropout is CONFIGURED on: the env force, else
+    the certification artifact's presence. Checks only the env var and
+    artifact — no ``jax.devices()`` probe — so config-construction
+    warning paths (``models/gpt/config.py``) can call it without
+    initializing the PJRT backend as a side effect. The device-kind
+    match is deferred to ``_kernel_dropout_enabled`` at
+    kernel-dispatch time, where the backend is up anyway."""
+    forced = _dropout_env_force()
+    if forced is not None:
+        return forced
+    return _dropout_cert_kind() is not None
+
+
 def _kernel_dropout_enabled() -> bool:
     """Gate for IN-KERNEL flash attention dropout. Self-certifying:
 
@@ -46,24 +102,13 @@ def _kernel_dropout_enabled() -> bool:
       Mosaic PRNG semantics differ across libtpu/device kinds (the r5
       session hit a v5e-specific two-operand ``prng_seed`` limit), so
       a v5e cert must not flip the default on a v3/v4 fleet; mismatch
-      falls back to dense with the documented warning. ``pltpu.
-      prng_seed`` has no CPU interpret lowering, so off-TPU the gate
-      is artifact-irrelevant anyway (dispatch refuses the kernel)."""
-    env = os.environ.get("PFX_FLASH_DROPOUT")
-    if env is not None:
-        v = env.strip().lower()
-        if v in ("1", "true", "yes", "on"):
-            return True
-        if v in ("0", "false", "no", "off"):
-            return False
-        # unrecognized (including empty) must not silently veto a
-        # valid certification — fall through to the artifact
-    try:
-        import json
-        with open(DROPOUT_CERT_PATH) as f:
-            kind = json.load(f).get("device_kind")
-    except (OSError, ValueError):
-        return False
+      falls back to dense with the documented warning. Only called at
+      kernel-dispatch time — config-construction paths use
+      ``_kernel_dropout_configured`` and never probe the backend."""
+    forced = _dropout_env_force()
+    if forced is not None:
+        return forced
+    kind = _dropout_cert_kind()
     if not kind:
         return False
     try:
@@ -128,24 +173,31 @@ def dot_product_attention(
     """
     skv = k.shape[3] if kv_cache_layout else k.shape[1]
     # training dropout on the kernel path: in-kernel philox masks
-    # (reference fused softmax-with-dropout, hybrid_model.py:277-285)
+    # (reference fused softmax-with-dropout, hybrid_model.py:277-285).
+    # Bias (ERNIE padding masks, GPT attn_mask) rides into the kernel
+    # as a tiled operand, causal or not; no DENSE_NONCAUSAL crossover
+    # here — the dense path pays the [b, h, sq, sk] dropout-mask
+    # traffic on top of the score materialization, so the kernel wins
+    # at every training shape
     if (use_flash and dropout_rate > 0.0 and not deterministic
-            and dropout_rng is not None and bias is None
-            and not kv_cache_layout and causal
+            and dropout_rng is not None
+            and not kv_cache_layout
             and _kernel_dropout_enabled()):
         try:
             from .pallas import flash_attention as fa
             return fa.flash_attention(q, k, v, causal=causal,
                                       query_offset=query_offset,
                                       dropout_rate=dropout_rate,
-                                      dropout_rng=dropout_rng)
+                                      dropout_rng=dropout_rng,
+                                      bias=bias)
         except (ImportError, NotImplementedError):
             pass
     # deterministic makes a configured dropout_rate inert, so eval and
     # generation may take the kernel even when training cannot
     if use_flash and (deterministic or dropout_rate == 0.0):
         # the decode kernel takes a per-key additive bias (generation's
-        # left-pad mask: [b, 1, 1, skv]); the training kernel does not
+        # left-pad mask: [b, 1, 1, skv]); the training kernel takes
+        # any bias broadcastable to [b, h, sq, skv]
         decode_bias_ok = causal and q.shape[1] == 1 and (
             bias is None or
             (bias.ndim == 4 and bias.shape[1] == bias.shape[2] == 1
@@ -165,10 +217,10 @@ def dot_product_attention(
             # wins causally (mask never materializes) and at long
             # sequences in either mode
             flash_worthwhile = causal or skv >= DENSE_NONCAUSAL_MAX_SKV
-            if bias is None and not kv_cache_layout and \
-                    flash_worthwhile:
+            if not kv_cache_layout and flash_worthwhile:
                 return fa.flash_attention(q, k, v, causal=causal,
-                                          query_offset=query_offset)
+                                          query_offset=query_offset,
+                                          bias=bias)
         except (ImportError, NotImplementedError):
             pass
     return _xla_attention(q, k, v, bias, causal, query_offset, dropout_rate,

@@ -67,6 +67,29 @@ def _dropout_threshold(rate: float):
                           int(round((1.0 - rate) * 4294967296.0))))
 
 
+def _interpret_random_bits(seed, fold, block_q, block_kv):
+    """Counter-based uint32 bits for INTERPRET mode only: pltpu's
+    per-core PRNG has no CPU lowering, so off-TPU the keep mask comes
+    from a stateless murmur3-style finalizer over (seed, block fold,
+    lane coordinates). Same regenerability contract as the TPU path —
+    a pure function of the same inputs, so forward and backward
+    rebuild identical masks — but a DIFFERENT bit pattern: interpret
+    runs validate dropout semantics and plumbing, never TPU numerics
+    (those are certified on-chip by scripts/validate_flash_dropout.py).
+    Module-level so tests can rebuild the exact mask for a dense
+    oracle."""
+    r = jax.lax.broadcasted_iota(jnp.uint32, (block_q, block_kv), 0)
+    c = jax.lax.broadcasted_iota(jnp.uint32, (block_q, block_kv), 1)
+    x = (jnp.asarray(seed, jnp.int32).astype(jnp.uint32)
+         * jnp.uint32(0x9E3779B1)
+         + jnp.asarray(fold, jnp.int32).astype(jnp.uint32)
+         * jnp.uint32(0x85EBCA77)
+         + r * jnp.uint32(0xC2B2AE3D) + c * jnp.uint32(0x27D4EB2F))
+    x = (x ^ (x >> 15)) * jnp.uint32(0x2C1B3C6D)
+    x = (x ^ (x >> 12)) * jnp.uint32(0x297A2D39)
+    return x ^ (x >> 15)
+
+
 def _block_keep_mask(seed_ref, b, qi, ki, n_q, n_kv, rate, block_q,
                      block_kv):
     """Regenerable [block_q, block_kv] keep mask for score block
@@ -80,10 +103,17 @@ def _block_keep_mask(seed_ref, b, qi, ki, n_q, n_kv, rate, block_q,
     libtpu ("Setting seed with more than 2 values is not supported",
     r5 chip cert) — using the STATIC block counts (n_q, n_kv) shared
     by the forward and backward pallas_calls, so the fold is injective
-    and kernel-order independent. Callers assert the fold fits i32."""
-    pltpu.prng_seed(seed_ref[0], (b * n_q + qi) * n_kv + ki)
-    bits = pltpu.bitcast(pltpu.prng_random_bits((block_q, block_kv)),
-                         jnp.uint32)
+    and kernel-order independent. Callers guard the fold against i32
+    overflow. Interpret mode substitutes the stateless hash above for
+    the (TPU-only) hardware PRNG."""
+    fold = (b * n_q + qi) * n_kv + ki
+    if _interpret():
+        bits = _interpret_random_bits(seed_ref[0], fold, block_q,
+                                      block_kv)
+    else:
+        pltpu.prng_seed(seed_ref[0], fold)
+        bits = pltpu.bitcast(pltpu.prng_random_bits((block_q, block_kv)),
+                             jnp.uint32)
     return bits < _dropout_threshold(rate)
 
 
@@ -176,10 +206,17 @@ def _masked_dispatch(block_fn, qi, ki, block_q, block_kv, causal,
         pl.when(live)(lambda: block_fn(False))
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
-                acc_scr, *, sm_scale, causal, block_q, block_kv, num_kv,
-                query_offset, dropout_rate=0.0, seed_ref=None,
-                num_q=None):
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs, sm_scale, causal, block_q,
+                block_kv, num_kv, query_offset, dropout_rate=0.0,
+                seed_ref=None, num_q=None, has_bias=False):
+    if has_bias:
+        bias_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        bias_ref = None
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    # hoisted OUTSIDE the pl.when blocks: 0.4.x interpret mode cannot
+    # substitute program_id inside a cond closure
+    bhi = pl.program_id(0)
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -198,11 +235,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
             s = jnp.where(
                 _causal_mask(qi, ki, block_q, block_kv, query_offset),
                 s, NEG_INF)
+        if has_bias:
+            # additive bias tile ([bq|1, bkv] broadcasts over rows for
+            # the [b,1,1,sk] padding-mask form), AFTER the causal mask
+            # like the XLA path — -1e9-style mask values on top of the
+            # -1e30 causal fill stay very negative
+            s = s + bias_ref[0, 0].astype(jnp.float32)
         drop_fn = None
         if dropout_rate > 0.0:
             def drop_fn(p):
                 keep = _block_keep_mask(
-                    seed_ref, pl.program_id(0), qi, ki, num_q, num_kv,
+                    seed_ref, bhi, qi, ki, num_q, num_kv,
                     dropout_rate, block_q, block_kv)
                 return jnp.where(keep, p / (1.0 - dropout_rate),
                                  jnp.zeros_like(p))
@@ -218,29 +261,57 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         lse_ref[0] = (m_scr[:] + jnp.log(l))
 
 
-def _fwd_kernel_seeded(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                       m_scr, l_scr, acc_scr, **kw):
-    """Scalar-prefetch wrapper: PrefetchScalarGridSpec delivers the
-    dropout seed as the leading ref."""
-    _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
-                acc_scr, seed_ref=seed_ref, **kw)
-
-
 def _vma(x):
     """Varying-across-mesh axes of a traced value — pallas out_shapes
     must carry them for shard_map's vma checker to accept the call
-    (outputs vary exactly where q does)."""
-    return getattr(jax.typeof(x), "vma", None)
+    (outputs vary exactly where q does). jax 0.4.x has neither
+    ``jax.typeof`` nor the vma concept; there the checker doesn't
+    exist either, so None is correct."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return None
+    return getattr(typeof(x), "vma", None)
+
+
+def _sds(shape, dtype, ref):
+    """ShapeDtypeStruct carrying ``ref``'s vma when this jax supports
+    the kwarg (0.4.x ShapeDtypeStruct rejects it)."""
+    vma = _vma(ref)
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
+def _bias_spec(bias, num_heads, block_q, block_kv, qk_of_ids):
+    """BlockSpec for a canonical ``[b0, h0, q0, skv]`` additive bias
+    (each leading dim 1 or full — ``_canon_bias``) on a bh-flattened
+    grid: broadcast dims pin their block index to 0 so the SAME tile
+    is re-referenced (Pallas elides the redundant copies), full dims
+    follow the program's (batch, head, q-block, kv-block) coordinates.
+    ``qk_of_ids`` maps the grid ids to (qi, ki) — the three backward
+    grids iterate in different orders."""
+    b0, h0, q0, _ = bias.shape
+    bq_b = block_q if q0 > 1 else 1
+
+    def idx(*ids):
+        qi, ki = qk_of_ids(*ids)
+        return ((ids[0] // num_heads) if b0 > 1 else 0,
+                (ids[0] % num_heads) if h0 > 1 else 0,
+                qi if q0 > 1 else 0,
+                ki)
+
+    return pl.BlockSpec((1, 1, bq_b, block_kv), idx)
 
 
 def _flash_forward(q, k, v, sm_scale, causal, query_offset, block_q,
-                   block_kv, dropout_rate=0.0, seed=None):
+                   block_kv, dropout_rate=0.0, seed=None, bias=None,
+                   num_heads=None):
     bh, sq, d = q.shape
     skv = k.shape[1]
     num_q, num_kv = sq // block_q, skv // block_kv
     out_shape = [
-        jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=_vma(q)),
-        jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32, vma=_vma(q)),
+        _sds((bh, sq, d), q.dtype, q),
+        _sds((bh, sq, 1), jnp.float32, q),
     ]
     scratch = [
         pltpu.VMEM((block_q, 1), jnp.float32),
@@ -258,15 +329,21 @@ def _flash_forward(q, k, v, sm_scale, causal, query_offset, block_q,
         pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
         pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
     ]
+    operands = [q, k, v]
+    if bias is not None:
+        in_specs.append(_bias_spec(bias, num_heads, block_q, block_kv,
+                                   lambda b, qi, ki: (qi, ki)))
+        operands.append(bias)
     if dropout_rate > 0.0:
         # the mixed-radix (b, qi, ki) seed fold must stay within i32
-        assert bh * num_q * num_kv < 2 ** 31, (
-            "dropout seed fold overflows i32 for this grid")
+        if bh * num_q * num_kv >= 2 ** 31:
+            raise NotImplementedError(
+                "dropout seed fold overflows i32 for this grid")
         kernel = functools.partial(
-            _fwd_kernel_seeded, sm_scale=sm_scale, causal=causal,
+            _seeded(_fwd_kernel), sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_kv=block_kv, num_kv=num_kv,
             query_offset=query_offset, dropout_rate=dropout_rate,
-            num_q=num_q)
+            num_q=num_q, has_bias=bias is not None)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(bh, num_q, num_kv),
@@ -277,10 +354,11 @@ def _flash_forward(q, k, v, sm_scale, causal, query_offset, block_q,
         return pl.pallas_call(
             kernel, grid_spec=grid_spec, out_shape=out_shape,
             interpret=_interpret(),
-        )(seed, q, k, v)
+        )(seed, *operands)
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
-        block_kv=block_kv, num_kv=num_kv, query_offset=query_offset)
+        block_kv=block_kv, num_kv=num_kv, query_offset=query_offset,
+        has_bias=bias is not None)
     return pl.pallas_call(
         kernel,
         grid=(bh, num_q, num_kv),
@@ -289,7 +367,7 @@ def _flash_forward(q, k, v, sm_scale, causal, query_offset, block_q,
         out_shape=out_shape,
         scratch_shapes=scratch,
         interpret=_interpret(),
-    )(q, k, v)
+    )(*operands)
 
 
 # -- backward ----------------------------------------------------------
@@ -298,7 +376,7 @@ def _flash_forward(q, k, v, sm_scale, causal, query_offset, block_q,
 def _bwd_block_math(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     masked, qi, ki, sm_scale, block_q, block_kv,
                     query_offset, dropout_rate=0.0, seed_ref=None,
-                    num_q=None, num_kv=None):
+                    num_q=None, num_kv=None, bias_ref=None, bhi=None):
     """Score-block recomputation shared by all backward kernels:
     ``(q_s, p_dv, ds)`` with q pre-scaled (so dk = ds^T @ q_s absorbs
     one sm_scale factor and the OTHER stays pending on dq — the caller
@@ -319,11 +397,16 @@ def _bwd_block_math(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jnp.where(
             _causal_mask(qi, ki, block_q, block_kv, query_offset),
             s, NEG_INF)
+    if bias_ref is not None:
+        # same post-mask position as the forward: lse was computed on
+        # the biased scores, so p = exp(s + bias - lse) reconstructs
+        # the forward's probabilities exactly
+        s = s + bias_ref[0, 0].astype(jnp.float32)
     p = jnp.exp(s - lse)                                # [bq, bkv]
     dp = _dot(do, v, trans_b=True)                      # [bq, bkv]
     p_dv = p
     if dropout_rate > 0.0:
-        keep = _block_keep_mask(seed_ref, pl.program_id(0), qi, ki,
+        keep = _block_keep_mask(seed_ref, bhi, qi, ki,
                                 num_q, num_kv, dropout_rate, block_q,
                                 block_kv)
         inv = 1.0 / (1.0 - dropout_rate)
@@ -334,9 +417,15 @@ def _bwd_block_math(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
-                    block_q, block_kv, num_q, query_offset,
-                    dropout_rate=0.0, seed_ref=None, num_kv=None):
+                    *refs, sm_scale, causal, block_q, block_kv, num_q,
+                    query_offset, dropout_rate=0.0, seed_ref=None,
+                    num_kv=None, has_bias=False):
+    if has_bias:
+        bias_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
+    else:
+        bias_ref = None
+        dk_ref, dv_ref, dk_scr, dv_scr = refs
+    bhi = pl.program_id(0)
     ki, qi = pl.program_id(1), pl.program_id(2)
 
     @pl.when(qi == 0)
@@ -348,7 +437,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q_s, p_dv, ds = _bwd_block_math(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, masked,
             qi, ki, sm_scale, block_q, block_kv, query_offset,
-            dropout_rate, seed_ref, num_q, num_kv)
+            dropout_rate, seed_ref, num_q, num_kv, bias_ref, bhi)
         dv_scr[:] += _dot(p_dv.astype(do_ref.dtype), do_ref[0],
                           trans_a=True)
         dk_scr[:] += _dot(ds.astype(q_s.dtype), q_s, trans_a=True)
@@ -363,9 +452,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_scr, *, sm_scale, causal, block_q,
-                   block_kv, num_kv, query_offset, dropout_rate=0.0,
-                   seed_ref=None, num_q=None):
+                   *refs, sm_scale, causal, block_q, block_kv, num_kv,
+                   query_offset, dropout_rate=0.0, seed_ref=None,
+                   num_q=None, has_bias=False):
+    if has_bias:
+        bias_ref, dq_ref, dq_scr = refs
+    else:
+        bias_ref = None
+        dq_ref, dq_scr = refs
+    bhi = pl.program_id(0)
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -376,7 +471,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         _, _, ds = _bwd_block_math(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, masked,
             qi, ki, sm_scale, block_q, block_kv, query_offset,
-            dropout_rate, seed_ref, num_q, num_kv)
+            dropout_rate, seed_ref, num_q, num_kv, bias_ref, bhi)
         dq_scr[:] += _dot(ds.astype(k_ref.dtype), k_ref[0])
 
     _masked_dispatch(_block, qi, ki, block_q, block_kv, causal,
@@ -388,10 +483,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_combined_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
-                         delta_ref, dq_ref, dk_ref, dv_ref, dq_scr, *,
-                         sm_scale, causal, block_q, block_kv, num_kv,
-                         query_offset, dropout_rate=0.0,
-                         seed_ref=None):
+                         delta_ref, *refs, sm_scale, causal, block_q,
+                         block_kv, num_kv, query_offset,
+                         dropout_rate=0.0, seed_ref=None,
+                         has_bias=False):
     """Combined backward for the ``num_q == 1`` regime (the training
     hot path: s <= block_q, and every ring-attention shard): ONE pass
     over the ki blocks produces dq, dk, AND dv — the split kernel
@@ -400,6 +495,12 @@ def _bwd_combined_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
     With a single q block, dq accumulates in VMEM scratch exactly
     like the split dq kernel, while each ki's dk/dv block is visited
     once and written directly."""
+    if has_bias:
+        bias_ref, dq_ref, dk_ref, dv_ref, dq_scr = refs
+    else:
+        bias_ref = None
+        dq_ref, dk_ref, dv_ref, dq_scr = refs
+    bhi = pl.program_id(0)
     ki = pl.program_id(1)
 
     @pl.when(ki == 0)
@@ -410,7 +511,7 @@ def _bwd_combined_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         q_s, p_dv, ds = _bwd_block_math(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, masked,
             0, ki, sm_scale, block_q, block_kv, query_offset,
-            dropout_rate, seed_ref, 1, num_kv)
+            dropout_rate, seed_ref, 1, num_kv, bias_ref, bhi)
         dv_ref[0] = _dot(p_dv.astype(do_ref.dtype), do_ref[0],
                          trans_a=True).astype(dv_ref.dtype)
         dk_ref[0] = _dot(ds.astype(q_s.dtype), q_s,
@@ -536,12 +637,16 @@ def _flash_backward_fused(q, k, v, g, lse, delta, sm_scale, causal,
         return None
     # the resident tensors' block index never changes within one bh —
     # single-buffer them so the pipeline does not allocate a useless
-    # second copy of the largest VMEM tenants
-    single = pl.Buffered(buffer_count=1)
+    # second copy of the largest VMEM tenants (jax 0.4.x has no
+    # pipeline_mode; there the pipeline still elides the copies, it
+    # just double-allocates the buffers)
+    buffered = getattr(pl, "Buffered", None)
+    mode_kw = {} if buffered is None else {
+        "pipeline_mode": buffered(buffer_count=1)}
     res_spec = pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0),
-                            pipeline_mode=single)
+                            **mode_kw)
     row_spec = pl.BlockSpec((1, sq, 1), lambda b, i: (b, 0, 0),
-                            pipeline_mode=single)
+                            **mode_kw)
     kv_spec = pl.BlockSpec((1, bkv, d), lambda b, i: (b, i, 0))
     dq32, dk, dv = pl.pallas_call(
         functools.partial(
@@ -552,12 +657,9 @@ def _flash_backward_fused(q, k, v, g, lse, delta, sm_scale, causal,
         in_specs=[res_spec, kv_spec, kv_spec, res_spec, row_spec,
                   row_spec],
         out_specs=[res_spec, kv_spec, kv_spec],
-        out_shape=[jax.ShapeDtypeStruct((bh, sq, d), jnp.float32,
-                                        vma=_vma(q)),
-                   jax.ShapeDtypeStruct((bh, skv, d), k.dtype,
-                                        vma=_vma(q)),
-                   jax.ShapeDtypeStruct((bh, skv, d), v.dtype,
-                                        vma=_vma(q))],
+        out_shape=[_sds((bh, sq, d), jnp.float32, q),
+                   _sds((bh, skv, d), k.dtype, q),
+                   _sds((bh, skv, d), v.dtype, q)],
         interpret=_interpret(),
     )(q, k, v, g, lse, delta)
     return (dq32 * sm_scale).astype(q.dtype), dk, dv
@@ -582,7 +684,8 @@ def _lift_spec(spec):
 
 
 def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
-                    block_kv, g_lse=None, dropout_rate=0.0, seed=None):
+                    block_kv, g_lse=None, dropout_rate=0.0, seed=None,
+                    bias=None, num_heads=None):
     q, k, v, out, lse = res
     bh, sq, d = q.shape
     skv = k.shape[1]
@@ -595,16 +698,22 @@ def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
         # as delta' = delta - g_lse — no kernel change needed
         delta = delta - g_lse.astype(jnp.float32)
     dropout = dropout_rate > 0.0
-    if dropout:
+    if dropout and bh * num_q * num_kv >= 2 ** 31:
         # the mixed-radix (b, qi, ki) seed fold must stay within i32
-        assert bh * num_q * num_kv < 2 ** 31, (
+        raise NotImplementedError(
             "dropout seed fold overflows i32 for this grid")
+    bias_ops = () if bias is None else (bias,)
 
     def _call(kernel_fn, grid, in_specs, out_specs, out_shape,
-              scratch_shapes, **kernel_kw):
-        """One backward pallas_call; with dropout the seed rides as a
-        prefetched scalar and every index map gains the trailing
-        scalar-ref arg."""
+              scratch_shapes, qk_of_ids, **kernel_kw):
+        """One backward pallas_call; the bias (if any) rides as a
+        trailing operand with a per-grid index map; with dropout the
+        seed rides as a prefetched scalar and every index map gains
+        the trailing scalar-ref arg."""
+        if bias is not None:
+            in_specs = in_specs + [_bias_spec(
+                bias, num_heads, block_q, block_kv, qk_of_ids)]
+            kernel_kw["has_bias"] = True
         if dropout:
             kernel = functools.partial(
                 _seeded(kernel_fn), dropout_rate=dropout_rate,
@@ -619,13 +728,13 @@ def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
             return pl.pallas_call(
                 kernel, grid_spec=grid_spec, out_shape=out_shape,
                 interpret=_interpret(),
-            )(seed, q, k, v, g, lse, delta)
+            )(seed, q, k, v, g, lse, delta, *bias_ops)
         kernel = functools.partial(kernel_fn, **kernel_kw)
         return pl.pallas_call(
             kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
             out_shape=out_shape, scratch_shapes=scratch_shapes,
             interpret=_interpret(),
-        )(q, k, v, g, lse, delta)
+        )(q, k, v, g, lse, delta, *bias_ops)
 
     if num_q == 1:
         q_spec = pl.BlockSpec((1, block_q, d), lambda b, i: (b, 0, 0))
@@ -638,22 +747,21 @@ def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
             in_specs=[q_spec, kv_spec, kv_spec, q_spec, r_spec,
                       r_spec],
             out_specs=[q_spec, kv_spec, kv_spec],
-            out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype,
-                                            vma=_vma(q)),
-                       jax.ShapeDtypeStruct((bh, skv, d), k.dtype,
-                                            vma=_vma(q)),
-                       jax.ShapeDtypeStruct((bh, skv, d), v.dtype,
-                                            vma=_vma(q))],
+            qk_of_ids=lambda b, i: (0, i),
+            out_shape=[_sds((bh, sq, d), q.dtype, q),
+                       _sds((bh, skv, d), k.dtype, q),
+                       _sds((bh, skv, d), v.dtype, q)],
             scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
             sm_scale=sm_scale, causal=causal, block_q=block_q,
             block_kv=block_kv, num_kv=num_kv,
             query_offset=query_offset)
         return dq, dk, dv
 
-    if not dropout:
+    if not dropout and bias is None:
         # the fused kernel tiles at its own internal block sizes, so
-        # its regenerated dropout masks could not match the forward's —
-        # dropout uses the split pair below instead
+        # its regenerated dropout masks could not match the forward's
+        # (and it has no bias plumbing) — those cases use the split
+        # pair below instead
         fused = _flash_backward_fused(q, k, v, g, lse, delta, sm_scale,
                                       causal, query_offset)
         if fused is not None:
@@ -667,10 +775,9 @@ def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
         grid=(bh, num_kv, num_q),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, r_spec, r_spec],
         out_specs=[kv_spec, kv_spec],
-        out_shape=[jax.ShapeDtypeStruct((bh, skv, d), k.dtype,
-                                        vma=_vma(q)),
-                   jax.ShapeDtypeStruct((bh, skv, d), v.dtype,
-                                        vma=_vma(q))],
+        qk_of_ids=lambda b, i, j: (j, i),
+        out_shape=[_sds((bh, skv, d), k.dtype, q),
+                   _sds((bh, skv, d), v.dtype, q)],
         scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32),
                         pltpu.VMEM((block_kv, d), jnp.float32)],
         sm_scale=sm_scale, causal=causal, block_q=block_q,
@@ -686,8 +793,8 @@ def _flash_backward(res, g, sm_scale, causal, query_offset, block_q,
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, r_spec2,
                   r_spec2],
         out_specs=q_spec2,
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype,
-                                       vma=_vma(q)),
+        qk_of_ids=lambda b, i, j: (i, j),
+        out_shape=_sds((bh, sq, d), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         sm_scale=sm_scale, causal=causal, block_q=block_q,
         block_kv=block_kv, num_kv=num_kv, num_q=num_q,
@@ -767,6 +874,69 @@ _flash_lse_dropout.defvjp(_flash_lse_dropout_fwd,
                           _flash_lse_dropout_bwd)
 
 
+def _canon_bias(bias, b, h, sq, skv):
+    """Validate an additive attention bias for the kernel: 4D
+    ``[b0, h0, q0, skv]`` with every leading dim either 1 or full (the
+    padding-mask ``[b, 1, 1, skv]`` and dense ``[b, h, sq, skv]``
+    forms both qualify) and the LAST dim full — a size-1 key dim would
+    add the same value to every score in a row, which softmax's shift
+    invariance makes a no-op, so refusing it costs nothing.
+    NotImplementedError sends the caller to the XLA fallback."""
+    if bias.ndim != 4:
+        raise NotImplementedError(
+            f"bias must be 4D broadcastable, got shape {bias.shape}")
+    b0, h0, q0, k0 = bias.shape
+    if k0 != skv:
+        raise NotImplementedError(
+            f"bias key dim {k0} must equal kv length {skv}")
+    if b0 not in (1, b) or h0 not in (1, h) or q0 not in (1, sq):
+        raise NotImplementedError(
+            f"bias shape {bias.shape} not broadcastable to "
+            f"[{b}, {h}, {sq}, {skv}]")
+    return bias
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash_lse_biased(q, k, v, bias, seed, sm_scale, causal, block_q,
+                      block_kv, dropout_rate, num_heads):
+    """Biased twin of ``_flash_lse`` / ``_flash_lse_dropout``: an
+    additive ``[b0, h0, q0, skv]`` bias rides into every kernel as a
+    tiled operand (``_bias_spec``). The bias is treated as an
+    attention MASK, not a trained tensor — its cotangent is defined
+    as ZERO (learned ALiBi-style biases must use the XLA path; see
+    docs/attention_dispatch.md). ``seed`` is ignored when
+    ``dropout_rate == 0`` (callers pass a dummy)."""
+    return _flash_forward(q, k, v, sm_scale, causal, 0, block_q,
+                          block_kv, dropout_rate, seed, bias=bias,
+                          num_heads=num_heads)
+
+
+def _flash_lse_biased_fwd(q, k, v, bias, seed, sm_scale, causal,
+                          block_q, block_kv, dropout_rate, num_heads):
+    out, lse = _flash_forward(q, k, v, sm_scale, causal, 0, block_q,
+                              block_kv, dropout_rate, seed, bias=bias,
+                              num_heads=num_heads)
+    out = checkpoint_name(out, "attn")
+    lse = checkpoint_name(lse, "attn")
+    return (out, lse), (q, k, v, out, lse, bias, seed)
+
+
+def _flash_lse_biased_bwd(sm_scale, causal, block_q, block_kv,
+                          dropout_rate, num_heads, res, g):
+    q, k, v, out, lse, bias, seed = res
+    g_out, g_lse = g
+    dq, dk, dv = _flash_backward(
+        (q, k, v, out, lse), g_out, sm_scale, causal, 0, block_q,
+        block_kv, g_lse=g_lse, dropout_rate=dropout_rate, seed=seed,
+        bias=bias, num_heads=num_heads)
+    import numpy as np
+    return (dq, dk, dv, jnp.zeros_like(bias),
+            np.zeros(seed.shape, jax.dtypes.float0))
+
+
+_flash_lse_biased.defvjp(_flash_lse_biased_fwd, _flash_lse_biased_bwd)
+
+
 def check_shapes(sq, skv, d, block_q: int = None,
                  block_kv: int = None):
     """(block_q, block_kv) after clamping, or NotImplementedError —
@@ -800,17 +970,24 @@ def _to_bh(x):
 
 def flash_attention(q, k, v, causal: bool = True, query_offset=0,
                     block_q: int = None, block_kv: int = None,
-                    dropout_rate: float = 0.0, dropout_rng=None):
-    """``[b, s, h, d]`` causal attention; raises NotImplementedError
-    when the shape/backend can't take the kernel (caller falls back to
-    the XLA path in ``ops.attention``).
+                    dropout_rate: float = 0.0, dropout_rng=None,
+                    bias=None):
+    """``[b, s, h, d]`` attention; raises NotImplementedError when the
+    shape/backend can't take the kernel (caller falls back to the XLA
+    path in ``ops.attention``).
+
+    ``bias`` is an additive score bias broadcastable to
+    ``[b, h, sq, skv]`` (each leading dim 1 or full — ERNIE padding
+    masks ``[b, 1, 1, skv]``, GPT attn_mask) tiled into every kernel;
+    it is treated as a non-differentiable MASK (zero cotangent).
 
     ``dropout_rate > 0`` runs IN-KERNEL attention-probs dropout (the
     reference's fused softmax-with-dropout training path,
     ``hybrid_model.py:277-285``): the per-core PRNG generates the keep
     mask inside each score block from (seed, block coords) — no
-    [b, h, s, s] mask tensor ever exists, in either direction.
-    TPU-only: ``pltpu.prng_seed`` has no interpret lowering."""
+    [b, h, s, s] mask tensor ever exists, in either direction. In
+    interpret mode a stateless hash substitutes for the (TPU-only)
+    hardware PRNG so CPU tests can validate the plumbing."""
     if jax.default_backend() != "tpu" and not _interpret():
         raise NotImplementedError("flash kernel targets TPU")
     if not isinstance(query_offset, int) or query_offset != 0:
@@ -818,14 +995,25 @@ def flash_attention(q, k, v, causal: bool = True, query_offset=0,
     b, sq, h, d = q.shape
     block_q, block_kv = check_shapes(sq, k.shape[1], d, block_q,
                                      block_kv)
+    if dropout_rate > 0.0 and dropout_rng is None:
+        raise NotImplementedError(
+            "flash dropout needs a dropout_rng")
+    if bias is not None:
+        bias = _canon_bias(bias, b, h, sq, k.shape[1])
+        # the kernels add the bias in f32 and its (zero) cotangent
+        # must be float-typed; one cast here covers bool/int masks
+        if bias.dtype != jnp.float32:
+            bias = bias.astype(jnp.float32)
+        if dropout_rate > 0.0:
+            seed = jax.random.randint(dropout_rng, (1,), 0,
+                                      2 ** 31 - 1, dtype=jnp.int32)
+        else:
+            seed = jnp.zeros((1,), jnp.int32)   # ignored
+        out, _ = _flash_lse_biased(
+            _to_bh(q), _to_bh(k), _to_bh(v), bias, seed, d ** -0.5,
+            causal, block_q, block_kv, float(dropout_rate), h)
+        return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     if dropout_rate > 0.0:
-        if dropout_rng is None:
-            raise NotImplementedError(
-                "flash dropout needs a dropout_rng")
-        if _interpret():
-            raise NotImplementedError(
-                "in-kernel dropout has no interpret lowering "
-                "(pltpu.prng_seed is TPU-only)")
         seed = jax.random.randint(dropout_rng, (1,), 0, 2 ** 31 - 1,
                                   dtype=jnp.int32)
         out, _ = _flash_lse_dropout(
@@ -1017,8 +1205,7 @@ def flash_decode(q, k, v, query_offset, bias=None,
                 pltpu.VMEM((h, d), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((b, h, d, 1), q.dtype,
-                                       vma=_vma(q)),
+        out_shape=_sds((b, h, d, 1), q.dtype, q),
         interpret=_interpret(),
     )(off, *operands)
     # [b, h, d, 1] -> [b, 1, h, d]

@@ -24,6 +24,21 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+try:                                    # jax >= 0.5 re-exports it
+    _shard_map = jax.shard_map
+except AttributeError:                  # 0.4.x spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _axis_size(axis_name):
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        # 0.4.x: psum of a static 1 is evaluated eagerly to a Python
+        # int — the classic pre-axis_size spelling
+        return jax.lax.psum(1, axis_name)
+
+
 NEG_INF = -1e30
 
 
@@ -64,7 +79,7 @@ def _ring_flash(q, k, v, axis_name, causal, scale):
     """
     from .pallas.flash_attention import flash_attention_with_lse
 
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, sq, h, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -146,7 +161,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if use_flash:
         return _ring_flash(q, k, v, axis_name, causal, scale)
 
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     q_start = idx * sq
 
@@ -205,5 +220,5 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     spec = P(batch_axes, axis_name, heads_axis, None)
     fn = partial(ring_attention, axis_name=axis_name, causal=causal,
                  use_flash=use_flash)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+    return _shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)(q, k, v)

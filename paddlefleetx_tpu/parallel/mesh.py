@@ -313,4 +313,9 @@ def cpu_mesh_env(n: int = 8) -> None:
         + f" --xla_force_host_platform_device_count={n}")
     os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n)
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        # older jax has no jax_num_cpu_devices option; the XLA_FLAGS
+        # line above already forces the host device count there
+        pass

@@ -355,8 +355,14 @@ def pipeline_value_and_grad(
         # ---- forward wave -------------------------------------------
         inp = jax.lax.dynamic_index_in_dim(
             x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False)
-        fstate = _constrain(fstate.at[0, 0].set(inp),
-                            P(None, PP_AXIS, DATA_AXES))
+        # .at[0, 0].set is a two-dim-index scatter; with dim 1 sharded
+        # over pp the SPMD partitioner mis-broadcasts the index
+        # concatenation (hlo-verifier RET_CHECK on 0.4.x). A
+        # dynamic_update_slice at a constant origin partitions cleanly
+        # and is the same write.
+        fstate = jax.lax.dynamic_update_slice(
+            fstate, inp[None, None], (0,) * fstate.ndim)
+        fstate = _constrain(fstate, P(None, PP_AXIS, DATA_AXES))
         stash = _constrain(stash.at[:, :, t % D].set(fstate),
                            P(None, PP_AXIS, None, DATA_AXES))
         m_f = jnp.clip(t - k_arr, 0, M - 1)

@@ -26,6 +26,9 @@ import os
 from typing import Any, Callable, Dict, List, Sequence, Tuple
 
 import jax
+# on jax 0.4.x the export module exists but is not re-exported as a
+# lazy `jax.export` attribute — the explicit submodule import binds it
+import jax.export
 import numpy as np
 import orbax.checkpoint as ocp
 
@@ -205,7 +208,11 @@ def load_inference_model(model_dir: str, mesh=None):
     flat_specs = spec["metadata"].get("param_specs")
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
         if mesh is not None and flat_specs:
-            meta_tree = ckptr.metadata(params_path).item_metadata.tree
+            meta = ckptr.metadata(params_path)
+            # newer orbax wraps the metadata tree; 0.7.x returns the
+            # pytree of ArrayMetadata (with .shape/.dtype) directly
+            meta_tree = getattr(
+                getattr(meta, "item_metadata", None), "tree", meta)
             shardings = deserialize_param_specs(flat_specs, meta_tree,
                                                 mesh)
             abstract = jax.tree.map(

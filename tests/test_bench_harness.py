@@ -370,8 +370,11 @@ def test_bench_67b_no_rung_fits_is_failure(monkeypatch, capsys):
     monkeypatch.setattr(bench.jax, "devices", lambda: [_TpuDev()])
     monkeypatch.setattr(bench, "peak_flops", lambda: 197e12)
     monkeypatch.setattr(bench, "mfu_6p7b", lambda peak: None)
-    # main() routes failure identity from --mode before dispatching
-    bench._active_metric = bench.METRIC_BY_MODE["67b"]
+    # main() routes failure identity from --mode before dispatching;
+    # monkeypatch (not bare assignment) so the module global is
+    # restored for later tests — bench state leaks across the session
+    monkeypatch.setattr(bench, "_active_metric",
+                        bench.METRIC_BY_MODE["67b"])
     with pytest.raises(SystemExit) as e:
         bench.bench_67b()
     assert e.value.code == 1

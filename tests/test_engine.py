@@ -199,7 +199,11 @@ def test_sigterm_preemption_saves_and_stops(tmp_path):
                 os.kill(os.getpid(), _signal.SIGTERM)
 
     prev = _signal.getsignal(_signal.SIGTERM)
-    engine.fit(epoch=1, train_data_loader=kicking(loader, 2))
+    # the input prefetcher pulls prefetch_depth batches ahead of the
+    # trained step, so kick that many pulls later to land the signal
+    # after >= 2 TRAINED steps (the pull count is not the step count)
+    engine.fit(epoch=1, train_data_loader=kicking(
+        loader, 2 + engine.prefetch_depth))
     assert _signal.getsignal(_signal.SIGTERM) is prev
 
     step = int(engine.state["step"])
@@ -449,6 +453,10 @@ def test_sharding_offload_shardings_request_pinned_host():
     from paddlefleetx_tpu.parallel.sharding import (
         device_memory_kinds, offload_to_host,
     )
+    kinds = {m.kind for m in jax.devices()[0].addressable_memories()}
+    if "pinned_host" not in kinds:
+        pytest.skip("backend has no pinned_host memory space "
+                    f"(addressable: {sorted(kinds)})")
     mesh = Mesh(np.array(jax.devices()), ("fsdp",))
     tree = {"mu": NamedSharding(mesh, P("fsdp")),
             "count": NamedSharding(mesh, P())}
@@ -505,3 +513,94 @@ def test_profiler_summary_printed(tmp_path, monkeypatch):
     assert any("Profiler summary" in l for l in lines)
     assert any("steady state" in l for l in lines)
     assert any("tokens/s" in l for l in lines)
+
+
+# -- input prefetch -----------------------------------------------------
+
+class _FakePrefetchHost:
+    """Just enough engine surface for Engine._prefetch_iter: records
+    the interleaving of device puts and yields."""
+
+    def __init__(self, events, depth):
+        self.events = events
+        self.prefetch_depth = depth
+        host = self
+
+        class _Mod:
+            @staticmethod
+            def pretreating_batch(b):
+                return b
+
+        self.module = _Mod()
+
+    def _put_batch(self, b):
+        self.events.append(("put", b))
+        return b
+
+
+def test_prefetch_iter_stages_ahead_and_preserves_order():
+    """The double-buffer contract: batch N+depth's device put is
+    ISSUED before batch N is handed to the consumer (so the transfer
+    overlaps step N's compute), loader order is preserved, and every
+    yield carries a non-negative h2d wait sample."""
+    events = []
+    fake = _FakePrefetchHost(events, depth=2)
+    got = []
+    for batch, wait in Engine._prefetch_iter(fake, [0, 1, 2, 3]):
+        events.append(("yield", batch))
+        got.append(batch)
+        assert wait >= 0.0
+    assert got == [0, 1, 2, 3]
+    assert [b for e, b in events if e == "put"] == [0, 1, 2, 3]
+    assert events.index(("put", 2)) < events.index(("yield", 0))
+    assert events.index(("put", 3)) < events.index(("yield", 1))
+
+
+def test_prefetch_iter_depth_zero_is_synchronous():
+    """depth<=0 degrades to the old synchronous per-step put — no
+    batch is staged before the previous one is consumed."""
+    events = []
+    fake = _FakePrefetchHost(events, depth=0)
+    for batch, _w in Engine._prefetch_iter(fake, [0, 1, 2]):
+        events.append(("yield", batch))
+    assert events == [("put", 0), ("yield", 0), ("put", 1),
+                      ("yield", 1), ("put", 2), ("yield", 2)]
+
+
+def test_prefetch_iter_short_loader_drains():
+    """Loaders shorter than the prefetch depth still yield every
+    batch exactly once."""
+    events = []
+    fake = _FakePrefetchHost(events, depth=4)
+    got = [b for b, _w in Engine._prefetch_iter(fake, [0, 1])]
+    assert got == [0, 1]
+
+
+def test_fit_records_h2d_wait_per_step(tmp_path):
+    """The step loop records one h2d wait sample per trained step
+    (the _step_costs summary's input-stall line feeds off these)."""
+    cfg, engine, loader = _build(tmp_path)
+    assert engine.prefetch_depth == 2   # config default
+    engine.fit(epoch=1, train_data_loader=loader)
+    assert len(engine._h2d_waits) == cfg.Engine.max_steps
+    assert all(w >= 0.0 for w in engine._h2d_waits)
+
+
+def test_fit_with_prefetch_disabled_matches_defaults(tmp_path):
+    """Engine.prefetch_depth=0 (sync path) trains to the same loss
+    trajectory as the staged path — prefetch must not reorder or
+    drop batches."""
+    losses = {}
+    for depth in (2, 0):
+        cfg, engine, loader = _build(
+            tmp_path, **{"Engine.prefetch_depth": depth})
+        seen = []
+        orig = engine.module.training_step_end
+
+        def capture(log, seen=seen):
+            seen.append(log["loss"])
+
+        engine.module.training_step_end = capture
+        engine.fit(epoch=1, train_data_loader=loader)
+        losses[depth] = seen
+    assert losses[2] == pytest.approx(losses[0], rel=1e-6)

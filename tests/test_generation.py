@@ -106,14 +106,22 @@ def test_num_return_sequences_tiles_prompts(model_and_params):
 
 
 def test_beam_search_k1_equals_greedy(model_and_params):
-    """Beam width 1 degenerates to greedy decoding exactly."""
+    """Beam width 1 degenerates to greedy decoding exactly.
+
+    Only while no EOS candidate enters the finished pool: beam search
+    ranks COMPLETE hypotheses, so with length_penalty=0 a shorter
+    sequence that ends in a near-argmax EOS can outrank the live beam
+    — correct beam semantics, not a greedy mismatch. min_dec_len bans
+    EOS (identically on both paths) to pin the step-wise equivalence
+    itself rather than this untrained model's EOS coin-flips."""
     model, params = model_and_params
     prompt = jnp.asarray(
         np.random.default_rng(4).integers(0, 90, (2, 7)), jnp.int32)
-    greedy = GenerationConfig(max_dec_len=6,
+    greedy = GenerationConfig(max_dec_len=6, min_dec_len=6,
                               decode_strategy="greedy_search",
                               eos_token_id=EOS, pad_token_id=PAD)
-    beam1 = GenerationConfig(max_dec_len=6, decode_strategy="beam_search",
+    beam1 = GenerationConfig(max_dec_len=6, min_dec_len=6,
+                             decode_strategy="beam_search",
                              num_beams=1, eos_token_id=EOS,
                              pad_token_id=PAD)
     g = np.asarray(generate(model, params, prompt, None,
