@@ -266,15 +266,34 @@ def wait_for_backend() -> dict:
     _install_sigterm_reporter()
     budget = float(os.environ.get("PFX_BENCH_MAX_WAIT", "10800"))
     probe_timeout = float(os.environ.get("PFX_BENCH_PROBE_TIMEOUT", "300"))
+    max_hung = int(os.environ.get("PFX_BENCH_MAX_HUNG_PROBES", "3"))
     deadline = time.monotonic() + budget
     delay, last = 15.0, "no probe ran"
     last_was_hang = False
+    hang_streak = 0
     attempt = 0
     while True:
         attempt += 1
         this_timeout = min(probe_timeout,
                            max(30.0, deadline - time.monotonic()))
         info, last, last_was_hang = probe_once(this_timeout)
+        # Circuit breaker on probes KILLED for hanging (BENCH_r05
+        # burned its whole 10500s budget on five consecutive hung
+        # probes and died rc=124 instead of reporting): each hang
+        # already consumed the full probe timeout, so a streak of
+        # them is a hard outage — report backend_unavailable NOW
+        # rather than rediscovering it until the budget expires.
+        # Only genuine probe_once hangs count; fast failures (gRPC
+        # errors, platform mismatches) keep the full retry budget.
+        hang_streak = hang_streak + 1 if last_was_hang else 0
+        if hang_streak >= max_hung:
+            _emit_failure(
+                "backend_unavailable",
+                f"{hang_streak} consecutive probes hung "
+                f">{this_timeout:.0f}s (killed) — backend wedged, "
+                f"not retrying the remaining "
+                f"{max(0.0, deadline - time.monotonic()):.0f}s budget; "
+                f"last: {last}")
         if info is not None:
             # a probe that silently fell back to CPU while the
             # environment expects a TPU is an OUTAGE, not success:

@@ -268,6 +268,18 @@ class Engine(BasicEngine):
             self.state["params"]))
         logger.info("initialized model: %.1fM params on mesh %s",
                     n_params / 1e6, dict(self.mesh.shape))
+        from ..parallel.mesh import MP_AXIS
+        mp = self.mesh.shape.get(MP_AXIS, 1)
+        mcfg = getattr(getattr(self.module, "model", None), "config",
+                       None)
+        if mp > 1 and hasattr(mcfg, "use_collective_matmul"):
+            logger.info(
+                "tensor-parallel linears (mp=%d): %s", mp,
+                "decomposed collective-matmul rings (overlapped)"
+                if mcfg.use_collective_matmul and mcfg.sequence_parallel
+                else "plain GSPMD collectives (set "
+                     "use_collective_matmul + sequence_parallel to "
+                     "overlap them; docs/tensor_parallel.md)")
 
     # -- jitted steps ---------------------------------------------------
 
@@ -291,6 +303,7 @@ class Engine(BasicEngine):
         acc = 1 if self.topo.pp_degree > 1 else self.accumulate_steps
         tx, schedule = self.tx, self.lr_schedule
         root_rng = self.root_rng
+        param_shardings = self.state_shardings["params"]
 
         offload = getattr(self, "_opt_offload", False)
         opt_device_shardings = getattr(self, "_opt_device_shardings",
@@ -324,8 +337,15 @@ class Engine(BasicEngine):
                 micro = jax.tree.map(
                     lambda x: x.reshape(acc, x.shape[0] // acc,
                                         *x.shape[1:]), batch)
+                # the fp32 grad_sum carry inherits the param
+                # PartitionSpecs: left unconstrained the partitioner
+                # replicates the whole fp32 gradient tree per chip,
+                # which at mp/fsdp > 1 costs more HBM than the sharded
+                # params themselves
                 zero = jax.tree.map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                    lambda p, s: jax.lax.with_sharding_constraint(
+                        jnp.zeros(p.shape, jnp.float32), s),
+                    params, param_shardings)
 
                 def body(carry, mb_with_idx):
                     mb_idx, mb = mb_with_idx
@@ -649,6 +669,18 @@ class Engine(BasicEngine):
                         "after fill %.4f s (prefetch depth %d)",
                         sum(waits) / len(waits), max(waits),
                         self._h2d_waits[0], self.prefetch_depth)
+        try:
+            probe = self._mp_collective_probe()
+        except Exception as exc:   # the probe must never kill the
+            logger.info("  mp collective: probe failed (%s)", exc)
+            probe = None           # summary it decorates
+        if probe is not None:
+            pair_t, path, n_layers = probe
+            logger.info(
+                "  mp collective: %.4f s per column+row linear pair "
+                "(%s); ~%.4f s/step forward estimate (%d layers x 2 "
+                "pairs)", pair_t, path, pair_t * 2 * n_layers,
+                n_layers)
         if (self.configs.get("Profiler", {}) or {}).get("detailed"):
             # reference Profiler.detailed prints the full table views;
             # the host-side analogue is every window's timing
@@ -667,6 +699,70 @@ class Engine(BasicEngine):
         logger.info("  device-time breakdown: open %s with "
                     "TensorBoard's profile plugin", self._prof_dir)
         logger.info("-" * 60)
+
+    def _mp_collective_probe(self):
+        """Time one column+row tensor-parallel linear pair
+        (``[b, s, h] @ [h, ffn] @ [ffn, h]``) on the live mesh — the
+        decomposed rings when the model dispatches to them, the plain
+        GSPMD all-gather/reduce-scatter lowering otherwise — so the
+        profiler summary records what the mp collectives cost this
+        run. Returns ``(seconds_per_pair, path, num_layers)`` or None
+        when mp is not in play (mp < 2, or no GPT-shaped config)."""
+        from ..parallel.mesh import DATA_AXES, MP_AXIS
+        mesh = self.mesh
+        mp = mesh.shape.get(MP_AXIS, 1)
+        mcfg = getattr(getattr(self.module, "model", None), "config",
+                       None)
+        hidden = getattr(mcfg, "hidden_size", 0)
+        if mp < 2 or not hidden:
+            return None
+        ffn = getattr(mcfg, "ffn_hidden_size", None) or 4 * hidden
+        n_layers = getattr(mcfg, "num_layers", 1)
+        bsz = int(np.prod([mesh.shape[a] for a in DATA_AXES]))
+        b = max(self.micro_batch_size, bsz)
+        b -= b % bsz
+        seq = self.configs.get("Data", {}).get("Train", {}).get(
+            "dataset", {}).get("max_seq_len", 0) or getattr(
+            mcfg, "max_position_embeddings", mp)
+        seq = max(seq - seq % mp, mp)
+        dtype = jnp.dtype(getattr(mcfg, "dtype", "float32"))
+
+        from ..ops.collective_matmul import (
+            all_gather_matmul, matmul_reduce_scatter, mp_ring_viable,
+        )
+        use_rings = (getattr(mcfg, "use_collective_matmul", False)
+                     and getattr(mcfg, "sequence_parallel", False)
+                     and mp_ring_viable(mesh, b, seq, (ffn,)))
+        seq_s = NamedSharding(mesh, P(DATA_AXES, MP_AXIS, None))
+        col_s = NamedSharding(mesh, P(DATA_AXES, None, MP_AXIS))
+        x = jax.device_put(jnp.ones((b, seq, hidden), dtype), seq_s)
+        w1 = jax.device_put(jnp.ones((hidden, ffn), dtype),
+                            NamedSharding(mesh, P(None, MP_AXIS)))
+        w2 = jax.device_put(jnp.ones((ffn, hidden), dtype),
+                            NamedSharding(mesh, P(MP_AXIS, None)))
+
+        if use_rings:
+            path = "decomposed overlapped rings"
+
+            def pair(x, w1, w2):
+                y = all_gather_matmul(x, w1, mesh)
+                return matmul_reduce_scatter(y, w2, mesh)
+        else:
+            path = "plain GSPMD all-gather/reduce-scatter"
+
+            def pair(x, w1, w2):
+                y = jax.lax.with_sharding_constraint(x @ w1, col_s)
+                return jax.lax.with_sharding_constraint(y @ w2, seq_s)
+
+        fn = jax.jit(pair)
+        reps = 3
+        with mesh:
+            jax.block_until_ready(fn(x, w1, w2))   # compile outside
+            t0 = time.time()                       # the timed window
+            for _ in range(reps):
+                out = fn(x, w1, w2)
+            jax.block_until_ready(out)
+        return (time.time() - t0) / reps, path, n_layers
 
     def _profiler_step(self, step: int) -> None:
         """Start/stop the jax.profiler trace at the configured window

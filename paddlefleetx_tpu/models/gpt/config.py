@@ -31,6 +31,15 @@ class GPTConfig:
     fused_linear: bool = False            # no-op on TPU: XLA fuses bias
     fuse_attn_qkv: bool = True
     sequence_parallel: bool = False
+    #: mp>1: replace the GSPMD all-gather+matmul / matmul+reduce-scatter
+    #: lowering of the column/row-parallel linears with the decomposed
+    #: bidirectional-ring kernels (ops/collective_matmul.py) so the mp
+    #: collectives overlap the per-shard matmul chunks. Requires
+    #: sequence_parallel (the rings stream seq shards); falls back to
+    #: the plain with_logical_constraint path per-site when shapes are
+    #: ring-indivisible, mp == 1, or there is no mesh — the dispatch
+    #: matrix is docs/tensor_parallel.md.
+    use_collective_matmul: bool = False
     virtual_pp_degree: int = 1
     #: pipeline schedule when pp_degree > 1. "1F1B" (reference default,
     #: bounded activation memory via the explicit fwd/bwd-interleaved
@@ -144,6 +153,21 @@ class GPTConfig:
                         "[b, h, s, s] scores will not fit and the "
                         "training module refuses to start."
                         if self.max_position_embeddings >= 4096 else "")
+        # Same no-silent-degradation stance for the overlapped mp
+        # rings: they stream sequence shards, so without Megatron-SP
+        # there is nothing sharded to stream and every site falls back
+        # to the plain GSPMD path. Warn instead of raising — the knob
+        # is a pure perf optimization and the fallback is numerically
+        # identical.
+        if self.use_collective_matmul and not self.sequence_parallel:
+            from ...utils.log import logger
+            logger.warning(
+                "use_collective_matmul=True without sequence_parallel: "
+                "the decomposed collective-matmul rings stream sequence "
+                "shards over mp and are inert without Megatron-SP — "
+                "every linear falls back to the plain GSPMD constraint "
+                "path. Set sequence_parallel: True to enable the "
+                "overlap (docs/tensor_parallel.md).")
         if self.moe_num_experts:
             if not 1 <= self.moe_top_k <= self.moe_num_experts:
                 raise ValueError(

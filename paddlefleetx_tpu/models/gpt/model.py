@@ -46,6 +46,88 @@ def _dense_init(cfg: GPTConfig):
     return nn.initializers.normal(stddev=cfg.initializer_range)
 
 
+class _CollectiveDense(nn.Module):
+    """``nn.DenseGeneral`` twin that dispatches its matmul to the
+    overlapped mp rings (``ops/collective_matmul.py``) when viable.
+
+    Parameters are created exactly as the DenseGeneral call sites
+    create them — same names ("kernel"/"bias"), shapes, logical axes
+    and init streams — so checkpoints and the abstract-init parameter
+    tree are identical whether the knob is on or off and whether a
+    given call falls back (the engine's batch-1 abstract-init sample
+    always does). Only the compute dispatches:
+
+    - ``mode="column"`` ("embed" contraction, qkv / fc1):
+      :func:`all_gather_matmul` — x arrives sequence-sharded
+      (Megatron-SP layout), output feature-sharded over mp.
+    - ``mode="row"`` (mp-sharded contraction, out-proj / fc2):
+      :func:`matmul_reduce_scatter` — output arrives sequence-sharded.
+
+    The fallback is the DenseGeneral ``dot_general`` + bias with the
+    usual GSPMD lowering — numerically identical (the dispatch matrix
+    lives in docs/tensor_parallel.md; conditions pinned by
+    tests/test_collective_matmul.py).
+    """
+    config: GPTConfig
+    features: Tuple[int, ...]
+    kernel_axes: Tuple[Optional[str], ...]
+    mode: str                       # "column" | "row"
+    contract_ndim: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        from flax.linen.dtypes import promote_dtype
+        cfg = self.config
+        cn = self.contract_ndim
+        kshape = tuple(x.shape[-cn:]) + tuple(self.features)
+        kernel = self.param(
+            "kernel",
+            nn.with_logical_partitioning(_dense_init(cfg),
+                                         self.kernel_axes),
+            kshape, jnp.dtype(cfg.param_dtype))
+        bias = self.param(
+            "bias",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(),
+                                         self.kernel_axes[cn:]),
+            tuple(self.features), jnp.dtype(cfg.param_dtype))
+        x, kernel, bias = promote_dtype(x, kernel, bias,
+                                        dtype=jnp.dtype(cfg.dtype))
+
+        mesh = None
+        if cfg.use_collective_matmul and cfg.sequence_parallel:
+            from ...parallel.mesh import get_mesh
+            mesh = get_mesh()
+        if mesh is not None:
+            from ...ops.collective_matmul import (
+                all_gather_matmul, matmul_reduce_scatter, mp_ring_viable,
+            )
+            from ...parallel.sharding import MP_WEIGHT_AXES
+            if self.mode == "column":
+                shard_idx = next(
+                    (i for i, a in enumerate(self.kernel_axes[cn:])
+                     if a in MP_WEIGHT_AXES), None)
+                if shard_idx is not None and cn == 1 and x.ndim == 3 \
+                        and mp_ring_viable(
+                            mesh, x.shape[0], x.shape[1],
+                            (self.features[shard_idx],)):
+                    y = all_gather_matmul(x, kernel, mesh,
+                                          w_shard_dim=shard_idx)
+                    return y + bias
+            else:
+                if self.kernel_axes[0] in MP_WEIGHT_AXES \
+                        and x.ndim == 2 + cn and mp_ring_viable(
+                            mesh, x.shape[0], x.shape[1], (kshape[0],)):
+                    y = matmul_reduce_scatter(x, kernel, mesh,
+                                              contract_ndim=cn)
+                    return y + bias
+
+        y = jax.lax.dot_general(
+            x, kernel,
+            ((tuple(range(x.ndim - cn, x.ndim)), tuple(range(cn))),
+             ((), ())))
+        return y + bias
+
+
 def _remat_policy(granularity: str):
     """Map reference recompute granularities onto checkpoint policies.
 
@@ -99,9 +181,19 @@ class MultiHeadAttention(nn.Module):
                 nn.initializers.zeros_init(), axes))
 
         if cfg.fuse_attn_qkv:
-            qkv = dense((3, nh, hd), "qkv_proj", (None, "heads", "kv"))(x)
+            if cfg.use_collective_matmul:
+                qkv = _CollectiveDense(
+                    cfg, features=(3, nh, hd),
+                    kernel_axes=("embed", None, "heads", "kv"),
+                    mode="column", name="qkv_proj")(x)
+            else:
+                qkv = dense((3, nh, hd), "qkv_proj",
+                            (None, "heads", "kv"))(x)
             q, k, v = (qkv[..., i, :, :] for i in range(3))
         else:
+            # non-fused qkv stays on the plain GSPMD path: three
+            # narrow column projections are not worth three rings
+            # (docs/tensor_parallel.md fallback matrix)
             q = dense((nh, hd), "q_proj", ("heads", "kv"))(x)
             k = dense((nh, hd), "k_proj", ("heads", "kv"))(x)
             v = dense((nh, hd), "v_proj", ("heads", "kv"))(x)
@@ -198,13 +290,19 @@ class MultiHeadAttention(nn.Module):
                 out, ("batch", "seq", "act_heads", None))
         out = checkpoint_name(out, "attn")
 
-        out = nn.DenseGeneral(
-            h, axis=(-2, -1), name="out_proj", dtype=dtype,
-            param_dtype=jnp.dtype(cfg.param_dtype),
-            kernel_init=nn.with_logical_partitioning(
-                _dense_init(cfg), ("heads", "kv", "embed")),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros_init(), ("embed",)))(out)
+        if cfg.use_collective_matmul:
+            out = _CollectiveDense(
+                cfg, features=(h,),
+                kernel_axes=("heads", "kv", "embed"),
+                mode="row", contract_ndim=2, name="out_proj")(out)
+        else:
+            out = nn.DenseGeneral(
+                h, axis=(-2, -1), name="out_proj", dtype=dtype,
+                param_dtype=jnp.dtype(cfg.param_dtype),
+                kernel_init=nn.with_logical_partitioning(
+                    _dense_init(cfg), ("heads", "kv", "embed")),
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), ("embed",)))(out)
         return checkpoint_name(out, "attn_out")
 
 
@@ -248,6 +346,19 @@ class TransformerDecoderLayer(nn.Module):
         if cfg.moe_num_experts:
             from .moe import MoEMLP
             y, moe_aux = MoEMLP(cfg, name="moe_mlp")(y, deterministic)
+        elif cfg.use_collective_matmul:
+            y = _CollectiveDense(
+                cfg, features=(cfg.ffn_hidden_size,),
+                kernel_axes=("embed", "mlp"), mode="column",
+                name="linear1")(y)
+            y = checkpoint_name(y, "mlp1")
+            y = nn.gelu(y, approximate=True)
+            y = with_logical_constraint(y, ("batch", None, "act_mlp"))
+            y = _CollectiveDense(
+                cfg, features=(cfg.hidden_size,),
+                kernel_axes=("mlp", "embed"), mode="row",
+                name="linear2")(y)
+            y = checkpoint_name(y, "mlp2")
         else:
             y = nn.DenseGeneral(
                 cfg.ffn_hidden_size, name="linear1", dtype=dtype,

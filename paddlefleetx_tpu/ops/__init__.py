@@ -1,2 +1,5 @@
 """Ops subpackage."""
 from .attention import dot_product_attention  # noqa: F401
+from .collective_matmul import (  # noqa: F401
+    all_gather_matmul, matmul_reduce_scatter, mp_ring_viable,
+)

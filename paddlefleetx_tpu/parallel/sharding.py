@@ -35,6 +35,14 @@ from .mesh import (
 
 Rules = Tuple[Tuple[str, Any], ...]
 
+#: Logical WEIGHT axes that map onto the mp mesh axis in the rule
+#: table below ("vocab" too, but embeddings/logits have no decoder
+#: linear). The collective-matmul dispatch
+#: (models/gpt/model.py::_CollectiveDense) keys on these to locate the
+#: ring-sharded dim of a kernel — kept here so the rules and the
+#: dispatch cannot drift apart.
+MP_WEIGHT_AXES = ("heads", "mlp")
+
 
 def make_sharding_rules(topo: TopologyConfig) -> Rules:
     """Build the logical→mesh rule table for a topology.

@@ -59,6 +59,42 @@ def test_hang_counts_as_transient(monkeypatch):
     assert bench.wait_for_backend()["platform"] == "tpu"
 
 
+def test_consecutive_hangs_trip_circuit_breaker(monkeypatch, capsys):
+    """ISSUE 2 satellite: 3 consecutive probes killed for hanging emit
+    backend_unavailable IMMEDIATELY instead of burning the whole
+    budget on more doomed full-timeout probes (BENCH_r05 died rc=124
+    after five of them)."""
+    def run(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
+    monkeypatch.setattr(bench.subprocess, "run", run)
+    # a budget far from expiring: only the streak can end the loop
+    monkeypatch.setenv("PFX_BENCH_MAX_WAIT", "100000")
+    with pytest.raises(SystemExit) as e:
+        bench.wait_for_backend()
+    assert e.value.code == 1
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["error_kind"] == "backend_unavailable"
+    assert "3 consecutive probes hung" in rec["error"]
+
+
+def test_hang_streak_resets_on_fast_failure(monkeypatch):
+    """Only CONSECUTIVE hangs trip the breaker — fast failures between
+    them (gRPC errors while the tunnel flaps) reset the streak and
+    keep the retry budget in charge."""
+    calls = iter(["hang", "hang", "err", "hang", "ok"])
+
+    def run(*a, **k):
+        kind = next(calls)
+        if kind == "hang":
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
+        if kind == "err":
+            return _Result(1, err="UNAVAILABLE: tunnel flapped")
+        return _probe_ok()
+    monkeypatch.setattr(bench.subprocess, "run", run)
+    monkeypatch.setenv("PFX_BENCH_MAX_WAIT", "100000")
+    assert bench.wait_for_backend()["platform"] == "tpu"
+
+
 def test_nontransient_emits_structured_exception(monkeypatch, capsys):
     """An un-outage-looking failure (ImportError) is still RETRIED
     until the budget expires (ADVICE r4 #2: unknown probe failures are

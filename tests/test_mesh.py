@@ -161,3 +161,62 @@ def test_sharded_matmul_matches_single_device():
     got = jax.jit(lambda a, b: a @ b)(xs, ws)
     np.testing.assert_allclose(np.asarray(got), expect, rtol=1e-5,
                                atol=1e-5)
+
+
+# -- optimizer_state_shardings edge cases (parallel/sharding.py) ------
+
+def _opt_shardings(shapes_by_name, param_specs_by_name, **topo_kw):
+    """Run optimizer_state_shardings over a moment-like subtree whose
+    leaf paths end in the param names (the optax layout the suffix
+    matcher keys on)."""
+    from paddlefleetx_tpu.parallel.sharding import (
+        optimizer_state_shardings,
+    )
+    t = topo(**topo_kw)
+    mesh = build_mesh(t)
+    shapes = {"mu": {name: jax.ShapeDtypeStruct(shape, np.float32)
+                     for name, shape in shapes_by_name.items()}}
+    return optimizer_state_shardings(
+        shapes, param_specs_by_name, mesh, t)["mu"]
+
+
+def test_opt_state_rank_mismatch_stays_replicated():
+    # adafactor-style factored stats: the (8,) row stat inherits the
+    # rank-2 param spec, which cannot apply — must stay replicated
+    out = _opt_shardings(
+        {"kernel": (8,)}, {"kernel": P(None, "mp")},
+        mp_degree=2, sharding_degree=2, sharding_stage=1, dp_degree=2)
+    assert out["kernel"].spec == P()
+
+
+def test_opt_state_indivisible_dim_skips_fsdp_shard():
+    # stage 1 wants to shard a free dim over fsdp=4; 6 and 9 both
+    # resist division, so the moment stays on the inherited spec
+    out = _opt_shardings(
+        {"kernel": (6, 9)}, {"kernel": P(None, None)},
+        sharding_degree=4, sharding_stage=1, dp_degree=2)
+    assert out["kernel"].spec == P(None, None)
+    # while a divisible sibling picks up fsdp on its LARGEST free dim
+    out = _opt_shardings(
+        {"kernel": (4, 8)}, {"kernel": P(None, None)},
+        sharding_degree=4, sharding_stage=1, dp_degree=2)
+    assert out["kernel"].spec == P(None, "fsdp")
+
+
+def test_opt_state_stage3_inherits_spec_unchanged():
+    # ZeRO-3 params are already fsdp-sharded; moments must mirror the
+    # param spec exactly — no extra fsdp dim is grafted on
+    out = _opt_shardings(
+        {"kernel": (8, 8)}, {"kernel": P("fsdp", "mp")},
+        mp_degree=2, sharding_degree=2, sharding_stage=3, dp_degree=2)
+    assert out["kernel"].spec == P("fsdp", "mp")
+    # and unmatched leaves (optimizer step counters) stay replicated
+    from paddlefleetx_tpu.parallel.sharding import (
+        optimizer_state_shardings,
+    )
+    t = topo(sharding_degree=2, sharding_stage=3, dp_degree=4)
+    mesh = build_mesh(t)
+    out = optimizer_state_shardings(
+        {"count": jax.ShapeDtypeStruct((), np.int32)},
+        {"kernel": P("fsdp")}, mesh, t)
+    assert out["count"].spec == P()

@@ -19,6 +19,7 @@ from paddlefleetx_tpu.models.gpt import (
 from paddlefleetx_tpu.parallel import (
     TopologyConfig, build_mesh, make_sharding_rules,
 )
+from paddlefleetx_tpu.parallel.mesh import set_mesh
 
 CFG = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2,
                 num_attention_heads=4, max_position_embeddings=32,
@@ -58,7 +59,10 @@ def golden():
     ({"sharding_degree": 4, "sharding_stage": 3, "dp_degree": 2}, {}),
     ({"dp_degree": 2, "mp_degree": 2, "sharding_degree": 2,
       "sharding_stage": 3}, {}),
-], ids=["tp4xdp2", "tp4xdp2-sp", "zero3x4xdp2", "dp2xtp2xfsdp2"])
+    ({"mp_degree": 4, "dp_degree": 2},
+     {"sequence_parallel": True, "use_collective_matmul": True}),
+], ids=["tp4xdp2", "tp4xdp2-sp", "zero3x4xdp2", "dp2xtp2xfsdp2",
+        "tp4xdp2-sp-cm"])
 def test_sharded_matches_single_device(golden, topo_kw, cfg_kw):
     variables, ids, labels, mask, ref_loss, ref_grads = golden
     topo = TopologyConfig(**topo_kw,
@@ -66,6 +70,10 @@ def test_sharded_matches_single_device(golden, topo_kw, cfg_kw):
                               "sequence_parallel", False))
     cfg = GPTConfig(**{**vars(CFG), **cfg_kw})
     mesh = build_mesh(topo)
+    # the collective-matmul dispatch (and ring attention) key off the
+    # process-global mesh, as under the engine; the conftest autouse
+    # fixture resets it after each test
+    set_mesh(mesh)
     rules = make_sharding_rules(topo)
 
     model = GPTForPretraining(cfg)
