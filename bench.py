@@ -69,6 +69,18 @@ _headline_result = None
 # single-client chip for the driver's next run
 _child_proc = None
 
+# flight recorder (observability.recorder.FlightRecorder) over
+# bench_log/events.jsonl; initialized in _run_guarded — the __main__
+# path only — so importing bench for its helpers (scripts/, tests)
+# never touches the repo's bench_log
+_recorder = None
+
+
+def _emit_event(event: str, **fields):
+    """Durable lifecycle event; no-op when the recorder is off."""
+    if _recorder is not None:
+        _recorder.emit(event, **fields)
+
 
 def _kill_child() -> str:
     """Kill + REAP any in-flight child; returns its stderr tail (the
@@ -153,12 +165,20 @@ UNIT_BY_METRIC = {
 
 
 def _failure_record(kind: str, detail: str) -> str:
-    return json.dumps({
+    _emit_event("failure", kind=kind, phase=_phase,
+                detail=detail[-500:])
+    rec = {
         "metric": _active_metric, "value": None,
         "unit": UNIT_BY_METRIC.get(_active_metric, "tokens/s"),
         "vs_baseline": None, "error_kind": kind,
         "error": detail[-2000:],
-    })
+    }
+    if _recorder is not None:
+        # the run's last recorded breadcrumbs ride inside the failure
+        # record, so the driver-side report shows WHAT the bench was
+        # doing when it died without needing the builder's disk
+        rec["recorder_tail"] = _recorder.tail(8)
+    return json.dumps(rec)
 
 
 def _emit_failure(kind: str, detail: str, rc: int = 1):
@@ -224,8 +244,18 @@ def probe_once(timeout: float):
         r = subprocess.run(
             [sys.executable, "-c", _PROBE_SRC],
             capture_output=True, text=True, timeout=timeout)
-    except subprocess.TimeoutExpired:
-        return None, f"probe hung >{timeout:.0f}s (killed)", True
+    except subprocess.TimeoutExpired as e:
+        # whatever the probe wrote before wedging is the only clue to
+        # WHERE it hung (libtpu init vs gRPC connect vs import);
+        # TimeoutExpired carries the captured pipes
+        tail = e.stderr or e.output or b""
+        if isinstance(tail, bytes):
+            tail = tail.decode("utf-8", "replace")
+        tail = tail.strip()[-300:]
+        msg = f"probe hung >{timeout:.0f}s (killed)"
+        if tail:
+            msg += f"; stderr tail: {tail}"
+        return None, msg, True
     if r.returncode == 0 and r.stdout.strip():
         # scan from the end: a library may append a banner/warning
         # line to stdout after the probe's JSON
@@ -400,39 +430,19 @@ def _log_success(record: dict):
             f.write(json.dumps(entry) + "\n")
     except OSError as e:  # the audit trail must never kill the bench
         sys.stderr.write(f"warning: bench_log append failed: {e}\n")
-# bf16 dense peak by device kind (jax Device.device_kind) — platform
-# alone can't distinguish TPU generations and would silently mis-scale
-# MFU on anything but the calibrated chip.
-PEAK_FLOPS_BY_KIND = {
-    "TPU v3": 123e12,
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,   # v5e
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,        # v5p
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,   # v6e / Trillium
-    "TPU v6e": 918e12,
-}
-
-
-def causal_attn_flops(b: int, h: int, s: int, d: int) -> float:
-    """Model FLOPs of one causal-attention forward at [b, h, s, d]:
-    QK^T + PV matmuls (2 each per element), half the square live.
-    Shared by the tuning/profiling scripts so the roofline accounting
-    cannot drift between them."""
-    return 4.0 * b * h * s * s * d * 0.5
+    _emit_event("result", metric=record.get("metric"),
+                value=record.get("value"))
+# FLOPs accounting now lives in observability.flops (the engine's
+# in-band MFU uses the same numbers); re-exported here so scripts
+# importing them from bench keep working.
+from paddlefleetx_tpu.observability.flops import (  # noqa: E402
+    PEAK_FLOPS_BY_KIND, causal_attn_flops,
+)
+from paddlefleetx_tpu.observability import flops as _obs_flops  # noqa: E402
 
 
 def peak_flops() -> float:
-    d = jax.devices()[0]
-    if d.platform != "tpu":
-        return None
-    peak = PEAK_FLOPS_BY_KIND.get(d.device_kind)
-    if peak is None:
-        sys.stderr.write(
-            f"warning: unknown TPU device_kind {d.device_kind!r}; "
-            f"MFU not reported (add it to PEAK_FLOPS_BY_KIND)\n")
-    return peak
+    return _obs_flops.peak_flops(jax.devices()[0])
 
 
 def _gpt345m(on_tpu: bool, **kw):
@@ -448,8 +458,8 @@ def _gpt345m(on_tpu: bool, **kw):
 
 
 def model_flops_per_token(cfg: GPTConfig, seq: int) -> float:
-    L, h, V = cfg.num_layers, cfg.hidden_size, cfg.vocab_size
-    return 72.0 * L * h * h * (1 + seq / (6.0 * h) + V / (12.0 * L * h))
+    return _obs_flops.model_flops_per_token(
+        cfg.num_layers, cfg.hidden_size, cfg.vocab_size, seq)
 
 
 def _measure_train(cfg, batch, seq, acc, n_steps, on_tpu,
@@ -1156,6 +1166,7 @@ def bench_convergence():
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, ids):
+        """One donated full train step for the bench loop."""
         labels = jnp.roll(ids, -1, axis=1)
         mask = jnp.ones(ids.shape, jnp.float32)
 
@@ -1234,6 +1245,7 @@ def main():
         _init_main_backend()
         global _phase
         _phase = "measurement"
+        _emit_event("phase", phase=_phase, mode=args.mode)
     # persistent compile cache: the unrolled 24-layer configs take
     # minutes to compile cold; repeated bench runs (and the perf-CI
     # driver) should pay that once per program, not per run
@@ -1262,6 +1274,13 @@ def _run_guarded():
     script in a fresh process (fresh backend state) up to
     PFX_BENCH_REEXECS times; anything else emits the structured
     failure JSON instead of a bare traceback."""
+    global _recorder
+    from paddlefleetx_tpu.observability.recorder import FlightRecorder
+    _recorder = FlightRecorder(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_log",
+        "events.jsonl"))
+    _emit_event("bench_start", argv=sys.argv[1:],
+                reexec=os.environ.get("PFX_BENCH_REEXEC", "0"))
     try:
         main()
     except (SystemExit, KeyboardInterrupt):

@@ -22,6 +22,19 @@ def maybe_virtual_cpu_mesh() -> None:
         cpu_mesh_env(int(os.environ["PFX_CPU_DEVICES"]))
 
 
+def maybe_force_telemetry(cfg) -> None:
+    """PFX_TELEMETRY=1 turns structured telemetry (flight recorder,
+    dispatch counters, HBM watermarks) on for this run without a
+    config edit — the path a preemption-prone fleet job or a one-off
+    triage run takes. 0/off forces it off over the config."""
+    env = os.environ.get("PFX_TELEMETRY")
+    if env is None:
+        return
+    on = env.strip().lower() in ("1", "true", "yes", "on")
+    cfg.setdefault("Telemetry", {})
+    cfg.Telemetry["enable"] = on
+
+
 def train_main(argv=None):
     """``tools/train.py`` entry: config parse -> mesh -> module ->
     dataloaders -> ``Engine.fit`` (reference ``tools/train.py:37-67``
@@ -39,6 +52,7 @@ def train_main(argv=None):
     args = parse_args(argv)
     env.init_dist_env()
     cfg = get_config(args.config, overrides=args.override, show=True)
+    maybe_force_telemetry(cfg)
 
     module = build_module(cfg)
     engine = Engine(cfg, module, mode="train")
@@ -63,6 +77,8 @@ def train_main(argv=None):
     engine.fit(epoch=cfg.Engine.get("num_train_epochs", 1),
                train_data_loader=train_loader,
                valid_data_loader=valid_loader)
+    if engine._recorder is not None:
+        logger.info("flight record at %s", engine._recorder.path)
     logger.info("training finished")
 
 

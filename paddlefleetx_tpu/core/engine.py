@@ -36,6 +36,11 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..observability import flops as obs_flops
+from ..observability import metrics as obs_metrics
+from ..observability.memory import device_memory_stats, format_bytes
+from ..observability.recorder import FlightRecorder
+from ..observability.trace import annotate
 from ..optims import build_lr_scheduler, build_optimizer
 from ..parallel.mesh import (
     TopologyConfig, build_mesh, set_mesh, DATA_AXES,
@@ -148,14 +153,42 @@ class Engine(BasicEngine):
             self._prof_active = False
             logger.warning("Profiler is enabled, do not enable it in "
                            "production.")
+
+        # structured telemetry (docs/observability.md): the
+        # engine-local registry absorbs the loop's sample series and
+        # wall-time buckets; Telemetry.enable additionally turns on
+        # the process-global dispatch-counter registry and the
+        # crash-surviving flight recorder (events.jsonl, every record
+        # flushed+fsynced so an OOM-killed run keeps its last state)
+        tele = configs.get("Telemetry", {}) or {}
+        self._tele_enabled = bool(tele.get("enable", False))
+        self._metrics = obs_metrics.MetricsRegistry(enabled=True)
+        self._recorder = None
+        if self._tele_enabled:
+            obs_metrics.set_enabled(True)
+            self._recorder = FlightRecorder(
+                tele.get("events_path") or
+                os.path.join(self.output_dir, "events.jsonl"))
+        # host-time summary gate: explicit Engine.print_summary wins;
+        # by default the summary prints whenever profiling OR
+        # telemetry asked for it (unprofiled telemetry runs must not
+        # report nothing)
+        self._print_summary_cfg = eng.get("print_summary", None)
         #: logged step costs for the post-run summary (reference
         #: ``_print_summary``, eager_engine.py:684-721 — device-time
-        #: tables live in the XProf trace; this is the host view)
-        self._step_costs = []
+        #: tables live in the XProf trace; this is the host view).
+        #: An alias into the registry's sample series.
+        self._step_costs = self._metrics.series("host/step_cost")
         #: per-step host time spent staging the NEXT batch's
         #: host->device transfer (_prefetch_iter); near-zero means the
         #: transfer is fully hidden behind the jitted step
-        self._h2d_waits = []
+        self._h2d_waits = self._metrics.series("host/h2d_wait")
+        #: goodput buckets: host wall time NOT spent in productive
+        #: steps (h2d waits live in the series above)
+        self._time_buckets = {"compile": 0.0, "eval": 0.0, "save": 0.0}
+        self._fit_t0 = None
+        self._hbm_watermark = None
+        self._compile_pending = True
         self._init_state()
         self._build_steps()
         if self.ckpt_dir:
@@ -176,6 +209,7 @@ class Engine(BasicEngine):
         extra_rngs = getattr(self.module, "init_rng_collections", ())
 
         def init_fn(rng):
+            """Initialize model variables from a single PRNG key."""
             rngs = {"params": rng}
             for i, name in enumerate(extra_rngs):
                 rngs[name] = jax.random.fold_in(rng, i + 1)
@@ -273,10 +307,14 @@ class Engine(BasicEngine):
         mcfg = getattr(getattr(self.module, "model", None), "config",
                        None)
         if mp > 1 and hasattr(mcfg, "use_collective_matmul"):
+            rings = bool(mcfg.use_collective_matmul and
+                         mcfg.sequence_parallel)
+            obs_metrics.inc("mp_linear/config/"
+                            + ("rings" if rings else "gspmd"))
             logger.info(
                 "tensor-parallel linears (mp=%d): %s", mp,
                 "decomposed collective-matmul rings (overlapped)"
-                if mcfg.use_collective_matmul and mcfg.sequence_parallel
+                if rings
                 else "plain GSPMD collectives (set "
                      "use_collective_matmul + sequence_parallel to "
                      "overlap them; docs/tensor_parallel.md)")
@@ -310,6 +348,7 @@ class Engine(BasicEngine):
                                        None)
 
         def train_step(state, batch):
+            """One optimizer step: grad-accum scan + update, jitted."""
             params, opt_state = state["params"], state["opt_state"]
             if offload:
                 # host -> HBM for the update; out_shardings put the
@@ -348,6 +387,7 @@ class Engine(BasicEngine):
                     params, param_shardings)
 
                 def body(carry, mb_with_idx):
+                    """Accumulate one microbatch's loss and grads."""
                     mb_idx, mb = mb_with_idx
                     loss_sum, grad_sum = carry
                     # fresh dropout stream per microbatch (the single
@@ -409,6 +449,7 @@ class Engine(BasicEngine):
         cp = self.mesh.shape.get(CP_AXIS, 1)
 
         def put(x):
+            """Shard one host batch array onto the device mesh."""
             x = np.asarray(x)
             # batches indivisible by the dataflow axis (small offline
             # eval sets) are replicated instead of sharded; the check
@@ -475,8 +516,9 @@ class Engine(BasicEngine):
                 batch = next(it)
             except StopIteration:
                 return False
-            batch = self.module.pretreating_batch(batch)
-            buf.append(self._put_batch(batch))
+            with annotate("h2d"):
+                batch = self.module.pretreating_batch(batch)
+                buf.append(self._put_batch(batch))
             return True
 
         if depth <= 0:
@@ -523,19 +565,37 @@ class Engine(BasicEngine):
         self.tx = build_optimizer(opt_cfg, self.lr_schedule)
         self._build_steps()
 
+    def _on_sigterm(self, signum, frame):
+        """Preemption notice: set the flag the step loop polls and put
+        the signal on the flight record NOW — the grace window may not
+        outlast the save at the next step boundary."""
+        self._preempt_signum = signum
+        if self._recorder is not None:
+            self._recorder.emit("sigterm", signum=signum,
+                                step=self._host_step)
+
     def fit(self, epoch: int = 1, train_data_loader=None,
             valid_data_loader=None):
+        """Train for ``epoch`` epochs (or ``max_steps``), with eval,
+        checkpointing and telemetry per the run config."""
         self._finalize_vit_schedule(train_data_loader)
-        self._step_costs = []   # per-fit summary samples
-        self._h2d_waits = []
+        del self._step_costs[:]   # per-fit summary samples (registry
+        del self._h2d_waits[:]    # aliases — clear, don't rebind)
+        self._time_buckets = {"compile": 0.0, "eval": 0.0, "save": 0.0}
+        self._fit_t0 = time.time()
+        self._compile_pending = True
         self._preempt_signum = None
+        if self._recorder is not None:
+            self._recorder.emit(
+                "fit_start", step=self._host_step, epochs=epoch,
+                global_batch_size=self.global_batch_size,
+                mesh={str(k): int(v)
+                      for k, v in dict(self.mesh.shape).items()})
         prev_handler, installed = None, False
         if self.save_on_preemption:
             try:
-                prev_handler = signal.signal(
-                    signal.SIGTERM,
-                    lambda signum, frame: setattr(
-                        self, "_preempt_signum", signum))
+                prev_handler = signal.signal(signal.SIGTERM,
+                                             self._on_sigterm)
                 installed = True
             except ValueError:
                 pass   # not the main thread; no handler possible
@@ -567,6 +627,10 @@ class Engine(BasicEngine):
                     "signal %d (preemption) received: saving "
                     "checkpoint at step %d and stopping cleanly",
                     self._preempt_signum, step)
+                if self._recorder is not None:
+                    self._recorder.emit("preemption",
+                                        signum=self._preempt_signum,
+                                        step=step)
                 self.save(ep)
                 ckpt.wait_for_pending_save()
                 break
@@ -591,8 +655,14 @@ class Engine(BasicEngine):
             jax.block_until_ready(self.state["step"])
             jax.profiler.stop_trace()
             self._prof_active = False
-        if self._prof_window is not None:
-            self._print_summary()
+        stats = self._summary_stats()
+        if self._summary_enabled():
+            self._print_summary(stats)
+        if self._recorder is not None:
+            self._recorder.emit(
+                "fit_end", step=self._host_step,
+                n_windows=len(stats.get("windows", ())),
+                **{k: v for k, v in stats.items() if k != "windows"})
         set_mesh(None)
 
     def _train_one_epoch(self, epoch: int, train_data_loader,
@@ -608,26 +678,58 @@ class Engine(BasicEngine):
                 if step >= self.max_steps:
                     return
                 self._profiler_step(step)
-                self.state, metrics = self._train_step(
-                    self.state, batch)
+                t_call = time.time()
+                with annotate("train_step"):
+                    self.state, metrics = self._train_step(
+                        self.state, batch)
+                if self._compile_pending:
+                    # the first call traces + compiles before its
+                    # async dispatch returns; charge that host time to
+                    # the compile bucket and sample memory right after
+                    # (the compile-time peak is what OOMs big configs)
+                    self._compile_pending = False
+                    compile_s = time.time() - t_call
+                    self._time_buckets["compile"] += compile_s
+                    if self._recorder is not None:
+                        self._recorder.emit(
+                            "compile", step=step,
+                            seconds=round(compile_s, 4),
+                            hbm=self._sample_memory())
                 self._h2d_waits.append(h2d_wait)
                 step += 1
                 self._host_step = step
                 if step % self.logging_freq == 0:
                     metrics = jax.device_get(metrics)
                     cost = (time.time() - step_start) / self.logging_freq
-                    self.module.training_step_end({
+                    mem = self._sample_memory()
+                    log_dict = {
                         "epoch": epoch, "batch": step,
                         "loss": float(metrics["loss"]),
                         "lr": float(metrics["lr"]),
                         "grad_norm": float(metrics["grad_norm"]),
                         "train_cost": cost,
-                    })
+                    }
+                    if mem is not None:
+                        log_dict["hbm_bytes_in_use"] = \
+                            mem.get("bytes_in_use")
+                        log_dict["hbm_peak_bytes"] = \
+                            mem.get("peak_bytes_in_use")
+                    self.module.training_step_end(log_dict)
                     # summary samples: only clean windows (a mid-window
                     # eval/save resets step_start, which would skew the
-                    # per-step quotient), only when profiling
-                    if self._prof_window is not None and window_clean:
+                    # per-step quotient)
+                    if window_clean:
                         self._step_costs.append(cost)
+                    if self._recorder is not None:
+                        w = self._h2d_waits[-self.logging_freq:]
+                        self._recorder.emit(
+                            "step_window", step=step,
+                            loss=log_dict["loss"], lr=log_dict["lr"],
+                            grad_norm=log_dict["grad_norm"],
+                            step_time=round(cost, 5),
+                            h2d_wait=round(sum(w) / len(w), 5)
+                            if w else 0.0,
+                            hbm=mem)
                     window_clean = True
                     step_start = time.time()
                 if self.run_mode == "step" and \
@@ -644,31 +746,131 @@ class Engine(BasicEngine):
                 if self._preempt_signum is not None:
                     return   # _fit_epochs saves, then stops
 
-    def _print_summary(self) -> None:
+    def _summary_enabled(self) -> bool:
+        """Whether fit() ends with the host-time summary: an explicit
+        ``Engine.print_summary`` wins; otherwise on iff profiling or
+        telemetry is on (the pre-observability behavior gated it on
+        the profiler window alone, leaving unprofiled runs mute)."""
+        if self._print_summary_cfg is not None:
+            return bool(self._print_summary_cfg)
+        return self._prof_window is not None or self._tele_enabled
+
+    def _sample_memory(self):
+        """HBM sample at a window edge / after compile; tracks the run
+        watermark for the summary. None where the backend keeps no
+        allocator stats (CPU) or telemetry is off."""
+        if not self._tele_enabled:
+            return None
+        mem = device_memory_stats(self.mesh.devices.flat[0])
+        if mem:
+            keep = dict(self._hbm_watermark or {})
+            for k, v in mem.items():
+                keep[k] = v if k == "bytes_limit" else \
+                    max(keep.get(k, 0), v)
+            self._hbm_watermark = keep
+            self._metrics.set_gauge("hbm/peak_bytes_in_use",
+                                    keep.get("peak_bytes_in_use"))
+        return mem
+
+    def _summary_stats(self) -> Dict[str, Any]:
+        """The machine-readable run summary: step-time windows, h2d
+        waits, throughput, model FLOPs + MFU (single source:
+        ``observability.flops``), goodput buckets, HBM watermark and
+        the global dispatch counters. ``_print_summary`` renders it;
+        the flight recorder's ``fit_end`` event carries it."""
+        costs = list(self._step_costs)
+        stats: Dict[str, Any] = {"windows": costs,
+                                 "logging_freq": self.logging_freq}
+        mean = 0.0
+        if costs:
+            # skip the first window: it usually contains the compile
+            steady = costs[1:] or costs
+            mean = sum(steady) / len(steady)
+            stats["first_window_s_per_step"] = costs[0]
+            stats["steady_mean_s_per_step"] = mean
+            stats["steady_min_s_per_step"] = min(steady)
+            stats["steady_max_s_per_step"] = max(steady)
+        if self._h2d_waits:
+            # first wait carries the pipeline fill; report it apart
+            waits = self._h2d_waits[1:] or self._h2d_waits
+            stats["h2d_fill_s"] = self._h2d_waits[0]
+            stats["h2d_mean_s"] = sum(waits) / len(waits)
+            stats["h2d_max_s"] = max(waits)
+        from .module import LanguageModule
+        seq = self.configs.get("Data", {}).get("Train", {}).get(
+            "dataset", {}).get("max_seq_len", 0)
+        tokens = self.global_batch_size * seq
+        # tokens/s only means something for language modules (vision/
+        # multimodal step logs already carry images/sec)
+        if tokens and mean > 0 and isinstance(self.module,
+                                              LanguageModule):
+            tps = tokens / mean
+            stats["tokens_per_sec"] = tps
+            mcfg = getattr(getattr(self.module, "model", None),
+                           "config", None)
+            L = getattr(mcfg, "num_layers", 0)
+            h = getattr(mcfg, "hidden_size", 0)
+            V = getattr(mcfg, "vocab_size", 0)
+            if L and h and V:
+                fpt = obs_flops.model_flops_per_token(L, h, V, seq)
+                n_dev = int(self.mesh.devices.size)
+                peak = obs_flops.peak_flops(self.mesh.devices.flat[0])
+                stats["model_flops_per_token"] = fpt
+                stats["achieved_tflops"] = tps * fpt / 1e12
+                stats["mfu"] = obs_flops.mfu(tps, fpt, peak, n_dev)
+        if self._fit_t0 is not None:
+            total = max(time.time() - self._fit_t0, 1e-9)
+            h2d = sum(self._h2d_waits)
+            b = self._time_buckets
+            productive = max(
+                total - b["compile"] - b["eval"] - b["save"] - h2d,
+                0.0)
+            stats["wall_total_s"] = total
+            stats["bucket_compile_s"] = b["compile"]
+            stats["bucket_eval_s"] = b["eval"]
+            stats["bucket_save_s"] = b["save"]
+            stats["bucket_h2d_s"] = h2d
+            stats["goodput_pct"] = 100.0 * productive / total
+        if self._hbm_watermark:
+            stats["hbm_bytes_in_use"] = \
+                self._hbm_watermark.get("bytes_in_use")
+            stats["hbm_peak_bytes"] = \
+                self._hbm_watermark.get("peak_bytes_in_use")
+            stats["hbm_bytes_limit"] = \
+                self._hbm_watermark.get("bytes_limit")
+        g = obs_metrics.get_registry()
+        if g.enabled:
+            counters = g.snapshot()["counters"]
+            if counters:
+                stats["dispatch_counters"] = counters
+        return stats
+
+    def _print_summary(self, stats: Optional[Dict[str, Any]] = None) \
+            -> None:
         """Post-run host-time summary (reference ``_print_summary``
         prints device-time tables; the device view here lives in the
         XProf trace — this prints the step-time overview)."""
-        costs = self._step_costs
+        if stats is None:
+            stats = self._summary_stats()
+        costs = stats.get("windows") or []
         if not costs:
             return
-        # skip the first window: it usually contains the jit compile
-        steady = costs[1:] or costs
-        mean = sum(steady) / len(steady)
+        mean = stats["steady_mean_s_per_step"]
         logger.info("-" * 60)
         logger.info("Profiler summary (host step times, %d windows of "
                     "%d steps)", len(costs), self.logging_freq)
         logger.info("  first window (incl. compile): %.4f s/step",
                     costs[0])
         logger.info("  steady state: mean %.4f / min %.4f / max %.4f "
-                    "s/step (%.2f step/s)", mean, min(steady),
-                    max(steady), 1.0 / mean if mean else 0.0)
-        if self._h2d_waits:
-            # first wait carries the pipeline fill; report it apart
-            waits = self._h2d_waits[1:] or self._h2d_waits
+                    "s/step (%.2f step/s)", mean,
+                    stats["steady_min_s_per_step"],
+                    stats["steady_max_s_per_step"],
+                    1.0 / mean if mean else 0.0)
+        if "h2d_mean_s" in stats:
             logger.info("  h2d input wait: mean %.4f / max %.4f s/step "
                         "after fill %.4f s (prefetch depth %d)",
-                        sum(waits) / len(waits), max(waits),
-                        self._h2d_waits[0], self.prefetch_depth)
+                        stats["h2d_mean_s"], stats["h2d_max_s"],
+                        stats["h2d_fill_s"], self.prefetch_depth)
         try:
             probe = self._mp_collective_probe()
         except Exception as exc:   # the probe must never kill the
@@ -686,18 +888,42 @@ class Engine(BasicEngine):
             # the host-side analogue is every window's timing
             for i, c in enumerate(costs):
                 logger.info("    window %3d: %.4f s/step", i, c)
-        from .module import LanguageModule
-        tokens = self.global_batch_size * self.configs.get(
-            "Data", {}).get("Train", {}).get("dataset", {}).get(
-            "max_seq_len", 0)
-        # tokens/s only means something for language modules (vision/
-        # multimodal step logs already carry images/sec)
-        if tokens and mean > 0 and isinstance(self.module,
-                                              LanguageModule):
+        if "tokens_per_sec" in stats:
             logger.info("  throughput: %.0f tokens/s (global batch %d)",
-                        tokens / mean, self.global_batch_size)
-        logger.info("  device-time breakdown: open %s with "
-                    "TensorBoard's profile plugin", self._prof_dir)
+                        stats["tokens_per_sec"], self.global_batch_size)
+        if "model_flops_per_token" in stats:
+            mfu = stats.get("mfu")
+            logger.info(
+                "  model FLOPs: %.3e /token; achieved %.2f TFLOP/s; "
+                "MFU %s", stats["model_flops_per_token"],
+                stats["achieved_tflops"],
+                "%.4f of aggregate bf16 peak" % mfu if mfu is not None
+                else "n/a (no calibrated peak for this device)")
+        if "goodput_pct" in stats:
+            logger.info(
+                "  goodput: %.1f%% productive step time of %.1f s "
+                "wall (compile %.2f / eval %.2f / save %.2f / h2d "
+                "%.2f s)", stats["goodput_pct"],
+                stats["wall_total_s"], stats["bucket_compile_s"],
+                stats["bucket_eval_s"], stats["bucket_save_s"],
+                stats["bucket_h2d_s"])
+        logger.info(
+            "  HBM watermark: %s",
+            "%s in use / %s peak of %s" % (
+                format_bytes(stats["hbm_bytes_in_use"]),
+                format_bytes(stats["hbm_peak_bytes"]),
+                format_bytes(stats.get("hbm_bytes_limit")))
+            if "hbm_peak_bytes" in stats
+            else "unavailable (backend keeps no memory stats)")
+        if "dispatch_counters" in stats:
+            logger.info("  dispatch counters: %s",
+                        stats["dispatch_counters"])
+        prof_dir = getattr(self, "_prof_dir", None)
+        if prof_dir:
+            logger.info("  device-time breakdown: open %s with "
+                        "TensorBoard's profile plugin", prof_dir)
+        if self._recorder is not None:
+            logger.info("  flight record: %s", self._recorder.path)
         logger.info("-" * 60)
 
     def _mp_collective_probe(self):
@@ -756,7 +982,7 @@ class Engine(BasicEngine):
 
         fn = jax.jit(pair)
         reps = 3
-        with mesh:
+        with mesh, annotate("mp_collective_probe"):
             jax.block_until_ready(fn(x, w1, w2))   # compile outside
             t0 = time.time()                       # the timed window
             for _ in range(reps):
@@ -793,25 +1019,39 @@ class Engine(BasicEngine):
         walks the whole loader (reference ``_evaluate_one_epoch``)."""
         losses = []
         t0 = time.time()
-        for i, (batch, _h2d) in enumerate(
-                self._prefetch_iter(valid_data_loader)):
-            if max_iters is not None and i >= max_iters:
-                break
-            if self._preempt_signum is not None:
-                # preemption grace windows are short; don't let a long
-                # eval pass outlive them — the preemption checkpoint
-                # in _fit_epochs is what matters
-                break
-            out = self._eval_step(self.state, batch)
-            losses.append(float(out["loss"]))
-            extra = {k: float(v) for k, v in out.items() if k != "loss"}
-            self.module.validation_step_end({
-                "epoch": epoch, "batch": i, "loss": losses[-1],
-                "eval_cost": (time.time() - t0) / (i + 1), **extra})
+        if self._recorder is not None:
+            self._recorder.emit("eval_start", step=self._host_step,
+                                epoch=epoch)
+        with annotate("eval"):
+            for i, (batch, _h2d) in enumerate(
+                    self._prefetch_iter(valid_data_loader)):
+                if max_iters is not None and i >= max_iters:
+                    break
+                if self._preempt_signum is not None:
+                    # preemption grace windows are short; don't let a
+                    # long eval pass outlive them — the preemption
+                    # checkpoint in _fit_epochs is what matters
+                    break
+                with annotate("eval_step"):
+                    out = self._eval_step(self.state, batch)
+                losses.append(float(out["loss"]))
+                extra = {k: float(v) for k, v in out.items()
+                         if k != "loss"}
+                self.module.validation_step_end({
+                    "epoch": epoch, "batch": i, "loss": losses[-1],
+                    "eval_cost": (time.time() - t0) / (i + 1), **extra})
         mean = float(np.mean(losses)) if losses else float("nan")
+        eval_s = time.time() - t0
+        self._time_buckets["eval"] += eval_s
+        self._metrics.add_time("eval", eval_s)
+        if self._recorder is not None:
+            self._recorder.emit("eval_end", step=self._host_step,
+                                epoch=epoch, loss=mean,
+                                n_batches=len(losses),
+                                eval_s=round(eval_s, 4))
         self.module.validation_epoch_end(
             {"epoch": epoch, "loss": mean,
-             "eval_cost": time.time() - t0})
+             "eval_cost": eval_s})
         return mean
 
     def evaluate(self, epoch: int = 1, valid_data_loader=None):
@@ -845,6 +1085,7 @@ class Engine(BasicEngine):
     # -- checkpoint -----------------------------------------------------
 
     def save(self, epoch: int = 0):
+        """Checkpoint the train state (+ resume metadata) via orbax."""
         # every process participates: orbax coordinates multi-host
         # saves internally (unlike the reference's dp_rank-0-only
         # writes, eager_engine.py:590-592)
@@ -854,10 +1095,21 @@ class Engine(BasicEngine):
             "consumed_samples": step * self.global_batch_size,
             "seed": int(self.configs.Global.get("seed", 1024)),
         }
-        ckpt.save_checkpoint(self.output_dir, epoch, step, self.state,
-                             meta, async_save=self.async_save)
+        t0 = time.time()
+        with annotate("save"):
+            ckpt.save_checkpoint(self.output_dir, epoch, step,
+                                 self.state, meta,
+                                 async_save=self.async_save)
+        save_s = time.time() - t0
+        self._time_buckets["save"] += save_s
+        self._metrics.add_time("save", save_s)
+        if self._recorder is not None:
+            self._recorder.emit("save", step=step, epoch=epoch,
+                                save_s=round(save_s, 4),
+                                async_save=bool(self.async_save))
 
     def load(self):
+        """Restore the latest checkpoint under ``ckpt_dir``, if any."""
         path = ckpt.latest_checkpoint(self.ckpt_dir)
         if path is None:
             logger.warning("no checkpoint found under %s; starting fresh",

@@ -24,11 +24,14 @@ Distribution modes:
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List
 
 import jax
 import numpy as np
 
+from ..observability import metrics
+from ..observability.trace import annotate
 from ..utils.export import (
     load_inference_model, load_spec, pad_to_spec,
 )
@@ -36,8 +39,12 @@ from ..utils.log import logger
 
 
 class InferenceEngine:
+    """Loads a ``jax.export`` artifact and serves ``predict`` calls,
+    re-partitioned onto the requested mesh."""
+
     def __init__(self, model_dir: str, mp_degree: int = 1, mesh=None):
         self.model_dir = model_dir
+        t_load = time.time()
         meta = load_spec(model_dir)["metadata"]
 
         n_export = int(meta.get("num_export_devices", 1))
@@ -97,6 +104,9 @@ class InferenceEngine:
                 "%s", n_export, axes)
         else:
             self._input_sharding = None
+        metrics.inc("inference/loads")
+        metrics.get_registry().add_time("inference/load",
+                                        time.time() - t_load)
 
     @staticmethod
     def _build_mesh_from_metadata(axes: Dict[str, int], n_export: int):
@@ -118,13 +128,15 @@ class InferenceEngine:
         return outputs keyed by position (the reference returns the
         predictor's named output handles; positions are the stable
         equivalent here)."""
+        metrics.inc("inference/predict_calls")
         pads = self.pad_values or [0] * len(data)
         inputs = pad_to_spec([np.asarray(d) for d in data], self.spec,
                              pads, self.pad_sides)
         if self._input_sharding is not None:
             inputs = [jax.device_put(x, self._input_sharding)
                       for x in inputs]
-        outputs = self.call(self.params, *inputs)
+        with annotate("predict"):
+            outputs = self.call(self.params, *inputs)
         if not isinstance(outputs, (tuple, list)):
             outputs = (outputs,)
         return {str(i): np.asarray(o) for i, o in enumerate(outputs)}

@@ -97,20 +97,31 @@ class LanguageModule(BasicModule):
     (reference ``language_module.py:58-95``)."""
 
     def training_step_end(self, log_dict: Dict[str, Any]) -> None:
+        """Emit the TIPC-scraped ``[train]`` line (see
+        ``utils/log.py:TRAIN_LINE_RE`` for the pinned grammar)."""
         speed = 1.0 / log_dict["train_cost"]
         default_global_tokens_num = (
             self.configs.Global.global_batch_size *
             log_dict["max_seq_len"])
+        # the HBM suffix rides AFTER the TIPC-pinned fields so the
+        # ``loss:``/``ips:`` grammar (tests/test_log_grammar.py) stays
+        # grep-compatible; present only when the engine sampled
+        # device-memory stats (telemetry on, TPU backend)
+        hbm = ""
+        if log_dict.get("hbm_bytes_in_use") is not None:
+            hbm = ", hbm: %.2fG (peak %.2fG)" % (
+                log_dict["hbm_bytes_in_use"] / 2**30,
+                (log_dict.get("hbm_peak_bytes") or 0) / 2**30)
         logger.train(
             "[train] epoch: %d, batch: %d, loss: %.9f, "
             "avg_batch_cost: %.5f sec, speed: %.2f step/s, "
             "ips_total: %.0f tokens/s, ips: %.0f tokens/s, "
-            "learning rate: %.5e",
+            "learning rate: %.5e%s",
             log_dict["epoch"], log_dict["batch"], log_dict["loss"],
             log_dict["train_cost"], speed,
             speed * default_global_tokens_num,
             speed * default_global_tokens_num / max(self.nranks or 1, 1),
-            log_dict["lr"])
+            log_dict["lr"], hbm)
 
     def validation_step_end(self, log_dict: Dict[str, Any]) -> None:
         speed = 1.0 / log_dict["eval_cost"]

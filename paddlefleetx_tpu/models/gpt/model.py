@@ -102,6 +102,7 @@ class _CollectiveDense(nn.Module):
                 all_gather_matmul, matmul_reduce_scatter, mp_ring_viable,
             )
             from ...parallel.sharding import MP_WEIGHT_AXES
+            from ...observability import metrics
             if self.mode == "column":
                 shard_idx = next(
                     (i for i, a in enumerate(self.kernel_axes[cn:])
@@ -110,6 +111,7 @@ class _CollectiveDense(nn.Module):
                         and mp_ring_viable(
                             mesh, x.shape[0], x.shape[1],
                             (self.features[shard_idx],)):
+                    metrics.inc("mp_linear/rings")
                     y = all_gather_matmul(x, kernel, mesh,
                                           w_shard_dim=shard_idx)
                     return y + bias
@@ -117,9 +119,14 @@ class _CollectiveDense(nn.Module):
                 if self.kernel_axes[0] in MP_WEIGHT_AXES \
                         and x.ndim == 2 + cn and mp_ring_viable(
                             mesh, x.shape[0], x.shape[1], (kshape[0],)):
+                    metrics.inc("mp_linear/rings")
                     y = matmul_reduce_scatter(x, kernel, mesh,
                                               contract_ndim=cn)
                     return y + bias
+            # the knob was on but this call site fell off the ring
+            # conditions (docs/tensor_parallel.md) — count it so a
+            # "rings enabled but silently all-GSPMD" run is visible
+            metrics.inc("mp_linear/gspmd_fallback")
 
         y = jax.lax.dot_general(
             x, kernel,
@@ -660,6 +667,7 @@ def pipelined_lm_loss_and_grad(
     word_emb = _word_embedding(emb_params)
 
     def head_loss_and_grad(y, ex):
+        """LM-head loss and its grads w.r.t. hidden states + head params."""
         labels_mb, mask_mb = ex
 
         def head(hp, yy):

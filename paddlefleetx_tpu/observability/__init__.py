@@ -1,0 +1,28 @@
+"""Structured telemetry: metrics registry, crash-surviving flight
+recorder, FLOPs/MFU accounting, device-memory sampling and labeled
+trace annotations.
+
+The training loop's numbers (step time, h2d wait, HBM watermark, MFU,
+goodput) and its dispatch decisions (attention path, mp-linear
+lowering) are first-class, machine-readable outputs here — not
+grep-able log lines plus out-of-band scripts. See
+``docs/observability.md`` for the events.jsonl schema and counter
+names.
+"""
+
+from . import metrics
+from .flops import (
+    PEAK_FLOPS_BY_KIND, causal_attn_flops, model_flops_per_token,
+    peak_flops,
+)
+from .memory import device_memory_stats, format_bytes
+from .metrics import MetricsRegistry, get_registry
+from .recorder import FlightRecorder
+from .trace import annotate
+
+__all__ = [
+    "FlightRecorder", "MetricsRegistry", "PEAK_FLOPS_BY_KIND",
+    "annotate", "causal_attn_flops", "device_memory_stats",
+    "format_bytes", "get_registry", "metrics", "model_flops_per_token",
+    "peak_flops",
+]

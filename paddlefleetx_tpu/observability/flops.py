@@ -1,0 +1,75 @@
+"""Model-FLOPs and peak-FLOPs accounting — the single source of truth.
+
+The Megatron fwd+bwd formula and the per-chip bf16 peaks used to live
+in ``bench.py`` with a forward-only copy in ``scripts/profile_mfu.py``;
+both now import from here and the engine's in-band MFU
+(``core/engine.py::_print_summary``) uses the same numbers, so the
+banked headline metric and the summary's figure can never drift.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# bf16 dense peak by device kind (jax Device.device_kind) — platform
+# alone can't distinguish TPU generations and would silently mis-scale
+# MFU on anything but the calibrated chip.
+PEAK_FLOPS_BY_KIND = {
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+    "TPU v6e": 918e12,
+}
+
+
+def model_flops_per_token(num_layers: int, hidden_size: int,
+                          vocab_size: int, seq: int) -> float:
+    """Megatron fwd+bwd model FLOPs per token for a GPT geometry:
+    ``72*L*h^2*(1 + s/6h + V/12Lh)`` (assumes ffn = 4h; counts the
+    model's own fwd+bwd only — remat recompute burns hardware FLOPs
+    but does not count as model FLOPs)."""
+    L, h, V = num_layers, hidden_size, vocab_size
+    return 72.0 * L * h * h * (1 + seq / (6.0 * h) + V / (12.0 * L * h))
+
+
+def causal_attn_flops(b: int, h: int, s: int, d: int) -> float:
+    """Model FLOPs of one causal-attention forward at [b, h, s, d]:
+    QK^T + PV matmuls (2 each per element), half the square live.
+    Shared by the tuning/profiling scripts so the roofline accounting
+    cannot drift between them."""
+    return 4.0 * b * h * s * s * d * 0.5
+
+
+def peak_flops(device=None) -> Optional[float]:
+    """Per-chip bf16 peak for ``device`` (default: the first attached
+    device), or None off-TPU / for an uncalibrated device_kind — MFU
+    is then reported as n/a rather than against a guessed peak."""
+    if device is None:
+        import jax
+        try:
+            device = jax.devices()[0]
+        except Exception:
+            return None
+    if device.platform != "tpu":
+        return None
+    peak = PEAK_FLOPS_BY_KIND.get(device.device_kind)
+    if peak is None:
+        from ..utils.log import logger
+        logger.warning(
+            "unknown TPU device_kind %r; MFU not reported (add it to "
+            "PEAK_FLOPS_BY_KIND)", device.device_kind)
+    return peak
+
+
+def mfu(tokens_per_sec: float, flops_per_token: float,
+        peak_per_chip: Optional[float],
+        n_chips: int = 1) -> Optional[float]:
+    """Achieved model FLOPs over the aggregate peak, or None when the
+    peak is unknown (non-TPU platforms)."""
+    if not peak_per_chip or not tokens_per_sec:
+        return None
+    return tokens_per_sec * flops_per_token / (peak_per_chip * n_chips)

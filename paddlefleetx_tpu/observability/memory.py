@@ -1,0 +1,42 @@
+"""Device-memory telemetry: HBM watermarks from ``memory_stats()``.
+
+TPU PJRT devices report allocator stats (bytes_in_use /
+peak_bytes_in_use / bytes_limit); the CPU test platform returns None.
+Sampling happens at window edges and after compile — a host call per
+logging window, never per step — so a RESOURCE_EXHAUSTED run leaves
+its watermark trail in the step lines, the summary and the flight
+recorder instead of dying unattributed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+#: the memory_stats keys worth carrying; anything else the backend
+#: reports is allocator-internal noise for this purpose
+_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+         "largest_alloc_size")
+
+
+def device_memory_stats(device=None) -> Optional[Dict[str, int]]:
+    """``device.memory_stats()`` distilled to the HBM-watermark keys,
+    or None when the backend keeps no stats (CPU) or is unreachable.
+    Never raises — telemetry must not kill the run it observes."""
+    try:
+        if device is None:
+            import jax
+            device = jax.devices()[0]
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    out = {k: int(stats[k]) for k in _KEYS if k in stats}
+    return out or None
+
+
+def format_bytes(n: Any) -> str:
+    """Human HBM figure (``"3.42G"``); '?' for missing values."""
+    if not isinstance(n, (int, float)):
+        return "?"
+    return f"{n / 2**30:.2f}G"

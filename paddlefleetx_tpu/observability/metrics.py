@@ -1,0 +1,125 @@
+"""Lightweight metrics registry: counters / gauges / timers / series.
+
+Two registries exist:
+
+- per-``Engine`` instances absorb the loop's sample series (the
+  former ad-hoc ``_step_costs`` / ``_h2d_waits`` lists) plus gauges
+  and wall-time buckets;
+- ONE process-global registry (``get_registry``) collects
+  dispatch-decision counters from code that has no engine handle —
+  ``ops/attention.py`` (which attention path a trace chose and why a
+  fallback happened) and ``models/gpt/model.py::_CollectiveDense``
+  (mp-linear lowering). It is DISABLED by default; the engine enables
+  it when ``Telemetry.enable`` is on.
+
+Cost discipline: the module-level ``inc`` is the only call that can
+sit on a hot path, and when the global registry is disabled it is a
+single attribute load + boolean test (the bench-harness test pins
+the disabled overhead below 1% of a host step). Dispatch counters
+additionally fire only at TRACE time — once per compilation, never
+per executed step.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List
+
+
+class MetricsRegistry:
+    """Counters / gauges / timers / sample series in plain dicts."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, Any] = {}
+        self._timers: Dict[str, float] = {}
+        self._series: Dict[str, List[float]] = {}
+
+    # -- counters ------------------------------------------------------
+    def inc(self, name: str, n: float = 1) -> None:
+        if not self.enabled:
+            return
+        self._counters[name] = self._counters.get(name, 0) + n
+
+    def counter(self, name: str) -> float:
+        return self._counters.get(name, 0)
+
+    # -- gauges --------------------------------------------------------
+    def set_gauge(self, name: str, value: Any) -> None:
+        if not self.enabled:
+            return
+        self._gauges[name] = value
+
+    def gauge(self, name: str, default: Any = None) -> Any:
+        return self._gauges.get(name, default)
+
+    # -- timers --------------------------------------------------------
+    def add_time(self, name: str, seconds: float) -> None:
+        if not self.enabled:
+            return
+        self._timers[name] = self._timers.get(name, 0.0) + seconds
+
+    @contextmanager
+    def timer(self, name: str):
+        """Accumulate the block's wall time under ``name`` (and count
+        entries under ``name + "/calls"``)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_time(name, time.perf_counter() - t0)
+            self.inc(name + "/calls")
+
+    def timed(self, name: str) -> float:
+        return self._timers.get(name, 0.0)
+
+    # -- series --------------------------------------------------------
+    def series(self, name: str) -> List[float]:
+        """The mutable sample list registered under ``name`` (created
+        on first use). Callers append/clear the returned list directly
+        — an alias, not a copy — so absorbing an existing ad-hoc list
+        costs nothing on the appending path."""
+        return self._series.setdefault(name, [])
+
+    # -- lifecycle -----------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Point-in-time copy: ``{"counters", "gauges", "timers",
+        "series"}`` (series copied shallowly)."""
+        return {
+            "counters": dict(self._counters),
+            "gauges": dict(self._gauges),
+            "timers": dict(self._timers),
+            "series": {k: list(v) for k, v in self._series.items()},
+        }
+
+    def reset(self) -> None:
+        """Zero everything; registered series are cleared IN PLACE so
+        aliases handed out by ``series()`` stay live."""
+        self._counters.clear()
+        self._gauges.clear()
+        self._timers.clear()
+        for v in self._series.values():
+            del v[:]
+
+
+#: process-global dispatch-counter registry; disabled until the engine
+#: (or a test) turns telemetry on
+_global = MetricsRegistry(enabled=False)
+
+
+def get_registry() -> MetricsRegistry:
+    return _global
+
+
+def set_enabled(flag: bool) -> None:
+    _global.enabled = bool(flag)
+
+
+def inc(name: str, n: float = 1) -> None:
+    """Hot-path global counter increment; a no-op boolean test when
+    telemetry is disabled."""
+    if not _global.enabled:
+        return
+    _global._counters[name] = _global._counters.get(name, 0) + n

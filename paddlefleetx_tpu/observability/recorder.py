@@ -1,0 +1,84 @@
+"""Crash-surviving flight recorder: an append-only events.jsonl stream.
+
+Every ``emit`` writes one JSON line and flushes + fsyncs it before
+returning, so an OOM-killed (SIGKILL, no handler runs) or wedged run
+still leaves its last known state on disk — the r5 failure mode this
+exists for: an rc=137 MoE bench and three null BENCH rounds whose only
+evidence was "probe hung". SIGTERM needs no special file handling for
+the same reason; handlers (engine preemption, bench reporter) just
+``emit`` one more event and it is durable.
+
+Schema: ``{"ts": <unix seconds>, "event": <name>, ...fields}``; the
+event vocabulary is pinned in ``docs/observability.md``. ``tail``
+re-reads the file so a DIFFERENT process (the bench embedding its
+recorder tail into a failure record) sees everything flushed so far.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+
+class FlightRecorder:
+    """Append-only JSONL event log that survives crashes: every
+    ``emit`` is flushed and fsynced, so the last record is on disk
+    even if the process is SIGKILLed right after."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+        try:
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
+            self._f = open(path, "a")
+        except OSError:
+            pass   # telemetry must never kill the run it observes
+
+    def emit(self, event: str, **fields: Any) -> None:
+        """Append one event line, durably (flush + fsync)."""
+        if self._f is None:
+            return
+        record = {"ts": round(time.time(), 3), "event": event}
+        record.update(fields)
+        try:
+            self._f.write(json.dumps(record, default=str) + "\n")
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        except (OSError, ValueError):
+            pass
+
+    def tail(self, n: int = 10) -> List[Dict[str, Any]]:
+        return read_tail(self.path, n)
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+
+
+def read_tail(path: Optional[str], n: int = 10) -> List[Dict[str, Any]]:
+    """Last ``n`` parseable event records of ``path`` (missing or
+    malformed files yield ``[]`` — the tail decorates diagnostics, it
+    must never raise over them)."""
+    if not path:
+        return []
+    try:
+        with open(path) as f:
+            lines = f.readlines()[-n:]
+    except OSError:
+        return []
+    out = []
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out

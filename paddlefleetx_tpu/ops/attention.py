@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
+from ..observability import metrics
+
 NEG_INF = -1e9
 
 
@@ -179,19 +181,27 @@ def dot_product_attention(
     # here — the dense path pays the [b, h, sq, sk] dropout-mask
     # traffic on top of the score materialization, so the kernel wins
     # at every training shape
+    # dispatch counters fire at trace time (once per compiled shape,
+    # not per step) into the process-global registry — free when
+    # telemetry is off, and they let the flight recorder / summary
+    # attest which lowering each run actually took
     if (use_flash and dropout_rate > 0.0 and not deterministic
             and dropout_rng is not None
-            and not kv_cache_layout
-            and _kernel_dropout_enabled()):
-        try:
-            from .pallas import flash_attention as fa
-            return fa.flash_attention(q, k, v, causal=causal,
-                                      query_offset=query_offset,
-                                      dropout_rate=dropout_rate,
-                                      dropout_rng=dropout_rng,
-                                      bias=bias)
-        except (ImportError, NotImplementedError):
-            pass
+            and not kv_cache_layout):
+        if _kernel_dropout_enabled():
+            try:
+                from .pallas import flash_attention as fa
+                out = fa.flash_attention(q, k, v, causal=causal,
+                                         query_offset=query_offset,
+                                         dropout_rate=dropout_rate,
+                                         dropout_rng=dropout_rng,
+                                         bias=bias)
+                metrics.inc("attention/flash_dropout")
+                return out
+            except (ImportError, NotImplementedError):
+                metrics.inc("attention/fallback/kernel_rejected")
+        else:
+            metrics.inc("attention/fallback/dropout_gate_off")
     # deterministic makes a configured dropout_rate inert, so eval and
     # generation may take the kernel even when training cannot
     if use_flash and (deterministic or dropout_rate == 0.0):
@@ -208,8 +218,10 @@ def dot_product_attention(
             if decode_bias_ok and kv_cache_layout:
                 # cached decode: single query token, dynamic cache
                 # index — the kernel skips blocks past the index
-                return fa.flash_decode(q, k, v, query_offset,
-                                       bias=bias)
+                out = fa.flash_decode(q, k, v, query_offset,
+                                      bias=bias)
+                metrics.inc("attention/flash_decode")
+                return out
             # non-causal at short seq: the dense XLA batched matmul
             # beats the kernel (measured on ERNIE h=768/s=512/d=64:
             # 10.9 vs 16.7 ms fwd — no causal-mask work to save and
@@ -218,11 +230,19 @@ def dot_product_attention(
             # sequences in either mode
             flash_worthwhile = causal or skv >= DENSE_NONCAUSAL_MAX_SKV
             if not kv_cache_layout and flash_worthwhile:
-                return fa.flash_attention(q, k, v, causal=causal,
-                                          query_offset=query_offset,
-                                          bias=bias)
+                out = fa.flash_attention(q, k, v, causal=causal,
+                                         query_offset=query_offset,
+                                         bias=bias)
+                metrics.inc("attention/flash")
+                return out
+            metrics.inc("attention/fallback/kv_cache_layout"
+                        if kv_cache_layout
+                        else "attention/fallback/short_noncausal")
         except (ImportError, NotImplementedError):
-            pass
+            metrics.inc("attention/fallback/kernel_rejected")
+    elif not use_flash:
+        metrics.inc("attention/fallback/flash_disabled")
+    metrics.inc("attention/dense")
     return _xla_attention(q, k, v, bias, causal, query_offset, dropout_rate,
                           dropout_rng, deterministic, softmax_in_fp32,
                           kv_cache_layout=kv_cache_layout)

@@ -76,6 +76,25 @@ def _build_logger() -> Logger:
 
 logger: Logger = _build_logger()
 
+# -- TIPC line-grammar contract ------------------------------------------
+# The benchmark harness greps the TRAIN/EVAL lines for these exact
+# ``key:`` tokens (reference run_benchmark.sh:17-21 pipes through
+# ``grep ips | awk -F 'ips:' ...``). The regexes pin the grammar so
+# tests (tests/test_log_grammar.py) fail loudly if a logging change —
+# e.g. a telemetry suffix — breaks the scrape, instead of silently
+# zeroing the benchmark dashboards.
+TRAIN_LINE_REQUIRED = ("loss:", "avg_batch_cost:", "speed:",
+                       "ips_total:", "ips:", "learning rate:")
+EVAL_LINE_REQUIRED = ("loss:", "avg_eval_cost:", "speed:")
+TRAIN_LINE_RE = (
+    r"\[train\] epoch: \d+, batch: \d+, loss: \d+\.\d{9}, "
+    r"avg_batch_cost: \d+\.\d{5} sec, speed: \d+\.\d{2} step/s, "
+    r"ips_total: \d+ tokens/s, ips: \d+ tokens/s, "
+    r"learning rate: \d\.\d{5}e[+-]\d+")
+EVAL_LINE_RE = (
+    r"\[eval\] epoch: \d+, batch: \d+, loss: \d+\.\d{9}, "
+    r"avg_eval_cost: \d+\.\d{5} sec, speed: \d+\.\d{2} step/s")
+
 
 @contextmanager
 def timed(name: str):

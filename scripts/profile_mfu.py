@@ -18,9 +18,10 @@ import optax
 
 from paddlefleetx_tpu.models.gpt import GPTConfig, GPTForPretraining
 from paddlefleetx_tpu.models.gpt.model import chunked_lm_loss
+from paddlefleetx_tpu.observability.flops import (
+    causal_attn_flops, model_flops_per_token, peak_flops,
+)
 from paddlefleetx_tpu.ops.pallas.flash_attention import flash_attention
-
-from bench import peak_flops
 
 PEAK = peak_flops() or 197e12
 
@@ -79,12 +80,12 @@ def timeit_rep(fn, x, *rest, n=3):
 
 
 def bench_attn():
+    """Sweep flash-attention block sizes and report TFLOP/s."""
     rng = np.random.default_rng(0)
     shape = (B, S, NH, D)
     q = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
     k = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
     v = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
-    from bench import causal_attn_flops
     fwd_flops = causal_attn_flops(B, NH, S, D)
     for bq, bkv in [(256, 256), (256, 512), (512, 512), (512, 1024),
                     (1024, 512), (1024, 1024), (512, 256)]:
@@ -105,6 +106,7 @@ def bench_attn():
 
 
 def bench_ce():
+    """Time the chunked cross-entropy head at several chunk counts."""
     rng = np.random.default_rng(0)
     h = jnp.asarray(rng.standard_normal((B, S, H)), jnp.bfloat16)
     emb = jnp.asarray(rng.standard_normal((V, H)) * 0.02, jnp.float32)
@@ -120,6 +122,7 @@ def bench_ce():
         csz = S // chunks
 
         def ce(h, emb, labels, mask, chunks=chunks, csz=csz):
+            """Chunk-scanned masked NLL over the tied LM head."""
             hc = h.reshape(B, chunks, csz, H).swapaxes(0, 1)
             lc = labels.reshape(B, chunks, csz).swapaxes(0, 1)
             mc = mask.reshape(B, chunks, csz).swapaxes(0, 1)
@@ -184,6 +187,7 @@ def _model_and_batch(**kw):
 
 
 def bench_micro():
+    """Time one microbatch fwd and fwd+bwd against the MFU formula."""
     cfg, model, params, ids, labels, mask = _model_and_batch()
 
     def loss_fn(p, ids, labels, mask):
@@ -194,7 +198,9 @@ def bench_micro():
     fwd = jax.jit(loss_fn)
     dt = timeit(fwd, params, ids, labels, mask)
     tok = B * S
-    fpt_fwd = 24 * L * H * H * (1 + S / (6 * H) + V / (12 * L * H))
+    # fwd-only = one third of the Megatron fwd+bwd count; derive it
+    # from the shared formula rather than keeping a second copy
+    fpt_fwd = model_flops_per_token(L, H, V, S) / 3.0
     report("microbatch fwd", dt, fpt_fwd * tok)
 
     g = jax.jit(jax.value_and_grad(loss_fn))
@@ -203,6 +209,7 @@ def bench_micro():
 
 
 def bench_opt():
+    """Time the optimizer update in isolation."""
     cfg, model, params, *_ = _model_and_batch()
     tx = optax.chain(optax.clip_by_global_norm(1.0),
                      optax.adamw(2e-4, weight_decay=0.01,

@@ -1,0 +1,99 @@
+"""Pin the TIPC-scraped TRAIN/EVAL line grammar (``loss:``/``ips:``).
+
+The reference benchmark harness greps these lines
+(``run_benchmark.sh:17-21``); the contract regexes live next to the
+logger (``utils/log.py``) and these tests fail loudly if a logging
+change — e.g. the telemetry ``hbm:`` suffix — breaks the scrape."""
+
+import logging
+import re
+
+from paddlefleetx_tpu.core.module import LanguageModule
+from paddlefleetx_tpu.utils.config import AttrDict
+from paddlefleetx_tpu.utils.log import (
+    EVAL_LINE_RE, EVAL_LINE_REQUIRED, TRAIN_LINE_RE,
+    TRAIN_LINE_REQUIRED, logger,
+)
+
+
+class _Capture(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.lines = []
+
+    def emit(self, record):
+        self.lines.append(record.getMessage())
+
+
+class _Module(LanguageModule):
+    def get_model(self):
+        return None
+
+
+def _capture_lines(fn):
+    h = _Capture()
+    logger.addHandler(h)
+    try:
+        fn()
+    finally:
+        logger.removeHandler(h)
+    return h.lines
+
+
+def _module(nranks=8):
+    m = _Module.__new__(_Module)
+    m.configs = AttrDict({"Global": AttrDict({"global_batch_size": 16})})
+    m.nranks = nranks
+    return m
+
+
+TRAIN_LOG = {"epoch": 1, "batch": 10, "loss": 4.123456789,
+             "train_cost": 0.25, "lr": 5e-5, "max_seq_len": 32}
+
+
+def test_train_line_matches_contract():
+    lines = _capture_lines(
+        lambda: _module().training_step_end(dict(TRAIN_LOG)))
+    assert len(lines) == 1
+    line = lines[0]
+    assert re.fullmatch(TRAIN_LINE_RE, line), line
+    for token in TRAIN_LINE_REQUIRED:
+        assert token in line, (token, line)
+    # the harness splits on 'ips:' and reads the number after it
+    ips = float(line.split("ips:")[-1].split("tokens/s")[0])
+    assert ips == round(16 * 32 / 0.25 / 8)
+
+
+def test_eval_line_matches_contract():
+    lines = _capture_lines(
+        lambda: _module().validation_step_end(
+            {"epoch": 1, "batch": 3, "loss": 4.5, "eval_cost": 0.5}))
+    assert len(lines) == 1
+    assert re.fullmatch(EVAL_LINE_RE, lines[0]), lines[0]
+    for token in EVAL_LINE_REQUIRED:
+        assert token in lines[0], (token, lines[0])
+
+
+def test_hbm_suffix_keeps_grammar():
+    """The telemetry HBM suffix rides AFTER every pinned field: the
+    contract regex still matches as a prefix and every grep token is
+    intact."""
+    log = dict(TRAIN_LOG)
+    log["hbm_bytes_in_use"] = int(3.5 * 2**30)
+    log["hbm_peak_bytes"] = int(5 * 2**30)
+    lines = _capture_lines(
+        lambda: _module().training_step_end(log))
+    line = lines[0]
+    assert re.match(TRAIN_LINE_RE, line), line
+    assert line.endswith(", hbm: 3.50G (peak 5.00G)"), line
+    for token in TRAIN_LINE_REQUIRED:
+        assert token in line
+    # and 'ips:' scraping still yields the same number
+    ips = float(line.split("ips:")[-1].split("tokens/s")[0])
+    assert ips == round(16 * 32 / 0.25 / 8)
+
+
+def test_no_hbm_suffix_without_sample():
+    lines = _capture_lines(
+        lambda: _module().training_step_end(dict(TRAIN_LOG)))
+    assert "hbm" not in lines[0]
