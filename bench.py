@@ -307,23 +307,6 @@ def wait_for_backend() -> dict:
         this_timeout = min(probe_timeout,
                            max(30.0, deadline - time.monotonic()))
         info, last, last_was_hang = probe_once(this_timeout)
-        # Circuit breaker on probes KILLED for hanging (BENCH_r05
-        # burned its whole 10500s budget on five consecutive hung
-        # probes and died rc=124 instead of reporting): each hang
-        # already consumed the full probe timeout, so a streak of
-        # them is a hard outage — report backend_unavailable NOW
-        # rather than rediscovering it until the budget expires.
-        # Only genuine probe_once hangs count; fast failures (gRPC
-        # errors, platform mismatches) keep the full retry budget.
-        hang_streak = hang_streak + 1 if last_was_hang else 0
-        if hang_streak >= max_hung:
-            _emit_failure(
-                "backend_unavailable",
-                f"{hang_streak} consecutive probes hung "
-                f">{this_timeout:.0f}s (killed) — backend wedged, "
-                f"not retrying the remaining "
-                f"{max(0.0, deadline - time.monotonic()):.0f}s budget; "
-                f"last: {last}")
         if info is not None:
             # a probe that silently fell back to CPU while the
             # environment expects a TPU is an OUTAGE, not success:
@@ -344,6 +327,28 @@ def wait_for_backend() -> dict:
             last = (f"probe reached platform="
                     f"{info.get('platform')!r}, expected tpu")
             last_was_hang = True  # outage shape, not a code bug
+        # Circuit breaker on outage-shaped probes (BENCH_r05 burned
+        # its whole 10500s budget on five consecutive hung probes and
+        # died rc=124 instead of reporting): each hang already
+        # consumed the full probe timeout, so a streak of them is a
+        # hard outage — report backend_unavailable NOW rather than
+        # rediscovering it until the budget expires. The accounting
+        # MUST run after the platform-mismatch reclassification above:
+        # a probe that "succeeds" on the wrong platform is the same
+        # outage shape (BENCH_r05's breaker never tripped because the
+        # pre-reclassification streak reset to 0 on every CPU-fallback
+        # probe mid-outage). Only fast failures (gRPC errors, connect
+        # refusals) reset the streak and keep the full retry budget.
+        hang_streak = hang_streak + 1 if last_was_hang else 0
+        if hang_streak >= max_hung:
+            _emit_failure(
+                "backend_unavailable",
+                f"{hang_streak} consecutive probes hung "
+                f">{this_timeout:.0f}s (killed) or reached the wrong "
+                f"platform — backend wedged, not retrying the "
+                f"remaining "
+                f"{max(0.0, deadline - time.monotonic()):.0f}s budget; "
+                f"last: {last}")
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             kind = ("backend_unavailable"
@@ -982,10 +987,13 @@ def bench_moe():
     """Tokens/s + active-FLOPs MFU of an 8-expert top-2 MoE at the
     345M width (h=1024; 8 layers — an ~620M-param stack whose fp32
     master + Adam moments + activations fill a 16G chip; 12 layers
-    measured 18.8G). Single-chip = ep 1; the dispatch/combine einsums
-    and router still run, so the number prices MoE's routing overhead
-    against ``bench_train``'s dense MFU."""
+    measured 18.8G). Single-chip = ep 1; the dispatch and router
+    still run, so the number prices MoE's routing overhead against
+    ``bench_train``'s dense MFU. ``PFX_BENCH_MOE_DISPATCH`` picks the
+    lowering (docs/moe.md; default "sort" — the r3 53.1k tokens/s
+    number was the "einsum" reference)."""
     on_tpu = jax.devices()[0].platform == "tpu"
+    dispatch = os.environ.get("PFX_BENCH_MOE_DISPATCH", "sort")
     batch, seq, acc = (4, 1024, 8) if on_tpu else (2, 128, 1)
     # off-TPU: machinery smoke only — shrink the stack (the full
     # h=1024/8-expert fp32 stack is multi-GB and minutes on CPU)
@@ -999,7 +1007,7 @@ def bench_moe():
         num_layers=8 if on_tpu else 2,
         moe_num_experts=8 if on_tpu else 4,
         moe_top_k=2, moe_capacity_factor=1.25,
-        moe_z_loss_weight=1e-3,
+        moe_z_loss_weight=1e-3, moe_dispatch=dispatch,
         scan_layers=not on_tpu,   # unrolled: 45.8k -> 53.1k tokens/s
         **shrink)
     tokens_per_sec = _measure_train(cfg, batch, seq, acc,
@@ -1020,6 +1028,7 @@ def bench_moe():
         "unit": "tokens/s",
         "vs_baseline": None,  # no reference MoE exists
         "mfu_active_flops": round(mfu, 4) if mfu is not None else None,
+        "moe_dispatch": dispatch,
     }
     _log_success(result)
     print(json.dumps(result))

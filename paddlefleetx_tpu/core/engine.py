@@ -318,6 +318,19 @@ class Engine(BasicEngine):
                 else "plain GSPMD collectives (set "
                      "use_collective_matmul + sequence_parallel to "
                      "overlap them; docs/tensor_parallel.md)")
+        if getattr(mcfg, "moe_num_experts", 0):
+            mode = mcfg.moe_dispatch
+            obs_metrics.inc("moe/config/" + mode)
+            logger.info(
+                "MoE dispatch (%d experts, top-%d, ep=%d): %s",
+                mcfg.moe_num_experts, mcfg.moe_top_k,
+                self.topo.ep_degree,
+                {"einsum": "dense one-hot dispatch/combine einsums "
+                           "(parity reference)",
+                 "sort": "counting-sort gather/scatter dispatch",
+                 "sort_pallas": "counting-sort dispatch + Pallas "
+                                "grouped expert GEMM"}[mode]
+                + " (docs/moe.md)")
 
     # -- jitted steps ---------------------------------------------------
 

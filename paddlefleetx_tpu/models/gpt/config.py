@@ -14,6 +14,8 @@ from typing import Optional
 
 @dataclasses.dataclass(frozen=True)
 class GPTConfig:
+    """Frozen GPT hyper-parameters (the YAML ``Model`` section)."""
+
     vocab_size: int = 51200
     hidden_size: int = 768
     num_layers: int = 12
@@ -71,6 +73,17 @@ class GPTConfig:
     moe_capacity_factor: float = 1.25     # slots = ceil(k*s*cf/E)
     moe_aux_loss_weight: float = 0.01     # Switch load-balance loss
     moe_z_loss_weight: float = 0.0        # router z-loss (off by default)
+    #: How routed tokens reach their experts (docs/moe.md):
+    #: "einsum" — dense one-hot [b, s, E, C] dispatch/combine einsums
+    #:   (the parity/fallback reference; O(b·s·E·C·h) pack/unpack);
+    #: "sort" — counting-sort gather into the contiguous per-expert
+    #:   [E, b, C, h] buffer and gate-weighted scatter-combine back
+    #:   (O(b·s·k·h) data movement, identical dropped-token set);
+    #: "sort_pallas" — "sort" dispatch + the Pallas grouped expert
+    #:   GEMM (ops/pallas/grouped_matmul.py) that skips empty expert
+    #:   groups from the routing counts (falls back to the XLA expert
+    #:   einsums per-site when the kernel rejects the shape).
+    moe_dispatch: str = "einsum"
     dtype: str = "float32"                # compute dtype (bf16 for AMP-O2)
     param_dtype: str = "float32"
 
@@ -175,6 +188,11 @@ class GPTConfig:
                     f"[1, moe_num_experts={self.moe_num_experts}]")
             if self.moe_capacity_factor <= 0:
                 raise ValueError("moe_capacity_factor must be > 0")
+            if self.moe_dispatch not in ("einsum", "sort",
+                                         "sort_pallas"):
+                raise ValueError(
+                    f"unknown moe_dispatch {self.moe_dispatch!r} "
+                    f"(expected 'einsum', 'sort' or 'sort_pallas')")
 
     @property
     def head_dim(self) -> int:

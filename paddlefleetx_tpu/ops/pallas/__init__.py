@@ -1,1 +1,7 @@
-"""Pallas subpackage."""
+"""Pallas subpackage.
+
+Kernels are imported lazily by their dispatch sites (ops/attention.py,
+models/gpt/moe.py) so an environment where the Pallas import itself
+fails still runs every XLA fallback path; importing THIS package stays
+side-effect free for the same reason.
+"""

@@ -1,0 +1,227 @@
+"""Grouped (per-expert) matmul for sort-based MoE dispatch, in Pallas.
+
+The sort-based MoE dispatch (``models/gpt/moe.py``,
+``moe_dispatch="sort*"``) gathers routed tokens into a contiguous
+``[E·b, C, h]`` buffer of per-(expert, batch-row) groups, each padded
+to the static capacity ``C``. The expert FFN is then G independent
+matmuls against per-expert weights — a *grouped* GEMM. XLA expresses
+it as one dense batched matmul over all ``G·C`` slots; this kernel
+instead iterates the expert group boundaries carried by the routing
+counts and **skips groups no token routed to** (their padded rows are
+zero, so the skipped matmul is exactly the zero block the dense form
+would have produced — bit-identical outputs, less MXU work; at the
+shipped ep8 config's load imbalance a third of (expert, row) groups
+are routinely empty).
+
+Layout: ``x [G, C, K]`` groups, ``w [Gw, K, N]`` per-expert weights
+with ``G == Gw * rep`` (``rep`` batch rows share one expert's weight),
+``counts [G]`` int32 live rows per group delivered by scalar prefetch
+(``PrefetchScalarGridSpec`` — the counts land in SMEM before the grid
+body runs, so the skip predicate costs no HBM traffic). The grid is
+``(G, N/bn, K/bk)`` with the K axis innermost-sequential, accumulating
+in fp32 VMEM scratch exactly like ``flash_attention.py``; the backward
+is wired through ``jax.custom_vjp``: dx reuses the forward kernel with
+``w`` transposed, dw is a second kernel accumulating ``xᵀ·dy`` over
+each expert's ``rep`` groups. Interpret mode
+(``PFX_PALLAS_INTERPRET=1``) lets the CPU test suite validate kernel
+semantics (tests/test_grouped_matmul.py) without a TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import _dot, _interpret, _sds
+
+
+def _block(dim: int, target: int) -> int:
+    """Largest power-of-two-shrunk block <= target dividing ``dim``
+    (1 always divides, so the shrink terminates)."""
+    b = max(1, min(target, dim))
+    while dim % b:
+        b //= 2
+    return b
+
+
+def _gmm_kernel(counts_ref, x_ref, w_ref, o_ref, acc_scr, *, num_k):
+    """out[g] = x[g] @ w[g // rep], skipping empty groups.
+
+    Scratch accumulates fp32 across the sequential ki axis; a group
+    with zero live rows never touches the MXU — its scratch stays the
+    zeros ``_init`` wrote, which IS the product of its all-zero padded
+    rows, so skipping preserves bitwise output parity with the dense
+    batched matmul."""
+    g = pl.program_id(0)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(counts_ref[g] > 0)
+    def _accumulate():
+        acc_scr[:] += _dot(x_ref[0], w_ref[0])
+
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        o_ref[0] = acc_scr[:].astype(o_ref.dtype)
+
+
+def _gmm_dw_kernel(counts_ref, x_ref, dy_ref, dw_ref, acc_scr, *,
+                   rep):
+    """dw[e] = sum over e's ``rep`` groups of x[g]ᵀ @ dy[g].
+
+    The group axis is innermost-sequential so the [K, bn] scratch
+    accumulates one expert's contributions before moving on; empty
+    groups are skipped (their x rows are zero — no contribution)."""
+    e = pl.program_id(0)
+    gi = pl.program_id(2)
+
+    @pl.when(gi == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(counts_ref[e * rep + gi] > 0)
+    def _accumulate():
+        acc_scr[:] += _dot(x_ref[0], dy_ref[0], trans_a=True)
+
+    @pl.when(gi == rep - 1)
+    def _finish():
+        dw_ref[0] = acc_scr[:].astype(dw_ref.dtype)
+
+
+def _gmm_forward(x, w, counts, block_n, block_k):
+    """One grouped-GEMM pallas_call: ``[G, C, K] @ [Gw, K, N] ->
+    [G, C, N]`` with per-group skip from ``counts``."""
+    g_groups, c_rows, k_dim = x.shape
+    w_groups, _, n_dim = w.shape
+    rep = g_groups // w_groups
+    bn = _block(n_dim, block_n)
+    bk = _block(k_dim, block_k)
+    num_n, num_k = n_dim // bn, k_dim // bk
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(g_groups, num_n, num_k),
+        in_specs=[
+            pl.BlockSpec((1, c_rows, bk),
+                         lambda g, ni, ki, c_ref: (g, 0, ki)),
+            pl.BlockSpec((1, bk, bn),
+                         lambda g, ni, ki, c_ref, _r=rep:
+                         (g // _r, ki, ni)),
+        ],
+        out_specs=pl.BlockSpec((1, c_rows, bn),
+                               lambda g, ni, ki, c_ref: (g, 0, ni)),
+        scratch_shapes=[pltpu.VMEM((c_rows, bn), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_gmm_kernel, num_k=num_k),
+        grid_spec=grid_spec,
+        out_shape=_sds((g_groups, c_rows, n_dim), x.dtype, x),
+        interpret=_interpret(),
+    )(counts, x, w)
+
+
+def _gmm_dw(x, dy, counts, w_groups, block_n):
+    """dw pallas_call: fp32 ``[Gw, K, N]`` cotangent of the weights."""
+    g_groups, c_rows, k_dim = x.shape
+    n_dim = dy.shape[-1]
+    rep = g_groups // w_groups
+    bn = _block(n_dim, block_n)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(w_groups, n_dim // bn, rep),
+        in_specs=[
+            pl.BlockSpec((1, c_rows, k_dim),
+                         lambda e, ni, gi, c_ref, _r=rep:
+                         (e * _r + gi, 0, 0)),
+            pl.BlockSpec((1, c_rows, bn),
+                         lambda e, ni, gi, c_ref, _r=rep:
+                         (e * _r + gi, 0, ni)),
+        ],
+        out_specs=pl.BlockSpec((1, k_dim, bn),
+                               lambda e, ni, gi, c_ref: (e, 0, ni)),
+        scratch_shapes=[pltpu.VMEM((k_dim, bn), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_gmm_dw_kernel, rep=rep),
+        grid_spec=grid_spec,
+        out_shape=_sds((w_groups, k_dim, n_dim), jnp.float32, x),
+        interpret=_interpret(),
+    )(counts, x, dy)
+
+
+def _check_shapes(x, w, counts):
+    """Kernel admission: a ``NotImplementedError`` here sends the MoE
+    layer to its XLA expert-einsum fallback (counted as
+    ``moe/fallback/pallas_rejected`` — docs/moe.md)."""
+    if x.ndim != 3 or w.ndim != 3 or counts.ndim != 1:
+        raise NotImplementedError(
+            f"grouped_matmul wants x[G,C,K] w[Gw,K,N] counts[G], got "
+            f"{x.shape} / {w.shape} / {counts.shape}")
+    if x.shape[0] != counts.shape[0] or \
+            x.shape[0] % w.shape[0] or x.shape[2] != w.shape[1]:
+        raise NotImplementedError(
+            f"grouped_matmul shape mismatch: x {x.shape}, w {w.shape},"
+            f" counts {counts.shape}")
+    if not jnp.issubdtype(counts.dtype, jnp.integer):
+        raise NotImplementedError("counts must be integer")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _grouped_matmul(x, w, counts, block_n, block_k):
+    return _gmm_forward(x, w, counts, block_n, block_k)
+
+
+def _grouped_matmul_fwd(x, w, counts, block_n, block_k):
+    return (_gmm_forward(x, w, counts, block_n, block_k),
+            (x, w, counts))
+
+
+def _grouped_matmul_bwd(block_n, block_k, res, g):
+    x, w, counts = res
+    # dx[g] = dy[g] @ w[g // rep]ᵀ — the forward kernel with w
+    # transposed; empty groups skip in BOTH directions, so dx is zero
+    # exactly where the dense form's zero dy rows would have made it
+    dx = _gmm_forward(g, jnp.swapaxes(w, 1, 2), counts, block_k,
+                      block_n)
+    dw = _gmm_dw(x, g, counts, w.shape[0], block_n)
+    return (dx.astype(x.dtype), dw.astype(w.dtype),
+            np.zeros(counts.shape, jax.dtypes.float0))
+
+
+_grouped_matmul.defvjp(_grouped_matmul_fwd, _grouped_matmul_bwd)
+
+
+def grouped_matmul(x: jax.Array, w: jax.Array, counts: jax.Array,
+                   block_n: int = 128, block_k: int = 512) -> jax.Array:
+    """Per-group matmul ``out[g] = x[g] @ w[g // (G//Gw)]`` that skips
+    groups with ``counts[g] == 0``.
+
+    Args:
+      x: ``[G, C, K]`` — G groups of C capacity-padded rows (rows past
+        ``counts[g]`` MUST be zero; the sort dispatch guarantees it).
+      w: ``[Gw, K, N]`` — per-expert weights, ``Gw`` divides ``G``;
+        consecutive blocks of ``G // Gw`` groups share one weight.
+      counts: int32 ``[G]`` live rows per group (a trace-time array —
+        fresh routing per step must not retrace; delivered to the
+        kernels by scalar prefetch).
+      block_n / block_k: N/K tile targets (shrunk to divisors).
+
+    Returns ``[G, C, N]`` in ``x.dtype``, accumulated in fp32. The
+    custom VJP computes dx with the same kernel (w transposed) and dw
+    with a per-expert accumulation kernel — both honor the same
+    empty-group skip. The skip is gradient-exact under the MoE
+    contract: dw loses nothing (a skipped group's x rows are zero)
+    and dx loses nothing because an empty group's outputs are pure
+    capacity padding that the combine step zero-weights, so its
+    cotangent rows arrive as zeros.
+    """
+    _check_shapes(x, w, counts)
+    return _grouped_matmul(x, w, counts.astype(jnp.int32), block_n,
+                           block_k)

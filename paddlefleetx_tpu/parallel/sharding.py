@@ -120,6 +120,12 @@ def make_sharding_rules(topo: TopologyConfig) -> Rules:
         # this, ep < dp*fsdp would silently replicate expert compute
         # over the uncovered axes
         ("act_expert_batch", _residual_data_axes(expert_axis)),
+        # slot dim of the sort-dispatch [b, E*C, h] grouped buffer
+        # (moe_dispatch="sort*"): it interleaves EVERY expert's
+        # capacity block, so it must not shard over the expert axis —
+        # the reshape+transpose to the ep-sharded [E, b, C, h] layout
+        # under "act_expert" is where GSPMD places the all-to-all
+        ("act_expert_slot", None),
     )
 
 

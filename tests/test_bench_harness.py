@@ -95,6 +95,33 @@ def test_hang_streak_resets_on_fast_failure(monkeypatch):
     assert bench.wait_for_backend()["platform"] == "tpu"
 
 
+def test_wrong_platform_probe_counts_toward_hang_streak(
+        monkeypatch, capsys):
+    """ISSUE 4 satellite: BENCH_r05 burned its whole budget because
+    probes that 'succeeded' on the CPU while the tunnel was down reset
+    the hang streak — the streak accounting ran BEFORE the
+    platform-mismatch reclassification. hang, hang, cpu-fallback is
+    three consecutive outage-shaped probes and must trip the breaker
+    immediately."""
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    # a budget far from expiring: only the streak can end the loop
+    monkeypatch.setenv("PFX_BENCH_MAX_WAIT", "100000")
+    calls = iter(["hang", "hang", "cpu"])
+
+    def run(*a, **k):
+        if next(calls) == "hang":
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
+        return _probe_ok(platform="cpu")
+    monkeypatch.setattr(bench.subprocess, "run", run)
+    with pytest.raises(SystemExit) as e:
+        bench.wait_for_backend()
+    assert e.value.code == 1
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["error_kind"] == "backend_unavailable"
+    assert "3 consecutive probes hung" in rec["error"]
+    assert "expected tpu" in rec["error"]
+
+
 def test_nontransient_emits_structured_exception(monkeypatch, capsys):
     """An un-outage-looking failure (ImportError) is still RETRIED
     until the budget expires (ADVICE r4 #2: unknown probe failures are

@@ -19,7 +19,7 @@ from paddlefleetx_tpu.models.gpt import (
     GPTConfig, GPTForPretraining, cross_entropy_loss,
 )
 from paddlefleetx_tpu.models.gpt.moe import (
-    MoEMLP, expert_capacity, router_dispatch,
+    MoEMLP, expert_capacity, router_dispatch, sort_routing,
 )
 from paddlefleetx_tpu.parallel import (
     TopologyConfig, build_mesh, make_sharding_rules,
@@ -205,10 +205,8 @@ def test_expert_weights_land_sharded():
     assert wi.spec == P(None, ("dp", "fsdp"), None, "mp"), wi.spec
 
 
-def test_moe_engine_train_step_decreases_loss():
-    from paddlefleetx_tpu.core import Engine
-    from paddlefleetx_tpu.models import build_module
-    from paddlefleetx_tpu.utils.config import AttrDict, process_configs
+def _moe_engine_cfg(**model_overrides):
+    from paddlefleetx_tpu.utils.config import AttrDict
 
     cfg = AttrDict({
         "Global": AttrDict({"seed": 11, "local_batch_size": 8,
@@ -236,9 +234,23 @@ def test_moe_engine_train_step_decreases_loss():
             "grad_clip": AttrDict({"clip_norm": 1.0}),
         }),
     })
+    cfg["Model"].update(model_overrides)
+    return cfg
+
+
+def _moe_engine(**model_overrides):
+    from paddlefleetx_tpu.core import Engine
+    from paddlefleetx_tpu.models import build_module
+    from paddlefleetx_tpu.utils.config import process_configs
+
+    cfg = _moe_engine_cfg(**model_overrides)
     process_configs(cfg, nranks=8)
     module = build_module(cfg)
-    engine = Engine(cfg, module, mode="train")
+    return Engine(cfg, module, mode="train")
+
+
+def test_moe_engine_train_step_decreases_loss():
+    engine = _moe_engine()
 
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, 64, (8, 16)).astype(np.int64)
@@ -320,3 +332,230 @@ def test_moe_config_validation():
         GPTConfig(moe_num_experts=2, moe_top_k=3)
     with pytest.raises(ValueError, match="capacity_factor"):
         GPTConfig(moe_num_experts=2, moe_capacity_factor=0.0)
+    with pytest.raises(ValueError, match="moe_dispatch"):
+        GPTConfig(moe_num_experts=2, moe_dispatch="argsort")
+
+
+# -- dispatch-mode parity matrix (ISSUE 4 tentpole) --------------------
+#
+# sort/sort_pallas must reproduce the einsum reference bit-for-policy:
+# identical outputs, identical dropped-token sets, fp32-tolerance
+# gradients — under ep in {1, 2, 4} and top_k in {1, 2} (docs/moe.md).
+
+EP_TOPOS = {
+    1: dict(dp_degree=8),
+    2: dict(dp_degree=2, mp_degree=4, ep_degree=2),
+    4: dict(dp_degree=4, mp_degree=2, ep_degree=4),
+}
+
+
+def _parity_cfg(top_k, mode):
+    # capacity_factor < 1 forces real capacity drops into the matrix
+    return dataclasses.replace(
+        MOE_CFG, moe_top_k=top_k, moe_capacity_factor=0.75,
+        moe_dispatch=mode)
+
+
+@pytest.fixture(scope="module")
+def dispatch_golden():
+    """einsum-mode layer outputs/loss/grads per top_k, no mesh."""
+    out = {}
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(4, 16, 16)), jnp.float32)
+    for top_k in (1, 2):
+        layer = MoEMLP(_parity_cfg(top_k, "einsum"))
+        params = nn.meta.unbox(
+            layer.init({"params": jax.random.key(2)}, x))["params"]
+
+        def loss(p, layer=layer):
+            y, aux = layer.apply({"params": p}, x)
+            return (y ** 2).sum() + aux
+        l, g = jax.value_and_grad(loss)(params)
+        y, _ = layer.apply({"params": params}, x)
+        out[top_k] = (x, params, l, g, y)
+    return out
+
+
+@pytest.mark.parametrize("ep", [1, 2, 4])
+@pytest.mark.parametrize("top_k", [1, 2])
+@pytest.mark.parametrize("mode", ["sort", "sort_pallas"])
+def test_dispatch_modes_match_einsum(dispatch_golden, monkeypatch,
+                                     mode, top_k, ep):
+    monkeypatch.setenv("PFX_PALLAS_INTERPRET", "1")
+    x, params, ref_l, ref_g, ref_y = dispatch_golden[top_k]
+    topo = TopologyConfig(**EP_TOPOS[ep])
+    mesh = build_mesh(topo)
+    rules = make_sharding_rules(topo)
+    layer = MoEMLP(_parity_cfg(top_k, mode))
+
+    def loss(p):
+        y, aux = layer.apply({"params": p}, x)
+        return (y ** 2).sum() + aux
+    with mesh, nn.logical_axis_rules(list(rules)):
+        l, g = jax.jit(jax.value_and_grad(loss))(params)
+        y, _ = jax.jit(layer.apply)({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref_y),
+                               atol=5e-5, rtol=1e-4)
+    np.testing.assert_allclose(float(l), float(ref_l), rtol=2e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, rtol=1e-3),
+        ref_g, g)
+
+
+def test_sort_and_dense_drop_identical_tokens():
+    """The acceptance bar's sharpest edge: not just close outputs but
+    the very same (token, choice) set surviving capacity — compared
+    slot-for-slot between the one-hot dispatch tensor and the sort
+    plan's destination map."""
+    rng = np.random.default_rng(23)
+    b, s, E, k, C = 2, 32, 4, 2, 3  # C far under s*k/E: heavy drops
+    probs = jax.nn.softmax(
+        jnp.asarray(rng.normal(size=(b, s, E)) * 3, jnp.float32), -1)
+    d, _, _ = router_dispatch(probs, k, C)
+    gate, dest, src, counts, _ = sort_routing(probs, k, C)
+
+    idx = np.asarray(jax.lax.top_k(probs, k)[1])        # [b, s, k]
+    kept_choice = np.asarray(dest).reshape(b, s, k) < E * C
+    sort_kept = np.zeros((b, s, E))
+    for bi in range(b):
+        for si in range(s):
+            for ki in range(k):
+                if kept_choice[bi, si, ki]:
+                    sort_kept[bi, si, idx[bi, si, ki]] += 1.0
+    np.testing.assert_array_equal(np.asarray(d.sum(axis=3)), sort_kept)
+    # per-expert occupancy used as the Pallas group boundaries must
+    # equal the dense tensor's slot usage
+    np.testing.assert_array_equal(np.asarray(counts),
+                                  np.asarray(d.sum(axis=(1, 3))))
+    # every occupied slot maps back to a real token, every empty slot
+    # to the zero pad row
+    occupied = np.asarray(src) < s
+    assert occupied.sum() == np.asarray(counts).sum()
+
+
+@pytest.mark.parametrize("mode", ["einsum", "sort"])
+def test_all_tokens_dropped_is_pure_residual(monkeypatch, mode):
+    """Every token overflowing (capacity forced to 0) must yield an
+    exactly-zero MoE output — at the decoder layer only the residual
+    stream survives — identically in both dispatch lowerings."""
+    import paddlefleetx_tpu.models.gpt.moe as moe_mod
+    monkeypatch.setattr(moe_mod, "expert_capacity", lambda cfg, s: 0)
+    layer = MoEMLP(dataclasses.replace(MOE_CFG, moe_dispatch=mode))
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(2, 8, 16)),
+                    jnp.float32)
+    variables = layer.init({"params": jax.random.key(0)}, x)
+    y, aux = layer.apply(variables, x)
+    np.testing.assert_array_equal(np.asarray(y), 0.0)
+    assert np.isfinite(float(aux))  # router losses still train
+
+
+def test_top_k_equals_num_experts_all_modes(monkeypatch):
+    """k == E with ample capacity: every token reaches every expert
+    (soft-MoE limit), nothing drops, and all three lowerings agree."""
+    monkeypatch.setenv("PFX_PALLAS_INTERPRET", "1")
+    x = jnp.asarray(np.random.default_rng(9).normal(size=(2, 8, 16)),
+                    jnp.float32)
+    cfg = dataclasses.replace(MOE_CFG, moe_top_k=4,
+                              moe_capacity_factor=1.0)
+    # C = ceil(4*8*1.0/4) = 8 = s: every expert can host every token
+    d, c, _ = router_dispatch(
+        jax.nn.softmax(jnp.asarray(
+            np.random.default_rng(9).normal(size=(2, 8, 4)),
+            jnp.float32), -1), 4, expert_capacity(cfg, 8))
+    np.testing.assert_array_equal(np.asarray(d.sum(axis=(2, 3))), 4.0)
+    np.testing.assert_allclose(np.asarray(c.sum(axis=(2, 3))), 1.0,
+                               atol=1e-6)
+    ys = {}
+    params = None
+    for mode in ("einsum", "sort", "sort_pallas"):
+        layer = MoEMLP(dataclasses.replace(cfg, moe_dispatch=mode))
+        if params is None:
+            params = layer.init({"params": jax.random.key(1)}, x)
+        ys[mode], _ = layer.apply(params, x)
+    np.testing.assert_allclose(np.asarray(ys["sort"]),
+                               np.asarray(ys["einsum"]), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ys["sort_pallas"]),
+                               np.asarray(ys["einsum"]), atol=1e-5)
+
+
+def test_expert_capacity_rounding():
+    cfg = dataclasses.replace(MOE_CFG, moe_top_k=1,
+                              moe_capacity_factor=1.0,
+                              moe_num_experts=3)
+    assert expert_capacity(cfg, 16) == 6   # ceil(16/3), rounds UP
+    assert expert_capacity(cfg, 15) == 5   # exact divisor: no pad
+    cfg = dataclasses.replace(cfg, moe_capacity_factor=0.1)
+    assert expert_capacity(cfg, 2) == 1    # floor-clamped to 1 slot
+
+
+# -- moe/* dispatch counters (trace-time, docs/moe.md) -----------------
+
+
+@pytest.fixture
+def _registry():
+    from paddlefleetx_tpu.observability import metrics as obs_metrics
+    reg = obs_metrics.get_registry()
+    prior = reg.enabled
+    reg.reset()
+    obs_metrics.set_enabled(True)
+    yield reg
+    obs_metrics.set_enabled(prior)
+    reg.reset()
+
+
+def test_moe_dispatch_counters(_registry, monkeypatch):
+    monkeypatch.setenv("PFX_PALLAS_INTERPRET", "1")
+    x = jnp.asarray(np.random.default_rng(4).normal(size=(2, 8, 16)),
+                    jnp.float32)
+    for mode in ("einsum", "sort", "sort_pallas"):
+        layer = MoEMLP(dataclasses.replace(MOE_CFG,
+                                           moe_dispatch=mode))
+        variables = layer.init({"params": jax.random.key(0)}, x)
+        layer.apply(variables, x)
+        assert _registry.counter("moe/" + mode) >= 1, mode
+    assert _registry.counter("moe/fallback/pallas_rejected") == 0
+
+
+def test_moe_pallas_rejection_counts_and_falls_back(
+        _registry, monkeypatch):
+    """A kernel-rejected shape must land on the sort-mode XLA expert
+    einsums with identical numbers, counting the rejection."""
+    import paddlefleetx_tpu.ops.pallas.grouped_matmul as gm
+    monkeypatch.setenv("PFX_PALLAS_INTERPRET", "1")
+
+    def refuse(*a, **k):
+        raise NotImplementedError("forced rejection")
+    x = jnp.asarray(np.random.default_rng(5).normal(size=(2, 8, 16)),
+                    jnp.float32)
+    layer = MoEMLP(dataclasses.replace(MOE_CFG,
+                                       moe_dispatch="sort_pallas"))
+    variables = layer.init({"params": jax.random.key(0)}, x)
+    y_ref, _ = MoEMLP(dataclasses.replace(
+        MOE_CFG, moe_dispatch="sort")).apply(variables, x)
+    monkeypatch.setattr(gm, "grouped_matmul", refuse)
+    _registry.reset()
+    y, _ = layer.apply(variables, x)
+    assert _registry.counter("moe/fallback/pallas_rejected") >= 1
+    assert _registry.counter("moe/sort") >= 1
+    assert _registry.counter("moe/sort_pallas") == 0
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               atol=1e-6)
+
+
+def test_moe_engine_logs_dispatch_lowering(_registry):
+    """Engine init must announce the configured MoE lowering (counted
+    moe/config/<mode>) exactly as mp_linear/config/* does — here with
+    moe_dispatch plumbed through the Model YAML section. The project
+    logger has propagate=False, so assert on the call itself."""
+    from unittest import mock
+
+    from paddlefleetx_tpu.utils.log import logger
+    with mock.patch.object(logger, "info", wraps=logger.info) as info:
+        _moe_engine(moe_dispatch="sort")
+    assert _registry.counter("moe/config/sort") == 1
+    moe_lines = [c for c in info.call_args_list
+                 if "MoE dispatch" in c.args[0]]
+    assert moe_lines, info.call_args_list
+    assert "counting-sort" in (moe_lines[0].args[0]
+                               % moe_lines[0].args[1:])
