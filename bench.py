@@ -20,6 +20,12 @@ through the fixed-capacity KV cache) in decoded tokens/s — the
 reference publishes generation behavior via ``tasks/gpt/generation.py``
 but no number; this attaches one.
 
+``--mode serving`` benchmarks continuous-batching decode (the
+slot-managed ``GenerationServer``, core/serving.py) over a pinned
+mixed-length request trace (``PFX_BENCH_SERVING_*`` knobs) in decode
+tokens/s/chip — the throughput the lockstep ``--mode generation``
+number forfeits by running every request at the batch's slowest pace.
+
 ``--mode moe`` benchmarks the 8-expert top-2 MoE variant of the 345M
 geometry (models/gpt/moe.py; no reference analogue — it has no MoE).
 Reported MFU counts ACTIVE FLOPs (top-2 of 8 experts ≈ 2x the dense
@@ -52,6 +58,7 @@ METRIC_BY_MODE = {
     "train": HEADLINE_METRIC,
     "moe": "gpt345m_moe8_top2_pretrain_tokens_per_sec_per_chip",
     "generation": "gpt345m_generation_decode_tokens_per_sec",
+    "serving": "gpt345m_serving_decode_tokens_per_sec_per_chip",
     "convergence": "gpt345m_convergence_loss_at_300",
     "67b": "gpt3_6p7b_geometry_mfu",
     "longctx": "gpt345m_long_context_s8192_mfu",
@@ -1083,6 +1090,81 @@ def bench_generation():
     print(json.dumps(result))
 
 
+def bench_serving():
+    """``--mode serving``: continuous-batching decode tokens/s/chip.
+
+    A ``GenerationServer`` (core/serving.py) serves a deterministic
+    mixed-length request trace — more requests than slots, prompt
+    lengths uniform over a range so admission staggers and slots turn
+    over mid-run (the regime continuous batching exists for; the
+    lockstep ``--mode generation`` number is its fixed-batch
+    counterpart). The trace is pinned by env knobs so runs are
+    reproducible and the harness test can pin the grammar:
+    ``PFX_BENCH_SERVING_REQUESTS`` / ``_SLOTS`` / ``_SEED`` /
+    ``_MIN_PROMPT`` / ``_MAX_PROMPT`` / ``_DEC_LEN``.
+
+    The metric is decode-tick tokens/s (prefill/admission excluded):
+    the whole trace runs once to compile every prefill bucket + the
+    tick, then a second identical pass is measured via the server's
+    own decode-time accounting."""
+    from paddlefleetx_tpu.core.serving import GenerationServer
+    from paddlefleetx_tpu.models.gpt.generation import GenerationConfig
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = _gpt345m(True)
+        d_req, d_slots, d_min, d_max, d_dec = 32, 8, 16, 384, 128
+    else:  # offline smoke: the machinery, not the 345M numbers
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_attention_heads=4,
+                        max_position_embeddings=64,
+                        hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+        d_req, d_slots, d_min, d_max, d_dec = 6, 2, 4, 24, 12
+    n_requests = int(os.environ.get("PFX_BENCH_SERVING_REQUESTS",
+                                    d_req))
+    num_slots = int(os.environ.get("PFX_BENCH_SERVING_SLOTS", d_slots))
+    seed = int(os.environ.get("PFX_BENCH_SERVING_SEED", "0"))
+    min_p = int(os.environ.get("PFX_BENCH_SERVING_MIN_PROMPT", d_min))
+    max_p = int(os.environ.get("PFX_BENCH_SERVING_MAX_PROMPT", d_max))
+    dec_len = int(os.environ.get("PFX_BENCH_SERVING_DEC_LEN", d_dec))
+    model = GPTForPretraining(cfg)
+    rng = np.random.default_rng(seed)
+    lengths = rng.integers(min_p, max_p + 1, n_requests)
+    prompts = [rng.integers(0, cfg.vocab_size - 2, int(n)).tolist()
+               for n in lengths]
+    params = jax.jit(model.init)(
+        {"params": jax.random.key(0)},
+        jnp.asarray(prompts[0], jnp.int32)[None])["params"]
+    gen_cfg = GenerationConfig(
+        max_dec_len=dec_len, decode_strategy="sampling", top_k=50,
+        top_p=0.75, eos_token_id=cfg.vocab_size - 1,
+        pad_token_id=cfg.vocab_size - 1)
+    srv = GenerationServer(model, params, gen_cfg,
+                           num_slots=num_slots,
+                           rng=jax.random.key(seed + 1))
+    srv.run(prompts)  # warm pass: compiles every bucket + the tick
+    warm = srv.summary()
+    srv.run(prompts)
+    total = srv.summary()
+    tokens = total["decode_tokens"] - warm["decode_tokens"]
+    dt = total["decode_time_sec"] - warm["decode_time_sec"]
+    decode_tps = tokens / dt if dt > 0 else 0.0
+    result = {
+        "metric": METRIC_BY_MODE["serving"],
+        "value": round(decode_tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,  # the reference has no serving path
+        "requests": n_requests,
+        "slots": num_slots,
+        "prompt_len_range": [min_p, max_p],
+        "max_dec_len": dec_len,
+        "seed": seed,
+        "decode_ticks": total["decode_ticks"] - warm["decode_ticks"],
+    }
+    _log_success(result)
+    print(json.dumps(result))
+
+
 def _zipf_markov_corpus(vocab: int, n_tokens: int, seq: int,
                         seed: int = 0, s: float = 1.1,
                         p_rep: float = 0.5):
@@ -1233,7 +1315,7 @@ def main():
     """Parse --mode, acquire the backend, run the selected bench."""
     p = argparse.ArgumentParser()
     p.add_argument("--mode",
-                   choices=["train", "generation", "moe",
+                   choices=["train", "generation", "serving", "moe",
                             "convergence", "67b", "longctx"],
                    default="train")
     args = p.parse_args()
@@ -1265,6 +1347,8 @@ def main():
                            os.path.abspath(__file__)), ".xla_cache")))
     if args.mode == "train":
         bench_train()
+    elif args.mode == "serving":
+        bench_serving()
     elif args.mode == "moe":
         bench_moe()
     elif args.mode == "convergence":

@@ -1,3 +1,4 @@
 """Core subpackage."""
 from .engine import BasicEngine, Engine  # noqa: F401
 from .module import BasicModule, LanguageModule  # noqa: F401
+from .serving import Completion, GenerationServer  # noqa: F401

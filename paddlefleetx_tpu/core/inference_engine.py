@@ -127,7 +127,10 @@ class InferenceEngine:
         """Feed ``data`` (one array-like per exported input), run, and
         return outputs keyed by position (the reference returns the
         predictor's named output handles; positions are the stable
-        equivalent here)."""
+        equivalent here). Each call accumulates wall time under the
+        ``inference/predict`` timer and bumps ``inference/predict_calls``
+        and ``inference/output_tokens`` (total output elements) —
+        docs/observability.md."""
         metrics.inc("inference/predict_calls")
         pads = self.pad_values or [0] * len(data)
         inputs = pad_to_spec([np.asarray(d) for d in data], self.spec,
@@ -135,8 +138,27 @@ class InferenceEngine:
         if self._input_sharding is not None:
             inputs = [jax.device_put(x, self._input_sharding)
                       for x in inputs]
-        with annotate("predict"):
-            outputs = self.call(self.params, *inputs)
-        if not isinstance(outputs, (tuple, list)):
-            outputs = (outputs,)
-        return {str(i): np.asarray(o) for i, o in enumerate(outputs)}
+        with metrics.get_registry().timer("inference/predict"):
+            with annotate("predict"):
+                outputs = self.call(self.params, *inputs)
+            if not isinstance(outputs, (tuple, list)):
+                outputs = (outputs,)
+            # np.asarray blocks on the device result, so the transfer
+            # lands inside the per-call latency timer
+            result = {str(i): np.asarray(o)
+                      for i, o in enumerate(outputs)}
+        metrics.inc("inference/output_tokens",
+                    sum(o.size for o in result.values()))
+        return result
+
+    @staticmethod
+    def serve_generation(model, params, gen_cfg, num_slots: int = 4,
+                         **kwargs):
+        """Build a continuous-batching :class:`~paddlefleetx_tpu.core.
+        serving.GenerationServer` over a live model (slot-managed KV
+        cache + ragged flash decode) — the serving counterpart of the
+        artifact-driven ``predict`` path. Extra ``kwargs`` pass through
+        to the server (``prefill_buckets``, ``rng``, ``events_path``)."""
+        from .serving import GenerationServer
+        return GenerationServer(model, params, gen_cfg,
+                                num_slots=num_slots, **kwargs)

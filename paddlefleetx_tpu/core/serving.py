@@ -1,0 +1,293 @@
+"""Continuous-batching generation server over slot-managed KV cache.
+
+The lockstep ``generate()`` path (``models/gpt/generation.py``) runs a
+batch at the speed of its longest request and admits nothing until the
+whole batch drains. ``GenerationServer`` keeps decode rolling instead:
+a persistent ``[slots, ...]`` KV cache lives on device, the host owns a
+request queue and admits each request into a free slot (a bucketed
+``prefill_into_slots`` — one compiled shape per prompt-length bucket),
+and ONE jitted SPMD ``decode_step`` ticks every occupied slot forward a
+token with per-slot lengths/sampling state through the ragged attention
+dispatch (``flash_decode_ragged`` or the XLA per-row-offset fallback —
+dispatch matrix in docs/inference.md). Finished slots are evicted
+between ticks and their completions returned, so new requests ride in
+as soon as capacity frees and throughput never drops to the slowest
+request.
+
+Slot-for-slot parity: greedy completions match the lockstep
+``generate()`` exactly, whatever the admission order or prompt-length
+mix (pinned by tests/test_serving.py's parity matrix).
+
+Telemetry (docs/observability.md): ``serving/slot_occupancy`` gauge,
+``serving/admitted`` / ``serving/evicted`` / ``serving/preempted``
+counters, a ``serving/decode_tick`` timer, and a tokens/s summary; an
+optional flight recorder mirrors admissions/evictions to an
+``events.jsonl`` stream CI's failure-diagnostics artifact collects.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.gpt.generation import (
+    GenerationConfig, _unrolled_twin, decode_step, init_slot_cache,
+    init_slot_state, prefill_into_slots,
+)
+from ..observability import metrics
+from ..observability.recorder import FlightRecorder
+from ..utils.log import logger
+
+
+def default_prefill_buckets(max_prompt_len: int) -> Tuple[int, ...]:
+    """Powers of two from 16 up to ``max_prompt_len``, which is always
+    included — a handful of compiled prefill shapes covers every
+    admissible prompt length."""
+    out = []
+    b = 16
+    while b < max_prompt_len:
+        out.append(b)
+        b *= 2
+    out.append(max_prompt_len)
+    return tuple(out)
+
+
+@dataclass
+class Completion:
+    """One finished request as returned by :meth:`GenerationServer.step`."""
+    request_id: int
+    prompt: List[int]
+    #: emitted tokens in order, EOS included when hit (identical to the
+    #: lockstep ``generate()`` row before its pad tail)
+    tokens: List[int]
+    #: "eos" | "length" (hit max_dec_len) | "preempted"
+    finish_reason: str
+
+
+class GenerationServer:
+    """Host-side queue/admit/evict loop around the jitted slot
+    primitives (``models/gpt/generation.py``).
+
+    ``model``/``params`` are the live flax model and its parameters
+    (the layer loop is unrolled and params cast to the compute dtype
+    once, exactly as ``generate()`` prepares them). Sampling and greedy
+    strategies are served; beam search stays on the lockstep path.
+    """
+
+    def __init__(self, model, params, gen_cfg: GenerationConfig,
+                 num_slots: int = 4,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 rng: Optional[jax.Array] = None,
+                 events_path: Optional[str] = None):
+        if gen_cfg.decode_strategy == "beam_search":
+            raise ValueError(
+                "GenerationServer serves sampling/greedy_search; beam "
+                "search reorders the batch every step and stays on the "
+                "lockstep generate() path")
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        model, params = _unrolled_twin(model, params)
+        cfg = model.config
+        compute_dtype = jnp.dtype(cfg.dtype)
+        if compute_dtype != jnp.float32:
+            # same one-time cast as generate(): halve the per-token
+            # parameter bandwidth of the decode tick
+            params = jax.tree.map(
+                lambda p: p.astype(compute_dtype)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+        self.model, self.params = model, params
+        self.gen_cfg = gen_cfg
+        self.num_slots = num_slots
+        self._max_prompt = cfg.max_position_embeddings - gen_cfg.max_dec_len
+        if self._max_prompt < 1:
+            raise ValueError(
+                f"max_dec_len ({gen_cfg.max_dec_len}) leaves no room "
+                f"for prompts under max_position_embeddings "
+                f"{cfg.max_position_embeddings}")
+        buckets = tuple(sorted(set(
+            prefill_buckets or default_prefill_buckets(self._max_prompt))))
+        if buckets[-1] < self._max_prompt:
+            buckets = buckets + (self._max_prompt,)
+        self._buckets = buckets
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._cache = init_slot_cache(model, params, num_slots)
+        self._state = init_slot_state(num_slots, cfg.vocab_size)
+        self._queue: deque = deque()
+        self._slots: List[Optional[dict]] = [None] * num_slots
+        self._next_id = 0
+        self._nonce = 0
+        self._counts = {"admitted": 0, "evicted": 0, "preempted": 0}
+        self._ticks = 0
+        self._decode_tokens = 0
+        self._tick_time = 0.0
+        self._recorder = FlightRecorder(events_path) if events_path \
+            else None
+        self._emit("serving_start", slots=num_slots,
+                   buckets=list(buckets),
+                   max_dec_len=gen_cfg.max_dec_len)
+        logger.info(
+            "GenerationServer: %d slots, prefill buckets %s, "
+            "capacity %d (max_position_embeddings %d)", num_slots,
+            list(buckets), cfg.cache_capacity,
+            cfg.max_position_embeddings)
+
+    # -- host bookkeeping ---------------------------------------------
+
+    def _emit(self, event: str, **fields) -> None:
+        if self._recorder is not None:
+            self._recorder.emit(event, **fields)
+
+    @property
+    def occupancy(self) -> int:
+        """Number of slots currently holding a live request."""
+        return sum(s is not None for s in self._slots)
+
+    @property
+    def pending(self) -> int:
+        """Number of submitted requests still waiting for a slot."""
+        return len(self._queue)
+
+    def submit(self, prompt: Sequence[int]) -> int:
+        """Queue a request; returns its id. Raises when the prompt can
+        never fit (``prompt + max_dec_len > max_position_embeddings``)
+        — an oversized request must fail loudly at the door, not stall
+        the queue."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > self._max_prompt:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_dec_len "
+                f"({self.gen_cfg.max_dec_len}) exceeds "
+                f"max_position_embeddings "
+                f"{self.model.config.max_position_embeddings}")
+        rid = self._next_id
+        self._next_id += 1
+        self._queue.append({"id": rid, "prompt": prompt, "tokens": []})
+        return rid
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self._buckets:
+            if b >= n:
+                return b
+        return self._buckets[-1]
+
+    def _admit(self) -> None:
+        """Move queued requests into free slots (bucketed prefill)."""
+        while self._queue and None in self._slots:
+            req = self._queue.popleft()
+            slot = self._slots.index(None)
+            bucket = self._bucket_for(len(req["prompt"]))
+            row = np.full((1, bucket), self.gen_cfg.pad_token_id,
+                          np.int32)
+            row[0, :len(req["prompt"])] = req["prompt"]
+            nonce = self._nonce
+            self._nonce += 1
+            self._cache, self._state = prefill_into_slots(
+                self.model, self.params, self._cache, self._state,
+                jnp.asarray([slot], jnp.int32), jnp.asarray(row),
+                jnp.asarray([len(req["prompt"])], jnp.int32),
+                jnp.asarray([nonce], jnp.int32))
+            self._slots[slot] = req
+            self._counts["admitted"] += 1
+            metrics.inc("serving/admitted")
+            self._emit("serving_admit", request=req["id"], slot=slot,
+                       prompt_len=len(req["prompt"]), bucket=bucket)
+
+    def _evict(self, slot: int, reason: str) -> Completion:
+        req = self._slots[slot]
+        self._slots[slot] = None
+        self._state = self._state._replace(
+            active=self._state.active.at[slot].set(False),
+            finished=self._state.finished.at[slot].set(False))
+        self._counts["evicted"] += 1
+        metrics.inc("serving/evicted")
+        if reason == "preempted":
+            self._counts["preempted"] += 1
+            metrics.inc("serving/preempted")
+        self._emit("serving_evict", request=req["id"], slot=slot,
+                   reason=reason, tokens=len(req["tokens"]))
+        return Completion(request_id=req["id"], prompt=req["prompt"],
+                          tokens=req["tokens"], finish_reason=reason)
+
+    def preempt(self, request_id: int) -> Optional[Completion]:
+        """Cancel a request (client abort / scheduler decision): evict
+        its slot — or drop it from the queue — and return the partial
+        completion. None when the id is unknown/already finished."""
+        for slot, req in enumerate(self._slots):
+            if req is not None and req["id"] == request_id:
+                return self._evict(slot, "preempted")
+        for i, req in enumerate(self._queue):
+            if req["id"] == request_id:
+                del self._queue[i]
+                self._counts["preempted"] += 1
+                metrics.inc("serving/preempted")
+                self._emit("serving_evict", request=request_id,
+                           slot=-1, reason="preempted", tokens=0)
+                return Completion(request_id=request_id,
+                                  prompt=req["prompt"], tokens=[],
+                                  finish_reason="preempted")
+        return None
+
+    # -- the serving loop ---------------------------------------------
+
+    def step(self) -> List[Completion]:
+        """Admit what fits, tick every occupied slot one token, evict
+        and return whatever finished."""
+        self._admit()
+        reg = metrics.get_registry()
+        if self.occupancy == 0:
+            reg.set_gauge("serving/slot_occupancy", 0)
+            return []
+        t0 = time.time()
+        with reg.timer("serving/decode_tick"):
+            self._cache, self._state, tok = decode_step(
+                self.model, self.params, self._cache, self._state,
+                self._rng, self.gen_cfg)
+            tok = np.asarray(tok)   # device sync inside the timer
+        self._tick_time += time.time() - t0
+        self._ticks += 1
+        finished = np.asarray(self._state.finished)
+        dec_count = np.asarray(self._state.dec_count)
+        done: List[Completion] = []
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            req["tokens"].append(int(tok[slot]))
+            self._decode_tokens += 1
+            if finished[slot]:
+                done.append(self._evict(slot, "eos"))
+            elif dec_count[slot] >= self.gen_cfg.max_dec_len:
+                done.append(self._evict(slot, "length"))
+        reg.set_gauge("serving/slot_occupancy", self.occupancy)
+        return done
+
+    def run(self, prompts: Sequence[Sequence[int]]) -> List[Completion]:
+        """Serve a batch of prompts to completion; completions return
+        in SUBMISSION order (slot/finish order is an implementation
+        detail the caller should not see)."""
+        ids = [self.submit(p) for p in prompts]
+        done: Dict[int, Completion] = {}
+        while self._queue or self.occupancy:
+            for c in self.step():
+                done[c.request_id] = c
+        return [done[i] for i in ids]
+
+    def summary(self) -> dict:
+        """Counters + decode tokens/s for the server's lifetime so far
+        (also emitted to the flight recorder)."""
+        tps = self._decode_tokens / self._tick_time \
+            if self._tick_time > 0 else 0.0
+        s = {"slots": self.num_slots, "occupancy": self.occupancy,
+             "pending": self.pending, "decode_ticks": self._ticks,
+             "decode_tokens": self._decode_tokens,
+             "decode_time_sec": round(self._tick_time, 4),
+             "tokens_per_sec": round(tps, 2), **self._counts}
+        self._emit("serving_summary", **s)
+        return s

@@ -198,6 +198,19 @@ class GPTConfig:
     def head_dim(self) -> int:
         return self.hidden_size // self.num_attention_heads
 
+    @property
+    def cache_capacity(self) -> int:
+        """Decode KV-cache slots per row: ``max_position_embeddings``
+        rounded UP to a multiple of 128 (the TPU lane width and the
+        flash-decode block alignment), so the cache minor dim always
+        tiles and an unaligned ``max_position_embeddings`` can never
+        knock decode off the kernel path via the ``skv % block_kv``
+        rejection in ``ops/pallas/flash_attention.py::flash_decode``.
+        The extra slots are dead weight only: positions are still
+        bounded by ``max_position_embeddings`` (the embedding table
+        size) and causal/validity masking never reads them."""
+        return -(-self.max_position_embeddings // 128) * 128
+
     @classmethod
     def from_config(cls, config) -> "GPTConfig":
         """Build from a parsed YAML tree (Model + Engine sections)."""

@@ -15,11 +15,12 @@ from __future__ import annotations
 import dataclasses
 from functools import partial
 from collections.abc import Mapping
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
+from ...parallel.sharding import with_logical_constraint
 from .config import GPTConfig
 from .processors import (
     hamming_diversity_processor, min_length_processor,
@@ -187,7 +188,12 @@ def generate(model, params, input_ids: jax.Array,
         if attention_mask is not None:
             attention_mask = jnp.repeat(attention_mask, tile, axis=0)
     b, prompt_len = input_ids.shape
-    capacity = cfg.max_position_embeddings
+    # the cache allocates cache_capacity slots (max_position_embeddings
+    # rounded up to a 128 multiple — config.py) so the decode-kernel
+    # tiling never rejects the cache length; the validity map must
+    # cover every allocated slot, while the LENGTH bound below stays
+    # at max_position_embeddings (the position-embedding table size)
+    capacity = cfg.cache_capacity
     compute_dtype = jnp.dtype(cfg.dtype)
     if compute_dtype != jnp.float32:
         # flax casts fp32 params to the compute dtype inside every op,
@@ -197,11 +203,12 @@ def generate(model, params, input_ids: jax.Array,
         params = jax.tree.map(
             lambda p: p.astype(compute_dtype)
             if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
-    if prompt_len + gen_cfg.max_dec_len > capacity:
+    if prompt_len + gen_cfg.max_dec_len > cfg.max_position_embeddings:
         raise ValueError(
             f"prompt ({prompt_len}) + max_dec_len "
             f"({gen_cfg.max_dec_len}) exceeds the cache capacity "
-            f"{capacity} (= max_position_embeddings)")
+            f"(max_position_embeddings "
+            f"{cfg.max_position_embeddings})")
     if attention_mask is None:
         attention_mask = jnp.ones((b, prompt_len), jnp.int32)
     attention_mask = attention_mask.astype(jnp.int32)
@@ -229,6 +236,7 @@ def generate(model, params, input_ids: jax.Array,
         jnp.arange(b)[:, None], input_ids].set(attention_mask > 0)
 
     def sample_token(logits, appeared, step_idx, step_rng):
+        """Pick the next token per row (greedy or filtered sample)."""
         logits = repetition_penalty_processor(
             logits, appeared, gen_cfg.repetition_penalty)
         # step_idx == tokens generated before this sample: EOS stays
@@ -246,6 +254,7 @@ def generate(model, params, input_ids: jax.Array,
         return jax.random.categorical(step_rng, logits, axis=-1)
 
     def body(carry, step_idx):
+        """One greedy/sampling decode step of the scan."""
         cache, logits, appeared, finished, valid = carry
         step_rng = jax.random.fold_in(rng, step_idx)
         token = sample_token(logits, appeared, step_idx, step_rng)
@@ -353,6 +362,7 @@ def _beam_search(model, params, cache, last_logits, base_valid,
     # seeding as the sampling path)
 
     def body(carry, step_idx):
+        """One beam-search expansion step of the scan."""
         (cache, logits, alive, seqs, appeared, fin_scores,
          fin_seqs, valid) = carry
         logits = repetition_penalty_processor(
@@ -453,6 +463,217 @@ def _beam_search(model, params, cache, last_logits, base_valid,
                             gen_cfg.num_return_sequences)
     out = jnp.take_along_axis(all_seqs, best[..., None], axis=1)
     return out.reshape(b0 * gen_cfg.num_return_sequences, dec)
+
+
+# -- continuous-batching slot primitives -------------------------------
+#
+# The lockstep generate() above advances every row at one shared cache
+# index. The serving path (core/serving.py) instead keeps a persistent
+# [slots, ...] KV cache whose rows are independent requests at
+# independent lengths: prefill_into_slots admits new requests into free
+# slot rows (one compiled shape per prompt-length bucket), decode_step
+# advances ALL slots one token with per-slot lengths/sampling state via
+# the ragged attention dispatch (cache_lengths -> flash_decode_ragged
+# or the XLA per-row-offset fallback — docs/inference.md).
+
+
+class SlotState(NamedTuple):
+    """Per-slot decode state carried across serving ticks.
+
+    One row per KV-cache slot; a pytree so the whole state threads
+    through the jitted ``decode_step`` unchanged in structure.
+    """
+    #: [slots] int32 — valid cache positions (the slot's token count)
+    lengths: jax.Array
+    #: [slots] int32 — tokens generated so far (the per-request
+    #: step_idx of the lockstep loop)
+    dec_count: jax.Array
+    #: [slots] int32 — per-request rng stream id (folded into the
+    #: server rng so a request's sample stream is independent of slot
+    #: assignment and neighbours)
+    nonce: jax.Array
+    #: [slots, V] bool — repetition-penalty token set
+    appeared: jax.Array
+    #: [slots] bool — emitted EOS
+    finished: jax.Array
+    #: [slots] bool — slot holds a live request
+    active: jax.Array
+    #: [slots, V] f32 — logits the next tick samples from
+    last_logits: jax.Array
+
+
+def init_slot_state(num_slots: int, vocab_size: int) -> SlotState:
+    """All-free slot state (no request admitted anywhere)."""
+    z = jnp.zeros((num_slots,), jnp.int32)
+    f = jnp.zeros((num_slots,), bool)
+    return SlotState(
+        lengths=z, dec_count=z, nonce=z,
+        appeared=jnp.zeros((num_slots, vocab_size), bool),
+        finished=f, active=f,
+        last_logits=jnp.zeros((num_slots, vocab_size), jnp.float32))
+
+
+def init_slot_cache(model, params, num_slots: int):
+    """Zeroed persistent ``[slots, ...]`` KV-cache tree, shaped by
+    ``jax.eval_shape`` over a cached apply (no compile, no FLOPs)."""
+    shapes = jax.eval_shape(
+        lambda p: model.apply(
+            {"params": p}, jnp.zeros((num_slots, 1), jnp.int32),
+            use_cache=True, deterministic=True,
+            mutable=["cache"])[1]["cache"],
+        params)
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
+def _constrain_slot_cache(cache):
+    """Pin the serving cache's logical layout: slots over the dataflow
+    plane, heads over mp (``cache_slots`` rule in parallel/sharding.py).
+    A no-op without an active mesh/rules context."""
+    def g(path, leaf):
+        name = getattr(path[-1], "key", "")
+        if name in ("cached_key", "cached_value"):
+            axes = (None,) * (leaf.ndim - 4) + (
+                "cache_slots", "act_heads", None, None)
+            return with_logical_constraint(leaf, axes)
+        return leaf
+    return jax.tree_util.tree_map_with_path(g, cache)
+
+
+def _scatter_slot_rows(cache, rows, slot_ids):
+    """Write per-request cache rows (batch = len(slot_ids)) into the
+    persistent slot cache at ``slot_ids``. KV leaves are
+    ``[..., b, h, d, S]`` with the batch axis at ``ndim - 4`` (matching
+    ``_gather_cache``); the scalar ``cache_index`` leaves keep the
+    persistent cache's value — slot lengths live in ``SlotState``."""
+    def put(path, pleaf, rleaf):
+        name = getattr(path[-1], "key", "")
+        if name in ("cached_key", "cached_value"):
+            ax = pleaf.ndim - 4
+            idx = (slice(None),) * ax + (slot_ids,)
+            return pleaf.at[idx].set(rleaf.astype(pleaf.dtype))
+        return pleaf
+    return jax.tree_util.tree_map_with_path(put, cache, rows)
+
+
+@partial(jax.jit, static_argnames=("model",))
+def prefill_into_slots(model, params, cache, state: SlotState,
+                       slot_ids: jax.Array, input_ids: jax.Array,
+                       true_lengths: jax.Array,
+                       nonce: jax.Array):
+    """Admit requests into free slots: prefill + scatter.
+
+    ``input_ids`` is RIGHT-padded ``[n, bucket]`` (prompts start at
+    cache position 0 of their slot; the pad tail past each row's
+    ``true_lengths`` is never read — causality masks it during prefill
+    and the per-slot length masks it during decode, so bucketing
+    prompt lengths to a few compiled shapes costs nothing but the
+    padded prefill FLOPs). Runs the ordinary scalar-cache-index
+    prefill over the ``n`` new requests, gathers each row's
+    last-real-token logits, and scatters the fresh cache rows and
+    sampling state into the persistent ``[slots, ...]`` cache /
+    ``SlotState`` at ``slot_ids``. One compiled shape per
+    ``(n, bucket)`` pair.
+    """
+    n, bucket = input_ids.shape
+    pos = jnp.broadcast_to(
+        jnp.arange(bucket, dtype=jnp.int32)[None, :], (n, bucket))
+    logits, mutated = model.apply(
+        {"params": params}, input_ids, position_ids=pos,
+        use_cache=True, deterministic=True, mutable=["cache"])
+    last = jnp.take_along_axis(
+        logits.astype(jnp.float32),
+        jnp.maximum(true_lengths, 1)[:, None, None] - 1, axis=1)[:, 0]
+    real = pos < true_lengths[:, None]                    # [n, bucket]
+    appeared = jnp.zeros((n, model.config.vocab_size), bool)
+    # scatter-max: True (a real occurrence) wins over the pad tail's
+    # False even when a token id shows up in both regions
+    appeared = appeared.at[jnp.arange(n)[:, None], input_ids].max(real)
+
+    cache = _scatter_slot_rows(cache, mutated["cache"], slot_ids)
+    cache = _constrain_slot_cache(cache)
+    state = SlotState(
+        lengths=state.lengths.at[slot_ids].set(true_lengths),
+        dec_count=state.dec_count.at[slot_ids].set(0),
+        nonce=state.nonce.at[slot_ids].set(nonce),
+        appeared=state.appeared.at[slot_ids].set(appeared),
+        finished=state.finished.at[slot_ids].set(False),
+        active=state.active.at[slot_ids].set(True),
+        last_logits=state.last_logits.at[slot_ids].set(last))
+    return cache, state
+
+
+@partial(jax.jit, static_argnames=("model", "gen_cfg"))
+def decode_step(model, params, cache, state: SlotState,
+                rng: jax.Array, gen_cfg: GenerationConfig):
+    """One shared decode tick over the whole slot batch.
+
+    Mirrors the lockstep ``body`` of :func:`generate` slot-for-slot —
+    sample from ``last_logits`` through the same processor pipeline
+    (repetition penalty over ``appeared``, min-length over the
+    PER-SLOT ``dec_count``), then advance the model one token with
+    per-slot cache writes and ragged attention (``cache_lengths``).
+    Greedy decoding therefore reproduces ``generate()`` exactly,
+    whatever mix of lengths/admission times the slots hold. Inactive
+    (free) slots ride along as pad tokens with frozen lengths; their
+    writes land at their stale position and are overwritten before any
+    later read (prefill rewrites the full row at admission).
+
+    Returns ``(cache, state, tokens)`` — ``tokens [slots]`` is what
+    each slot emitted this tick (pad for finished/inactive slots).
+    """
+    slots = state.lengths.shape[0]
+    logits = repetition_penalty_processor(
+        state.last_logits, state.appeared, gen_cfg.repetition_penalty)
+    logits = min_length_processor(
+        logits, state.dec_count[:, None], gen_cfg.min_dec_len,
+        gen_cfg.eos_token_id)
+    if gen_cfg.decode_strategy == "greedy_search":
+        token = jnp.argmax(logits, axis=-1)
+    elif gen_cfg.decode_strategy == "sampling":
+        logits = logits / jnp.maximum(gen_cfg.temperature, 1e-6)
+        logits = top_k_top_p_filter(logits, gen_cfg.top_k,
+                                    gen_cfg.top_p,
+                                    approx=gen_cfg.approx_top_k)
+        # per-slot streams: (request nonce, request step) fold so a
+        # request samples the same continuation whichever slot it
+        # lands in and whenever it was admitted
+        step_keys = jax.vmap(
+            lambda n, c: jax.random.fold_in(
+                jax.random.fold_in(rng, n), c))(
+            state.nonce, state.dec_count)
+        token = jax.vmap(
+            lambda kk, lg: jax.random.categorical(kk, lg))(
+            step_keys, logits)
+    else:
+        raise ValueError(
+            f"decode_step supports sampling/greedy_search, got "
+            f"{gen_cfg.decode_strategy!r} (beam search stays on the "
+            f"lockstep generate() path)")
+    token = jnp.where(state.finished | ~state.active,
+                      gen_cfg.pad_token_id, token).astype(jnp.int32)
+    finished = state.finished | (
+        state.active & (token == gen_cfg.eos_token_id))
+    appeared = state.appeared.at[jnp.arange(slots), token].set(True)
+
+    step_pos = jnp.clip(state.lengths, 0,
+                        model.config.max_position_embeddings - 1)
+    logits2, mutated = model.apply(
+        {"params": params, "cache": cache}, token[:, None],
+        position_ids=step_pos[:, None], use_cache=True,
+        deterministic=True, cache_lengths=state.lengths,
+        mutable=["cache"])
+    cache = _constrain_slot_cache(mutated["cache"])
+    new_state = SlotState(
+        lengths=jnp.where(state.active, state.lengths + 1,
+                          state.lengths),
+        dec_count=jnp.where(state.active, state.dec_count + 1,
+                            state.dec_count),
+        nonce=state.nonce,
+        appeared=appeared,
+        finished=finished,
+        active=state.active,
+        last_logits=logits2[:, -1].astype(jnp.float32))
+    return cache, new_state, token
 
 
 def left_pad_batch(sequences, pad_id: int):

@@ -175,7 +175,7 @@ class MultiHeadAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, attn_bias=None, use_cache: bool = False,
-                 deterministic: bool = True):
+                 deterministic: bool = True, cache_lengths=None):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         h, nh, hd = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
@@ -214,9 +214,12 @@ class MultiHeadAttention(nn.Module):
         kv_cache_layout = False
         if use_cache:
             # Decode: roll the new keys/values into the preallocated
-            # cache. Capacity is max_position_embeddings; the caller
-            # (generation loop) must bound prompt+decode length by it —
-            # dynamic_update_slice clamps rather than raises on overrun.
+            # cache. Capacity is cache_capacity (max_position_embeddings
+            # rounded up to a 128 multiple so the minor dim always
+            # tiles — config.py); the caller (generation loop / serving
+            # server) must bound prompt+decode length by
+            # max_position_embeddings — dynamic_update_slice clamps
+            # rather than raises on overrun.
             # Layout [b, h, d, S]: the minor tile dims (d, S) =
             # (64, capacity) fill TPU (8,128) tiles exactly. The
             # alternatives both waste 2x HBM to lane padding (any
@@ -225,24 +228,52 @@ class MultiHeadAttention(nn.Module):
             # uncompress copies of the whole stacked cache, which OOMs
             # at batch 64. As a bonus k arrives pre-transposed for the
             # q @ k^T decode matmul.
+            capacity = cfg.cache_capacity
             cache_k = self.variable(
                 "cache", "cached_key", jnp.zeros,
-                (x.shape[0], nh, hd, cfg.max_position_embeddings), dtype)
+                (x.shape[0], nh, hd, capacity), dtype)
             cache_v = self.variable(
                 "cache", "cached_value", jnp.zeros,
-                (x.shape[0], nh, hd, cfg.max_position_embeddings), dtype)
+                (x.shape[0], nh, hd, capacity), dtype)
             cache_index = self.variable(
                 "cache", "cache_index",
                 lambda: jnp.zeros((), jnp.int32))
-            idx = cache_index.value
-            cache_k.value = jax.lax.dynamic_update_slice(
-                cache_k.value, k.transpose(0, 2, 3, 1), (0, 0, 0, idx))
-            cache_v.value = jax.lax.dynamic_update_slice(
-                cache_v.value, v.transpose(0, 2, 3, 1), (0, 0, 0, idx))
+            if cache_lengths is not None:
+                # Ragged slot decode (continuous batching): each batch
+                # row is a server slot advancing at its OWN length, so
+                # the single dynamic_update_slice index cannot serve —
+                # scatter every row's new key/value column at that
+                # row's position and hand the per-row offsets to the
+                # attention dispatch (flash_decode_ragged or the XLA
+                # per-row-offset fallback). cache_index is left
+                # untouched: the slot lengths live with the server's
+                # SlotState, not in the cache collection.
+                if x.shape[1] != 1:
+                    raise ValueError(
+                        "cache_lengths (ragged slot decode) is "
+                        "single-token only; prefill writes at offset 0 "
+                        "through the scalar cache_index path")
+                rows = jnp.arange(x.shape[0])
+                pos = jnp.clip(
+                    jnp.asarray(cache_lengths, jnp.int32), 0,
+                    capacity - 1)
+                cache_k.value = cache_k.value.at[rows, :, :, pos].set(
+                    k.transpose(0, 2, 3, 1)[..., 0])
+                cache_v.value = cache_v.value.at[rows, :, :, pos].set(
+                    v.transpose(0, 2, 3, 1)[..., 0])
+                query_offset = pos                      # [b]
+            else:
+                idx = cache_index.value
+                cache_k.value = jax.lax.dynamic_update_slice(
+                    cache_k.value, k.transpose(0, 2, 3, 1),
+                    (0, 0, 0, idx))
+                cache_v.value = jax.lax.dynamic_update_slice(
+                    cache_v.value, v.transpose(0, 2, 3, 1),
+                    (0, 0, 0, idx))
+                query_offset = idx
+                cache_index.value = idx + x.shape[1]
             k, v = cache_k.value, cache_v.value
             kv_cache_layout = True
-            query_offset = idx
-            cache_index.value = idx + x.shape[1]
 
         dropout_rng = None
         if cfg.attention_probs_dropout_prob > 0.0 and not deterministic:
@@ -327,7 +358,7 @@ class TransformerDecoderLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, attn_bias=None, use_cache: bool = False,
-                 deterministic: bool = True):
+                 deterministic: bool = True, cache_lengths=None):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         pdtype = jnp.dtype(cfg.param_dtype)
@@ -341,7 +372,7 @@ class TransformerDecoderLayer(nn.Module):
         residual = x
         y = ln("norm1")(x)
         y = MultiHeadAttention(cfg, name="self_attn")(
-            y, attn_bias, use_cache, deterministic)
+            y, attn_bias, use_cache, deterministic, cache_lengths)
         y = nn.Dropout(cfg.hidden_dropout_prob, name="dropout1")(
             y, deterministic=deterministic)
         x = residual + y
@@ -427,7 +458,7 @@ class GPTModel(nn.Module):
     @nn.compact
     def __call__(self, input_ids, position_ids=None, attn_bias=None,
                  use_cache: bool = False, deterministic: bool = True,
-                 position_offset=0):
+                 position_offset=0, cache_lengths=None):
         cfg = self.config
         static_offset = position_offset if isinstance(position_offset, int) \
             else 0
@@ -460,14 +491,15 @@ class GPTModel(nn.Module):
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, scanned=True, name="decoder")(
-                x, attn_bias, use_cache, deterministic)
+                x, attn_bias, use_cache, deterministic, cache_lengths)
             moe_aux = aux_stack.sum() if cfg.moe_num_experts else None
         else:
             moe_aux = jnp.zeros((), jnp.float32) \
                 if cfg.moe_num_experts else None
             for i in range(cfg.num_layers):
                 x = block(cfg, name=f"decoder_{i}")(
-                    x, attn_bias, use_cache, deterministic)
+                    x, attn_bias, use_cache, deterministic,
+                    cache_lengths)
                 if cfg.moe_num_experts:
                     x, aux = x
                     moe_aux = moe_aux + aux
@@ -506,10 +538,10 @@ class GPTForPretraining(nn.Module):
     @nn.compact
     def __call__(self, input_ids, position_ids=None, attn_bias=None,
                  use_cache: bool = False, deterministic: bool = True,
-                 position_offset=0):
+                 position_offset=0, cache_lengths=None):
         x = GPTModel(self.config, name="gpt")(
             input_ids, position_ids, attn_bias, use_cache, deterministic,
-            position_offset)
+            position_offset, cache_lengths)
         word_emb = _word_embedding(
             self.variables["params"]["gpt"]["embeddings"])
         return tied_logits(x, word_emb)

@@ -139,8 +139,17 @@ def _xla_attention(q, k, v, bias, causal, query_offset, dropout_rate,
     if causal:
         sq, sk = scores.shape[-2], scores.shape[-1]
         # query i attends to keys <= i + query_offset (offset > 0 during
-        # cached decode where keys include the past)
-        q_pos = jnp.arange(sq)[:, None] + query_offset
+        # cached decode where keys include the past). A [b] offset
+        # vector masks PER ROW — the XLA oracle/fallback for the
+        # ragged slot decode (flash_decode_ragged): row i's mask
+        # broadcasts as [b, 1, sq, sk] against the [b, h, sq, sk]
+        # scores, so each slot sees exactly its own cache prefix.
+        off = jnp.asarray(query_offset)
+        if off.ndim == 1:
+            q_pos = (jnp.arange(sq)[:, None]
+                     + off[:, None, None, None])   # [b, 1, sq, 1]
+        else:
+            q_pos = jnp.arange(sq)[:, None] + off  # [sq, 1]
         k_pos = jnp.arange(sk)[None, :]
         scores = jnp.where(k_pos <= q_pos, scores, NEG_INF)
     if bias is not None:
@@ -216,6 +225,15 @@ def dot_product_attention(
         try:
             from .pallas import flash_attention as fa
             if decode_bias_ok and kv_cache_layout:
+                if getattr(query_offset, "ndim", 0) == 1:
+                    # ragged slot decode: a [b] offset vector (the
+                    # continuous-batching server's per-slot lengths) —
+                    # each row masks and block-skips against its OWN
+                    # last valid position
+                    out = fa.flash_decode_ragged(q, k, v, query_offset,
+                                                 bias=bias)
+                    metrics.inc("attention/flash_decode_ragged")
+                    return out
                 # cached decode: single query token, dynamic cache
                 # index — the kernel skips blocks past the index
                 out = fa.flash_decode(q, k, v, query_offset,

@@ -1053,7 +1053,7 @@ def flash_attention_with_lse(q, k, v, causal: bool = True,
 
 
 def _decode_kernel(off_ref, q_ref, k_ref, v_ref, *refs, sm_scale,
-                   block_kv, num_kv, has_bias):
+                   block_kv, num_kv, has_bias, ragged=False):
     """Single-token decode over the fixed-capacity KV cache.
 
     Decode attention is a matvec, not a matmul — per (head, key-block)
@@ -1071,6 +1071,11 @@ def _decode_kernel(off_ref, q_ref, k_ref, v_ref, *refs, sm_scale,
     have actually filled — and the straddling block is masked. With
     ``has_bias`` a per-key additive bias tile rides along (the
     generation loop's left-pad mask).
+
+    ``ragged``: the prefetched offsets are PER ROW (``[b]``, the
+    continuous-batching slot lengths) instead of one shared scalar —
+    each batch row masks and block-skips against its OWN last valid
+    position, so a short slot never pays a long slot's cache walk.
     """
     if has_bias:
         bias_ref, o_ref, m_scr, l_scr, acc_scr = refs
@@ -1078,7 +1083,8 @@ def _decode_kernel(off_ref, q_ref, k_ref, v_ref, *refs, sm_scale,
         bias_ref = None
         o_ref, m_scr, l_scr, acc_scr = refs
     ki = pl.program_id(1)
-    offset = off_ref[0]            # last valid key position
+    # last valid key position: shared (lockstep decode) or this row's
+    offset = off_ref[pl.program_id(0)] if ragged else off_ref[0]
 
     @pl.when(ki == 0)
     def _init():
@@ -1117,27 +1123,21 @@ def _decode_kernel(off_ref, q_ref, k_ref, v_ref, *refs, sm_scale,
             o_ref.dtype)
 
 
-def flash_decode(q, k, v, query_offset, bias=None,
-                 block_kv: int = DEFAULT_BLOCK_KV):
-    """One decode step through the cache: ``q [b, 1, h, d]`` attends to
-    ``k/v [b, h, d, S]`` positions ``<= query_offset`` (a traced
-    scalar — the fixed-capacity cache index of ``models/gpt/model.py``).
-
-    Inference-only (no VJP). Raises NotImplementedError when the
-    shape/backend can't take the kernel; the caller falls back to the
-    XLA path. The cache arrives in its NATIVE ``[b, h, d, S]`` layout
-    — minor tile dims (d, S) fill TPU (8,128) tiles exactly (zero
-    padding; any d=64-minor layout wastes 2x HBM). One program per
-    (batch, key-block) streams every head's ``[d, bkv]`` tiles and
-    runs the matvec attention on the VPU (see ``_decode_kernel``).
-    """
+def _flash_decode_call(q, k, v, off, bias, block_kv: int, ragged: bool):
+    """Shared shape-check + ``pallas_call`` builder behind
+    :func:`flash_decode` (``off [1]``, one shared cache index) and
+    :func:`flash_decode_ragged` (``off [b]``, per-slot lengths). Raises
+    NotImplementedError where the caller must fall back to XLA."""
     if jax.default_backend() != "tpu" and not _interpret():
         raise NotImplementedError("flash kernel targets TPU")
     b, sq, h, d = q.shape
     if sq != 1:
         raise NotImplementedError("flash_decode is single-token only")
     skv = k.shape[3]
-    block_kv = min(block_kv, skv)
+    # largest 128-aligned divisor <= block_kv: capacities that are
+    # 128-multiples but not block_kv-multiples (e.g. 1280) stay on the
+    # kernel instead of tripping the skv % block_kv rejection below
+    block_kv = _auto_block(skv, block_kv, 128)
     # all heads ride in one block, so k/v blocks are h-times larger
     # than a per-head grid's: shrink block_kv until double-buffered
     # k+v blocks fit comfortably in the ~16M VMEM (a Mosaic
@@ -1158,24 +1158,26 @@ def flash_decode(q, k, v, query_offset, bias=None,
     # [b, 1, h, d] -> [b, h, d, 1]: the query token as a lane-1
     # column per head, matching the cache's d-major tiles
     qp = q.transpose(0, 2, 3, 1)
-    off = jnp.reshape(jnp.asarray(query_offset, jnp.int32), (1,))
 
     # clamp the kv block index once past the live length: skipped
     # iterations re-reference the already-resident block, so the
     # HBM->VMEM copy is elided and a short prefix pays only for the
     # cache it has actually filled (the compute skip alone would
-    # still stream the full capacity)
-    def kv_block(ki, off):
-        return jnp.minimum(ki, off[0] // block_kv)
+    # still stream the full capacity). Ragged, each ROW clamps
+    # against its own length — the per-slot cost model of the
+    # continuous-batching server.
+    def kv_block(bi, ki, off):
+        row = off[bi] if ragged else off[0]
+        return jnp.minimum(ki, row // block_kv)
 
     in_specs = [
         pl.BlockSpec((1, h, d, 1), lambda bi, ki, off: (bi, 0, 0, 0)),
         pl.BlockSpec((1, h, d, block_kv),
                      lambda bi, ki, off: (bi, 0, 0,
-                                          kv_block(ki, off))),
+                                          kv_block(bi, ki, off))),
         pl.BlockSpec((1, h, d, block_kv),
                      lambda bi, ki, off: (bi, 0, 0,
-                                          kv_block(ki, off))),
+                                          kv_block(bi, ki, off))),
     ]
     operands = [qp, k, v]
     if bias is not None:
@@ -1186,11 +1188,12 @@ def flash_decode(q, k, v, query_offset, bias=None,
                                     (b, 1, skv)))
         in_specs.append(pl.BlockSpec(
             (1, 1, block_kv),
-            lambda bi, ki, off: (bi, 0, kv_block(ki, off))))
+            lambda bi, ki, off: (bi, 0, kv_block(bi, ki, off))))
 
     kernel = functools.partial(_decode_kernel, sm_scale=d ** -0.5,
                                block_kv=block_kv, num_kv=num_kv,
-                               has_bias=bias is not None)
+                               has_bias=bias is not None,
+                               ragged=ragged)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -1210,3 +1213,47 @@ def flash_decode(q, k, v, query_offset, bias=None,
     )(off, *operands)
     # [b, h, d, 1] -> [b, 1, h, d]
     return out.transpose(0, 3, 1, 2)
+
+
+def flash_decode(q, k, v, query_offset, bias=None,
+                 block_kv: int = DEFAULT_BLOCK_KV):
+    """One decode step through the cache: ``q [b, 1, h, d]`` attends to
+    ``k/v [b, h, d, S]`` positions ``<= query_offset`` (a traced
+    scalar — the fixed-capacity cache index of ``models/gpt/model.py``).
+
+    Inference-only (no VJP). Raises NotImplementedError when the
+    shape/backend can't take the kernel; the caller falls back to the
+    XLA path. The cache arrives in its NATIVE ``[b, h, d, S]`` layout
+    — minor tile dims (d, S) fill TPU (8,128) tiles exactly (zero
+    padding; any d=64-minor layout wastes 2x HBM). One program per
+    (batch, key-block) streams every head's ``[d, bkv]`` tiles and
+    runs the matvec attention on the VPU (see ``_decode_kernel``).
+    """
+    off = jnp.reshape(jnp.asarray(query_offset, jnp.int32), (1,))
+    return _flash_decode_call(q, k, v, off, bias, block_kv,
+                              ragged=False)
+
+
+def flash_decode_ragged(q, k, v, query_offsets, bias=None,
+                        block_kv: int = DEFAULT_BLOCK_KV):
+    """Per-row decode through the cache: row ``i`` of ``q [b, 1, h, d]``
+    attends to ``k/v [b, h, d, S]`` positions ``<= query_offsets[i]``
+    (a traced ``[b]`` int vector — the continuous-batching server's
+    per-slot cache lengths minus one, i.e. each slot's just-written
+    position).
+
+    Same kernel body and layout contract as :func:`flash_decode`; the
+    offsets prefetch as a ``[b]`` scalar operand so both the in-kernel
+    masking and the block-skip index maps read the PER-ROW length —
+    a freshly admitted slot walks only its own short cache while a
+    long-running neighbour streams its full one. Inference-only;
+    raises NotImplementedError where the caller must fall back to the
+    XLA per-row-offset path (``ops/attention.py::_xla_attention``).
+    """
+    b = q.shape[0]
+    offs = jnp.asarray(query_offsets, jnp.int32)
+    if offs.ndim != 1 or offs.shape[0] != b:
+        raise NotImplementedError(
+            f"ragged offsets must be [b={b}], got {offs.shape}")
+    return _flash_decode_call(q, k, v, offs, bias, block_kv,
+                              ragged=True)

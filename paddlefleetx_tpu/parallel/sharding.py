@@ -126,6 +126,12 @@ def make_sharding_rules(topo: TopologyConfig) -> Rules:
         # the reshape+transpose to the ep-sharded [E, b, C, h] layout
         # under "act_expert" is where GSPMD places the all-to-all
         ("act_expert_slot", None),
+        # slot axis of the serving KV cache ([slots, heads, d, S],
+        # core/serving.py): slots are the decode batch, so they ride
+        # the dataflow plane like "batch" while mp stays over the
+        # cache's heads dim ("act_heads") — a slot server under mp
+        # shards every slot's cache by head, never by slot content
+        ("cache_slots", DATA_AXES),
     )
 
 

@@ -582,6 +582,49 @@ def test_bench_moe_runs_offline(capsys):
     assert rec["mfu_active_flops"] is None
 
 
+def test_bench_serving_runs_offline(capsys):
+    """The continuous-batching bench's tiny CPU path must execute end
+    to end and emit a finite decode-tokens/s record with the pinned
+    metric grammar (same record shape the on-chip 345M run emits)."""
+    bench.bench_serving()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == bench.METRIC_BY_MODE["serving"]
+    assert rec["metric"] == \
+        "gpt345m_serving_decode_tokens_per_sec_per_chip"
+    assert rec["value"] > 0 and rec["unit"] == "tokens/s"
+    assert rec["vs_baseline"] is None  # the reference has no serving
+    # trace-shape fields ride in the record so a number is never
+    # detached from the workload that produced it
+    assert rec["requests"] == 6 and rec["slots"] == 2
+    assert rec["prompt_len_range"] == [4, 24]
+    assert rec["max_dec_len"] == 12 and rec["seed"] == 0
+    assert 0 < rec["decode_ticks"] <= rec["requests"] * rec["max_dec_len"]
+
+
+def test_bench_serving_env_knobs_pin_trace(monkeypatch, capsys):
+    """PFX_BENCH_SERVING_* knobs override the trace shape and are
+    echoed back in the record (the perf-CI driver pins runs by these;
+    mirrors the bench_moe PFX_BENCH_MOE_DISPATCH convention)."""
+    monkeypatch.setenv("PFX_BENCH_SERVING_REQUESTS", "3")
+    monkeypatch.setenv("PFX_BENCH_SERVING_SLOTS", "1")
+    monkeypatch.setenv("PFX_BENCH_SERVING_SEED", "7")
+    monkeypatch.setenv("PFX_BENCH_SERVING_MIN_PROMPT", "4")
+    monkeypatch.setenv("PFX_BENCH_SERVING_MAX_PROMPT", "6")
+    monkeypatch.setenv("PFX_BENCH_SERVING_DEC_LEN", "5")
+    bench.bench_serving()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["requests"] == 3 and rec["slots"] == 1
+    assert rec["prompt_len_range"] == [4, 6]
+    assert rec["max_dec_len"] == 5 and rec["seed"] == 7
+    assert 0 < rec["decode_ticks"] <= 15
+    first_ticks = rec["decode_ticks"]
+    # same knobs -> same trace: the run is deterministic end to end
+    bench.bench_serving()
+    rec2 = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec2["decode_ticks"] == first_ticks
+
+
 # -- observability wiring (flight recorder, probe stderr tails) --------
 
 

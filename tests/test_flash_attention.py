@@ -342,6 +342,129 @@ def test_decode_with_leftpad_bias_matches_xla():
                                atol=2e-6, rtol=2e-6)
 
 
+def _decode_batch(b=4, S=256, h=2, d=64, seed=11):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, 1, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h, d, S)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h, d, S)), jnp.float32)
+    return q, k, v
+
+
+def test_flash_decode_ragged_matches_xla_per_row():
+    """flash_decode_ragged with per-row cache lengths == the XLA
+    per-row-offset oracle, and garbage past EACH row's length never
+    leaks (the continuous-batching invariant: a fresh slot shares the
+    tick with deep slots whose cache tails it must not read)."""
+    from paddlefleetx_tpu.ops.pallas.flash_attention import (
+        flash_decode, flash_decode_ragged,
+    )
+    q, k, v = _decode_batch()
+    offs = jnp.asarray([0, 5, 130, 255], jnp.int32)
+    ref = _xla_attention(q, k, v, None, True, offs, 0.0, None, True,
+                         True, kv_cache_layout=True)
+    got = flash_decode_ragged(q, k, v, offs, block_kv=128)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-6, rtol=2e-6)
+    # garbage independence per row
+    mask = np.arange(256)[None, :] > np.asarray(offs)[:, None]
+    k2 = jnp.where(jnp.asarray(mask)[:, None, None, :], 1e3, k)
+    v2 = jnp.where(jnp.asarray(mask)[:, None, None, :], -1e3, v)
+    got2 = flash_decode_ragged(q, k2, v2, offs, block_kv=128)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(got),
+                               atol=2e-6, rtol=2e-6)
+    # all-equal lengths degenerate to the scalar kernel exactly
+    uni = jnp.full((4,), 130, jnp.int32)
+    np.testing.assert_allclose(
+        np.asarray(flash_decode_ragged(q, k, v, uni, block_kv=128)),
+        np.asarray(flash_decode(q, k, v, jnp.int32(130),
+                                block_kv=128)),
+        atol=2e-6, rtol=2e-6)
+
+
+def test_flash_decode_ragged_under_jit_with_traced_offsets():
+    """One compiled tick serves any slot-length vector (the serving
+    decode loop retraces nothing as slots churn)."""
+    from paddlefleetx_tpu.ops.pallas.flash_attention import (
+        flash_decode_ragged,
+    )
+    q, k, v = _decode_batch(b=2, S=128, seed=12)
+
+    @jax.jit
+    def step(offs):
+        return flash_decode_ragged(q, k, v, offs)
+
+    for offs in ([3, 100], [127, 0]):
+        offs = jnp.asarray(offs, jnp.int32)
+        ref = _xla_attention(q, k, v, None, True, offs, 0.0, None,
+                             True, True, kv_cache_layout=True)
+        np.testing.assert_allclose(np.asarray(step(offs)),
+                                   np.asarray(ref),
+                                   atol=2e-6, rtol=2e-6)
+
+
+def test_flash_decode_ragged_rejects_bad_offset_shapes():
+    from paddlefleetx_tpu.ops.pallas.flash_attention import (
+        flash_decode_ragged,
+    )
+    q, k, v = _decode_batch(b=2, S=128, seed=13)
+    with pytest.raises(NotImplementedError):
+        flash_decode_ragged(q, k, v, jnp.zeros((3,), jnp.int32))
+    with pytest.raises(NotImplementedError):
+        flash_decode_ragged(q, k, v, jnp.zeros((2, 2), jnp.int32))
+
+
+def test_ragged_decode_dispatch_and_counter():
+    """dot_product_attention routes a [b] query_offset to the ragged
+    kernel (counter `attention/flash_decode_ragged`), falls back to
+    the identically-masked dense path on kernel-rejected shapes, and
+    honors the [b,1,1,S] left-pad bias — the docs/inference.md decode
+    dispatch matrix rows for ragged offsets."""
+    from paddlefleetx_tpu.observability import metrics
+    from paddlefleetx_tpu.ops.attention import dot_product_attention
+    q, k, v = _decode_batch(b=2, S=256, seed=14)
+    offs = jnp.asarray([17, 200], jnp.int32)
+    reg = metrics.get_registry()
+    metrics.set_enabled(True)
+    reg.reset()
+    try:
+        out = dot_product_attention(q, k, v, causal=True,
+                                    query_offset=offs, use_flash=True,
+                                    kv_cache_layout=True)
+        assert reg.counter("attention/flash_decode_ragged") == 1
+        assert reg.counter("attention/dense") == 0
+        ref = _xla_attention(q, k, v, None, True, offs, 0.0, None,
+                             True, True, kv_cache_layout=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-6, rtol=2e-6)
+        # left-pad bias rides along (row 1 pads its first 120 slots)
+        valid = np.ones((2, 256), bool)
+        valid[1, :120] = False
+        bias = jnp.where(jnp.asarray(valid), 0.0, -1e9)[:, None, None, :]
+        outb = dot_product_attention(q, k, v, bias=bias, causal=True,
+                                     query_offset=offs, use_flash=True,
+                                     kv_cache_layout=True)
+        refb = _xla_attention(q, k, v, bias, True, offs, 0.0, None,
+                              True, True, kv_cache_layout=True)
+        np.testing.assert_allclose(np.asarray(outb), np.asarray(refb),
+                                   atol=2e-6, rtol=2e-6)
+        # head_dim the kernel rejects -> dense fallback, same per-row
+        # masking
+        reg.reset()
+        q2, k2, v2 = q[..., :44], k[:, :, :44, :], v[:, :, :44, :]
+        out2 = dot_product_attention(q2, k2, v2, causal=True,
+                                     query_offset=offs, use_flash=True,
+                                     kv_cache_layout=True)
+        assert reg.counter("attention/fallback/kernel_rejected") == 1
+        assert reg.counter("attention/dense") == 1
+        ref2 = _xla_attention(q2, k2, v2, None, True, offs, 0.0, None,
+                              True, True, kv_cache_layout=True)
+        np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2),
+                                   atol=2e-6, rtol=2e-6)
+    finally:
+        metrics.set_enabled(False)
+        reg.reset()
+
+
 def test_kernel_dropout_gate_and_fallback(monkeypatch):
     """The in-kernel dropout dispatch (PFX_FLASH_DROPOUT=1) must fall
     back to the XLA dense path on CPU (prng has no interpret

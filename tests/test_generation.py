@@ -571,3 +571,103 @@ def test_beam_search_processed_score_semantics_k_gt_1(model_and_params):
     assert (raw[:, 0] < raw[:, 1] - 1e-6).any(), (
         "raw and processed orders coincide for every prompt — the "
         "test lost its discriminating power; change the seed", raw)
+
+
+def test_cache_capacity_rounds_up_to_128(model_and_params):
+    """`GPTConfig.cache_capacity` = max_position_embeddings rounded UP
+    to a multiple of 128 (TPU lane width / flash-decode block
+    alignment), and the cache the model ALLOCATES uses it — an
+    unaligned max_position_embeddings can never knock decode off the
+    kernel path via the `skv % block_kv` rejection."""
+    assert CFG.max_position_embeddings == 48
+    assert CFG.cache_capacity == 128
+    mk = lambda mpe: GPTConfig(vocab_size=96, hidden_size=32,
+                               num_layers=2, num_attention_heads=4,
+                               max_position_embeddings=mpe)
+    assert mk(128).cache_capacity == 128
+    assert mk(129).cache_capacity == 256
+    assert mk(1024).cache_capacity == 1024
+    # the allocated cache's minor dim is the rounded capacity
+    model, params = model_and_params
+    _, mods = model.apply({"params": params},
+                          jnp.zeros((1, 4), jnp.int32),
+                          use_cache=True, mutable=["cache"])
+    leaves = [l for l in jax.tree.leaves(mods["cache"]) if l.ndim >= 4]
+    assert leaves and all(l.shape[-1] == 128 for l in leaves)
+
+
+def test_beam_gather_cache_reorders_under_mp_mesh(model_and_params):
+    """Beam search's `_gather_cache` batch reordering must commute
+    with an mp mesh whose cache leaves are sharded over heads (the
+    `act_heads` plane): the gathered sharded cache equals the gathered
+    replicated cache leaf-for-leaf."""
+    import flax.linen as nn
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddlefleetx_tpu.models.gpt.generation import _gather_cache
+    from paddlefleetx_tpu.parallel import (
+        TopologyConfig, build_mesh, make_sharding_rules,
+    )
+    from paddlefleetx_tpu.parallel.mesh import MP_AXIS
+
+    model, params = model_and_params
+    ids = jnp.asarray(
+        np.random.default_rng(9).integers(0, 90, (4, 6)), jnp.int32)
+    _, mods = model.apply({"params": params}, ids, use_cache=True,
+                          mutable=["cache"])
+    cache = mods["cache"]
+    gidx = jnp.asarray([2, 0, 3, 1], jnp.int32)
+    want = jax.tree.map(lambda l: np.asarray(l),
+                        _gather_cache(cache, gidx))
+
+    topo = TopologyConfig(mp_degree=4, dp_degree=2)
+    mesh = build_mesh(topo)
+    rules = make_sharding_rules(topo)
+
+    def _shard(leaf):
+        if leaf.ndim >= 4:     # [b, h, d, S] KV: heads over mp
+            spec = P(*([None] * (leaf.ndim - 4)), None, MP_AXIS)
+        else:
+            spec = P()
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    cache_s = jax.tree.map(_shard, cache)
+    with mesh, nn.logical_axis_rules(list(rules)):
+        got = jax.jit(_gather_cache)(cache_s, gidx)
+    jax.tree.map(
+        lambda w, g: np.testing.assert_array_equal(w, np.asarray(g)),
+        want, got)
+
+
+def test_beam_search_tp4_matches_single_device(model_and_params):
+    """End-to-end: beam search under an mp4 mesh (sharded params AND
+    the per-step `_gather_cache` reorder over the sharded cache)
+    returns exactly the single-device hypotheses."""
+    import flax.linen as nn
+
+    from paddlefleetx_tpu.parallel import (
+        TopologyConfig, build_mesh, make_sharding_rules,
+    )
+
+    model, params = model_and_params
+    prompt = jnp.asarray(
+        np.random.default_rng(8).integers(0, 90, (2, 5)), jnp.int32)
+    gen_cfg = GenerationConfig(
+        max_dec_len=4, decode_strategy="beam_search", num_beams=3,
+        num_return_sequences=2, eos_token_id=EOS, pad_token_id=PAD)
+    single = np.asarray(generate(model, params, prompt, None,
+                                 jax.random.key(2), gen_cfg))
+
+    topo = TopologyConfig(mp_degree=4, dp_degree=2)
+    mesh = build_mesh(topo)
+    rules = make_sharding_rules(topo)
+    logical = nn.get_partition_spec(
+        jax.eval_shape(model.init, {"params": jax.random.key(0)},
+                       jnp.zeros((1, 8), jnp.int32)))
+    shardings = nn.logical_to_mesh_sharding(logical, mesh, list(rules))
+    params_s = jax.device_put({"params": params},
+                              nn.meta.unbox(shardings))["params"]
+    with mesh, nn.logical_axis_rules(list(rules)):
+        dist = np.asarray(generate(model, params_s, prompt, None,
+                                   jax.random.key(2), gen_cfg))
+    np.testing.assert_array_equal(dist, single)
