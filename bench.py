@@ -1101,25 +1101,39 @@ def bench_serving():
     counterpart). The trace is pinned by env knobs so runs are
     reproducible and the harness test can pin the grammar:
     ``PFX_BENCH_SERVING_REQUESTS`` / ``_SLOTS`` / ``_SEED`` /
-    ``_MIN_PROMPT`` / ``_MAX_PROMPT`` / ``_DEC_LEN``.
+    ``_MIN_PROMPT`` / ``_MAX_PROMPT`` / ``_DEC_LEN``, plus the paged
+    KV-cache knobs ``PFX_BENCH_SERVING_PAGED`` / ``_PAGE_SIZE`` /
+    ``_POOL_PAGES``.
+
+    On TPU the server runs paged by default at 2x the contiguous slot
+    count with the page pool sized to the SAME KV HBM budget the old
+    8-slot contiguous cache used — the density win prefix sharing and
+    on-demand page growth buy (requests rarely use their full
+    ``cache_capacity`` worst case).
 
     The metric is decode-tick tokens/s (prefill/admission excluded):
     the whole trace runs once to compile every prefill bucket + the
     tick, then a second identical pass is measured via the server's
-    own decode-time accounting."""
+    own decode-time accounting. The record also reports p50/p99
+    time-to-first-token over the trace (admission + prefill queueing
+    included — the latency continuous batching trades against)."""
     from paddlefleetx_tpu.core.serving import GenerationServer
     from paddlefleetx_tpu.models.gpt.generation import GenerationConfig
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu:
         cfg = _gpt345m(True)
-        d_req, d_slots, d_min, d_max, d_dec = 32, 8, 16, 384, 128
+        # Paged default: 2x the PR-5 contiguous slot count, pool
+        # pinned to the 8-slot contiguous KV HBM budget.
+        d_req, d_slots, d_min, d_max, d_dec = 32, 16, 16, 384, 128
+        d_paged, d_page, d_contig_slots = 1, 128, 8
     else:  # offline smoke: the machinery, not the 345M numbers
         cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
                         num_attention_heads=4,
-                        max_position_embeddings=64,
+                        max_position_embeddings=128,  # >= one KV page
                         hidden_dropout_prob=0.0,
                         attention_probs_dropout_prob=0.0)
         d_req, d_slots, d_min, d_max, d_dec = 6, 2, 4, 24, 12
+        d_paged, d_page, d_contig_slots = 1, 128, 2
     n_requests = int(os.environ.get("PFX_BENCH_SERVING_REQUESTS",
                                     d_req))
     num_slots = int(os.environ.get("PFX_BENCH_SERVING_SLOTS", d_slots))
@@ -1127,6 +1141,16 @@ def bench_serving():
     min_p = int(os.environ.get("PFX_BENCH_SERVING_MIN_PROMPT", d_min))
     max_p = int(os.environ.get("PFX_BENCH_SERVING_MAX_PROMPT", d_max))
     dec_len = int(os.environ.get("PFX_BENCH_SERVING_DEC_LEN", d_dec))
+    paged = bool(int(os.environ.get("PFX_BENCH_SERVING_PAGED",
+                                    d_paged)))
+    page_size = int(os.environ.get("PFX_BENCH_SERVING_PAGE_SIZE",
+                                   d_page))
+    # Same-HBM pool: the pages the PR-5 contiguous server would have
+    # committed up front for d_contig_slots full-capacity caches.
+    cap_pages = -(-cfg.cache_capacity // page_size)
+    d_pool = d_contig_slots * cap_pages + 1
+    pool_pages = int(os.environ.get("PFX_BENCH_SERVING_POOL_PAGES",
+                                    d_pool))
     model = GPTForPretraining(cfg)
     rng = np.random.default_rng(seed)
     lengths = rng.integers(min_p, max_p + 1, n_requests)
@@ -1139,9 +1163,15 @@ def bench_serving():
         max_dec_len=dec_len, decode_strategy="sampling", top_k=50,
         top_p=0.75, eos_token_id=cfg.vocab_size - 1,
         pad_token_id=cfg.vocab_size - 1)
+    paged_kw = {}
+    if paged:
+        paged_kw = dict(page_size=page_size, pool_pages=pool_pages,
+                        prefill_chunk_pages=2 if cap_pages % 2 == 0
+                        else 1)
     srv = GenerationServer(model, params, gen_cfg,
                            num_slots=num_slots,
-                           rng=jax.random.key(seed + 1))
+                           rng=jax.random.key(seed + 1),
+                           **paged_kw)
     srv.run(prompts)  # warm pass: compiles every bucket + the tick
     warm = srv.summary()
     srv.run(prompts)
@@ -1160,6 +1190,11 @@ def bench_serving():
         "max_dec_len": dec_len,
         "seed": seed,
         "decode_ticks": total["decode_ticks"] - warm["decode_ticks"],
+        "paged": paged,
+        "page_size": page_size if paged else 0,
+        "pool_pages": pool_pages if paged else 0,
+        "ttft_p50_ms": total.get("ttft_p50_ms", 0.0),
+        "ttft_p99_ms": total.get("ttft_p99_ms", 0.0),
     }
     _log_success(result)
     print(json.dumps(result))

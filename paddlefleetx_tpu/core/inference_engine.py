@@ -158,7 +158,10 @@ class InferenceEngine:
         serving.GenerationServer` over a live model (slot-managed KV
         cache + ragged flash decode) — the serving counterpart of the
         artifact-driven ``predict`` path. Extra ``kwargs`` pass through
-        to the server (``prefill_buckets``, ``rng``, ``events_path``)."""
+        to the server (``prefill_buckets``, ``rng``, ``events_path``,
+        and the paged-KV knobs ``page_size`` / ``pool_pages`` /
+        ``prefill_chunk_pages`` / ``prefix_sharing`` —
+        docs/inference.md, "Paged KV cache")."""
         from .serving import GenerationServer
         return GenerationServer(model, params, gen_cfg,
                                 num_slots=num_slots, **kwargs)

@@ -18,15 +18,38 @@ Slot-for-slot parity: greedy completions match the lockstep
 ``generate()`` exactly, whatever the admission order or prompt-length
 mix (pinned by tests/test_serving.py's parity matrix).
 
-Telemetry (docs/observability.md): ``serving/slot_occupancy`` gauge,
-``serving/admitted`` / ``serving/evicted`` / ``serving/preempted``
-counters, a ``serving/decode_tick`` timer, and a tokens/s summary; an
-optional flight recorder mirrors admissions/evictions to an
+Paged mode (``page_size``/``pool_pages``, or a config with
+``kv_page_size``/``kv_pool_pages`` set): instead of one contiguous
+``cache_capacity`` row per slot, the KV store is a global pool of
+fixed-size pages reached through a slot->page table
+(``core/paging.py``), which buys three things at once:
+
+- **Density** — a slot holds only the pages its tokens actually fill,
+  so a pool sized well below ``slots * capacity`` serves the same slot
+  count (the 2-4x-slots-per-HBM headline; pool exhaustion preempts the
+  youngest slot back to the queue head instead of OOMing).
+- **Prefix sharing** — full prompt pages are content-addressed
+  (chain hash), so requests sharing a system prompt prefill it once
+  and map the same physical pages; an IDENTICAL prompt admits with
+  zero prefill through the whole-prompt registry. Shared pages split
+  copy-on-write at the first divergent decode write.
+- **Chunked prefill** — long admissions run as page-aligned chunks,
+  at most one per ``step()``, interleaved with decode ticks
+  (``prefill_chunk_paged``), so admitting a long prompt never stalls
+  tokens/s for running slots.
+
+Telemetry (docs/observability.md): ``serving/slot_occupancy`` and
+``serving/pages_in_use`` gauges, ``serving/admitted`` /
+``serving/evicted`` / ``serving/preempted`` / ``serving/prefix_hits``
+/ ``serving/cow_splits`` / ``serving/prefill_chunks`` counters, a
+``serving/decode_tick`` timer, and a tokens/s + TTFT p50/p99 summary;
+an optional flight recorder mirrors admissions/evictions to an
 ``events.jsonl`` stream CI's failure-diagnostics artifact collects.
 """
 
 from __future__ import annotations
 
+import dataclasses as _dc
 import time
 from collections import deque
 from dataclasses import dataclass
@@ -37,12 +60,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.gpt.generation import (
-    GenerationConfig, _unrolled_twin, decode_step, init_slot_cache,
-    init_slot_state, prefill_into_slots,
+    GenerationConfig, _unrolled_twin, activate_slot, copy_kv_pages,
+    decode_step, init_page_pool, init_slot_cache, init_slot_state,
+    prefill_chunk_paged, prefill_into_slots,
 )
 from ..observability import metrics
 from ..observability.recorder import FlightRecorder
 from ..utils.log import logger
+from .paging import (
+    NULL_PAGE, PageAllocator, PagePoolExhausted, page_prefix_keys,
+    prompt_key,
+)
 
 
 def default_prefill_buckets(max_prompt_len: int) -> Tuple[int, ...]:
@@ -84,7 +112,11 @@ class GenerationServer:
                  num_slots: int = 4,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  rng: Optional[jax.Array] = None,
-                 events_path: Optional[str] = None):
+                 events_path: Optional[str] = None,
+                 page_size: Optional[int] = None,
+                 pool_pages: Optional[int] = None,
+                 prefill_chunk_pages: int = 2,
+                 prefix_sharing: bool = True):
         if gen_cfg.decode_strategy == "beam_search":
             raise ValueError(
                 "GenerationServer serves sampling/greedy_search; beam "
@@ -94,6 +126,52 @@ class GenerationServer:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         model, params = _unrolled_twin(model, params)
         cfg = model.config
+        # paged mode: explicit kwargs win, else the config's own
+        # kv_page_size/kv_pool_pages turn it on; either way the model
+        # is rebuilt on a twin config that carries the final values (a
+        # pure dispatch change — parameters are untouched) and
+        # GPTConfig.__post_init__ validates the composition
+        self.paged = bool(page_size or pool_pages or cfg.kv_page_size)
+        if self.paged:
+            page_size = int(page_size or cfg.kv_page_size)
+            if not pool_pages:
+                # default pool: the contiguous layout's exact HBM
+                # footprint (every slot at full capacity) + the null
+                # page — same memory, paged indirection; density wins
+                # come from passing a smaller pool explicitly
+                pool_pages = cfg.kv_pool_pages or (
+                    num_slots * (cfg.cache_capacity
+                                 // max(page_size, 1)) + 1)
+            cfg = _dc.replace(cfg, kv_page_size=page_size,
+                              kv_pool_pages=int(pool_pages))
+            model = type(model)(cfg)
+            if prefill_chunk_pages < 1:
+                raise ValueError(
+                    f"prefill_chunk_pages must be >= 1, got "
+                    f"{prefill_chunk_pages}")
+            if cfg.max_kv_pages % prefill_chunk_pages:
+                raise ValueError(
+                    f"prefill_chunk_pages ({prefill_chunk_pages}) must "
+                    f"divide max_kv_pages ({cfg.max_kv_pages}) so a "
+                    f"padded prefill never outgrows the page table")
+            self._page = cfg.kv_page_size
+            self._max_pages = cfg.max_kv_pages
+            self._chunk = self._page * prefill_chunk_pages
+            if self._chunk > cfg.max_position_embeddings:
+                raise ValueError(
+                    f"prefill chunk ({self._chunk} tokens) exceeds "
+                    f"max_position_embeddings "
+                    f"{cfg.max_position_embeddings}")
+            self._prefix_sharing = bool(prefix_sharing)
+            self._alloc = PageAllocator(cfg.kv_pool_pages, self._page)
+            self._pt = np.full((num_slots, self._max_pages), NULL_PAGE,
+                               np.int32)
+            self._pt_dev = jnp.asarray(self._pt)
+            self._pt_dev_dec = self._pt_dev
+            self._pt_dirty = False
+            self._prefilling: deque = deque()
+            self._admit_seq = 0
+            self._prefill_chunk_count = 0
         compute_dtype = jnp.dtype(cfg.dtype)
         if compute_dtype != jnp.float32:
             # same one-time cast as generate(): halve the per-token
@@ -116,7 +194,8 @@ class GenerationServer:
             buckets = buckets + (self._max_prompt,)
         self._buckets = buckets
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
-        self._cache = init_slot_cache(model, params, num_slots)
+        self._cache = init_page_pool(model, params, num_slots) \
+            if self.paged else init_slot_cache(model, params, num_slots)
         self._state = init_slot_state(num_slots, cfg.vocab_size)
         self._queue: deque = deque()
         self._slots: List[Optional[dict]] = [None] * num_slots
@@ -126,16 +205,29 @@ class GenerationServer:
         self._ticks = 0
         self._decode_tokens = 0
         self._tick_time = 0.0
+        self._ttfts: List[float] = []
         self._recorder = FlightRecorder(events_path) if events_path \
             else None
         self._emit("serving_start", slots=num_slots,
                    buckets=list(buckets),
-                   max_dec_len=gen_cfg.max_dec_len)
-        logger.info(
-            "GenerationServer: %d slots, prefill buckets %s, "
-            "capacity %d (max_position_embeddings %d)", num_slots,
-            list(buckets), cfg.cache_capacity,
-            cfg.max_position_embeddings)
+                   max_dec_len=gen_cfg.max_dec_len,
+                   paged=self.paged,
+                   page_size=self._page if self.paged else 0,
+                   pool_pages=cfg.kv_pool_pages if self.paged else 0)
+        if self.paged:
+            logger.info(
+                "GenerationServer (paged): %d slots, %d-page pool of "
+                "%d-token pages (capacity %d = %d pages/slot max), "
+                "prefill chunk %d tokens, prefix sharing %s",
+                num_slots, cfg.kv_pool_pages, self._page,
+                cfg.cache_capacity, self._max_pages, self._chunk,
+                self._prefix_sharing)
+        else:
+            logger.info(
+                "GenerationServer: %d slots, prefill buckets %s, "
+                "capacity %d (max_position_embeddings %d)", num_slots,
+                list(buckets), cfg.cache_capacity,
+                cfg.max_position_embeddings)
 
     # -- host bookkeeping ---------------------------------------------
 
@@ -169,7 +261,8 @@ class GenerationServer:
                 f"{self.model.config.max_position_embeddings}")
         rid = self._next_id
         self._next_id += 1
-        self._queue.append({"id": rid, "prompt": prompt, "tokens": []})
+        self._queue.append({"id": rid, "prompt": prompt, "tokens": [],
+                            "submit_t": time.time()})
         return rid
 
     def _bucket_for(self, n: int) -> int:
@@ -179,7 +272,10 @@ class GenerationServer:
         return self._buckets[-1]
 
     def _admit(self) -> None:
-        """Move queued requests into free slots (bucketed prefill)."""
+        """Move queued requests into free slots."""
+        if self.paged:
+            self._admit_paged()
+            return
         while self._queue and None in self._slots:
             req = self._queue.popleft()
             slot = self._slots.index(None)
@@ -200,8 +296,259 @@ class GenerationServer:
             self._emit("serving_admit", request=req["id"], slot=slot,
                        prompt_len=len(req["prompt"]), bucket=bucket)
 
+    # -- paged scheduling ---------------------------------------------
+    #
+    # The host is the single owner of every paging decision: the numpy
+    # page-table master + PageAllocator refcounts live here, and the
+    # device only ever sees shape-stable jitted ops (chunk prefill,
+    # page copy, decode tick) driven by uploaded int32 tables. Two
+    # device views of the table exist: the full one (prefill reads
+    # shared/owned pages of a still-inactive slot) and the decode one,
+    # where every non-ACTIVE slot's row is nulled so an inactive slot's
+    # dead decode write lands in the reserved garbage page instead of
+    # a page another request is still prefilling or sharing.
+
+    def _sync_pt(self) -> None:
+        if not self._pt_dirty:
+            return
+        self._pt_dev = jnp.asarray(self._pt)
+        act = np.zeros((self.num_slots, 1), bool)
+        for s, r in enumerate(self._slots):
+            if r is not None and r.get("active"):
+                act[s, 0] = True
+        self._pt_dev_dec = jnp.asarray(
+            np.where(act, self._pt, NULL_PAGE).astype(np.int32))
+        self._pt_dirty = False
+
+    def _place(self, req: dict, slot: int, num_pages: int) -> None:
+        """Common bookkeeping of both paged admission paths."""
+        if "nonce" not in req:
+            # assigned once per REQUEST: a preempted-then-readmitted
+            # request keeps its nonce (and its dec_count = emitted
+            # tokens), so its sampling stream resumes exactly where
+            # preemption cut it
+            req["nonce"] = self._nonce
+            self._nonce += 1
+        req["num_pages"] = num_pages
+        req["active"] = False
+        req["admit_seq"] = self._admit_seq
+        self._admit_seq += 1
+        self._slots[slot] = req
+        self._counts["admitted"] += 1
+        metrics.inc("serving/admitted")
+
+    def _activate(self, slot: int, last_logits_row) -> None:
+        """Flip a placed slot live: per-slot SlotState from the host's
+        view of the request (seq = prompt + already-emitted tokens, so
+        resumes re-enter mid-request)."""
+        req = self._slots[slot]
+        seq = req["prompt"] + req["tokens"]
+        appeared = np.zeros((self.model.config.vocab_size,), bool)
+        appeared[np.asarray(seq, np.int64)] = True
+        self._state = activate_slot(
+            self._state, jnp.int32(slot), jnp.int32(len(seq)),
+            jnp.int32(len(req["tokens"])), jnp.int32(req["nonce"]),
+            jnp.asarray(appeared),
+            jnp.asarray(last_logits_row, jnp.float32))
+        req["active"] = True
+        req["cur_len"] = len(seq)
+        self._pt_dirty = True   # decode view must unhide this row
+
+    def _admit_paged(self) -> None:
+        """Paged admission: whole-prompt registry hit -> share every
+        page and activate with zero prefill; else map shared prefix
+        pages + freshly allocated owned pages and queue the slot for
+        chunked prefill. The queue HEAD blocks when the pool cannot
+        cover its owned pages yet — admitting smaller later requests
+        over it would starve long prompts."""
+        while self._queue and None in self._slots:
+            req = self._queue[0]
+            seq = req["prompt"] + req["tokens"]
+            L = len(seq)
+            slot = self._slots.index(None)
+            hit = self._alloc.lookup_prompt(prompt_key(seq)) \
+                if self._prefix_sharing else None
+            if hit is not None:
+                pages, last = hit
+                self._queue.popleft()
+                for pid in pages:
+                    self._alloc.retain(pid)
+                self._pt[slot, :] = NULL_PAGE
+                self._pt[slot, :len(pages)] = pages
+                self._pt_dirty = True
+                self._alloc.stats["prompt_hits"] += 1
+                metrics.inc("serving/prefix_hits")
+                self._place(req, slot, num_pages=len(pages))
+                self._activate(slot, last)
+                self._emit("serving_admit", request=req["id"],
+                           slot=slot, prompt_len=L, mode="prompt_hit",
+                           shared_pages=len(pages))
+                continue
+            shared_pids: List[int] = []
+            if self._prefix_sharing:
+                # share only FULL pages strictly before the one
+                # holding the last prompt token: that page must
+                # recompute locally so the first sampling logits exist
+                for kk in page_prefix_keys(
+                        seq, self._page)[:(L - 1) // self._page]:
+                    pid = self._alloc.lookup_prefix(kk)
+                    if pid is None:
+                        break
+                    shared_pids.append(pid)
+            start = len(shared_pids) * self._page
+            n_chunks = -(-(L - start) // self._chunk)
+            total_pages = (start + n_chunks * self._chunk) // self._page
+            if self._alloc.free_pages < total_pages - len(shared_pids):
+                break
+            self._queue.popleft()
+            self._pt[slot, :] = NULL_PAGE
+            for j, pid in enumerate(shared_pids):
+                self._alloc.retain(pid)
+                self._pt[slot, j] = pid
+            for j in range(len(shared_pids), total_pages):
+                self._pt[slot, j] = self._alloc.alloc()
+            self._pt_dirty = True
+            if shared_pids:
+                self._alloc.stats["prefix_hits"] += len(shared_pids)
+                metrics.inc("serving/prefix_hits", len(shared_pids))
+            self._place(req, slot, num_pages=total_pages)
+            req["prefill_pos"] = start
+            self._prefilling.append(slot)
+            self._emit("serving_admit", request=req["id"], slot=slot,
+                       prompt_len=L, mode="chunked",
+                       shared_pages=len(shared_pids), chunks=n_chunks)
+
+    def _prefill_pump(self) -> None:
+        """Run at most ONE page-aligned prefill chunk per step — the
+        oldest still-prefilling slot advances while everyone else's
+        decode tick proceeds, so a long admission never freezes
+        tokens/s (the chunked-prefill contract of ROADMAP item 1)."""
+        if not self._prefilling:
+            return
+        slot = self._prefilling[0]
+        req = self._slots[slot]
+        seq = req["prompt"] + req["tokens"]
+        L = len(seq)
+        c0 = req["prefill_pos"]
+        row = np.full((1, self._chunk), self.gen_cfg.pad_token_id,
+                      np.int32)
+        row[0, :len(seq[c0:c0 + self._chunk])] = seq[c0:c0 + self._chunk]
+        self._sync_pt()
+        self._cache, logits = prefill_chunk_paged(
+            self.model, self.params, self._cache, jnp.asarray(row),
+            jnp.asarray([c0], jnp.int32), self._pt_dev[slot:slot + 1])
+        req["prefill_pos"] = c0 + self._chunk
+        self._prefill_chunk_count += 1
+        metrics.inc("serving/prefill_chunks")
+        self._emit("serving_prefill_chunk", request=req["id"],
+                   slot=slot, start=c0,
+                   tokens=min(self._chunk, L - c0))
+        if req["prefill_pos"] < L:
+            return
+        self._prefilling.popleft()
+        del req["prefill_pos"]
+        # the last real token sits at chunk row L - 1 - c0
+        last = np.asarray(logits[0, L - 1 - c0])
+        self._activate(slot, last)
+        if self._prefix_sharing:
+            keys = page_prefix_keys(seq, self._page)
+            for j, kk in enumerate(keys):
+                self._alloc.register_prefix(kk, int(self._pt[slot, j]))
+            self._alloc.register_prompt(
+                prompt_key(seq),
+                [int(p) for p in self._pt[slot, :req["num_pages"]]],
+                last)
+
+    def _release_pages(self, slot: int) -> None:
+        req = self._slots[slot]
+        for j in range(req.get("num_pages", 0)):
+            pid = int(self._pt[slot, j])
+            if pid != NULL_PAGE:
+                self._alloc.release(pid)
+        self._pt[slot, :] = NULL_PAGE
+        self._pt_dirty = True
+        req["num_pages"] = 0
+
+    def _alloc_or_preempt(self, needy_slot: int) -> int:
+        """A free page, preempting the youngest OTHER occupied slot
+        (whole request back to the queue HEAD, pages released) until
+        one exists. Config validation guarantees a lone slot can
+        always grow to its maximum length, so this terminates."""
+        pid = self._alloc.try_alloc()
+        while pid is None:
+            victims = [s for s, r in enumerate(self._slots)
+                       if r is not None and s != needy_slot]
+            if not victims:
+                raise PagePoolExhausted(
+                    f"slot {needy_slot} needs a page with none free "
+                    f"and no one to preempt (pool "
+                    f"{self._alloc.num_pages} pages)")
+            victim = max(victims,
+                         key=lambda s: self._slots[s]["admit_seq"])
+            self._preempt_slot(victim)
+            pid = self._alloc.try_alloc()
+        return pid
+
+    def _preempt_slot(self, victim: int) -> None:
+        """Kick a request off the device to reclaim its pages, keeping
+        its host state (emitted tokens, nonce) intact; re-admission
+        prefills prompt+tokens and resumes the sampling stream at the
+        preserved dec_count — token-for-token as if never preempted."""
+        req = self._slots[victim]
+        self._release_pages(victim)
+        if victim in self._prefilling:
+            self._prefilling.remove(victim)
+        self._slots[victim] = None
+        self._state = self._state._replace(
+            active=self._state.active.at[victim].set(False),
+            finished=self._state.finished.at[victim].set(False))
+        req["active"] = False
+        req.pop("prefill_pos", None)
+        self._queue.appendleft(req)
+        self._counts["preempted"] += 1
+        metrics.inc("serving/preempted")
+        self._emit("serving_preempt", request=req["id"], slot=victim,
+                   reason="pages", tokens=len(req["tokens"]))
+
+    def _page_maintenance(self) -> None:
+        """Before every decode tick: each active slot's NEXT write
+        position (its current length) must land in a page it owns
+        exclusively — map a fresh page at a page boundary, and split
+        shared pages copy-on-write (device page copy + host refcount
+        handoff) at the first divergent write."""
+        for slot in range(self.num_slots):
+            req = self._slots[slot]
+            if req is None or not req.get("active"):
+                continue
+            pos = req["cur_len"]
+            if pos >= self.model.config.cache_capacity:
+                continue   # length bound enforced at submit
+            j = pos // self._page
+            if j >= req["num_pages"]:
+                self._pt[slot, j] = self._alloc_or_preempt(slot)
+                req["num_pages"] = j + 1
+                self._pt_dirty = True
+            else:
+                pid = int(self._pt[slot, j])
+                if self._alloc.refcount(pid) > 1:
+                    new = self._alloc_or_preempt(slot)
+                    self._cache = copy_kv_pages(
+                        self._cache, jnp.asarray([pid], jnp.int32),
+                        jnp.asarray([new], jnp.int32))
+                    self._alloc.release(pid)
+                    self._pt[slot, j] = new
+                    self._pt_dirty = True
+                    self._alloc.stats["cow_splits"] += 1
+                    metrics.inc("serving/cow_splits")
+                    self._emit("serving_cow_split", request=req["id"],
+                               slot=slot, page=j, src=pid, dst=new)
+
     def _evict(self, slot: int, reason: str) -> Completion:
         req = self._slots[slot]
+        if self.paged:
+            self._release_pages(slot)
+            if slot in self._prefilling:
+                self._prefilling.remove(slot)
         self._slots[slot] = None
         self._state = self._state._replace(
             active=self._state.active.at[slot].set(False),
@@ -238,28 +585,53 @@ class GenerationServer:
     # -- the serving loop ---------------------------------------------
 
     def step(self) -> List[Completion]:
-        """Admit what fits, tick every occupied slot one token, evict
-        and return whatever finished."""
+        """Admit what fits, advance at most one prefill chunk (paged),
+        tick every ACTIVE slot one token, evict and return whatever
+        finished."""
         self._admit()
         reg = metrics.get_registry()
-        if self.occupancy == 0:
-            reg.set_gauge("serving/slot_occupancy", 0)
+        if self.paged:
+            self._prefill_pump()
+            reg.set_gauge("serving/pages_in_use",
+                          self._alloc.pages_in_use)
+        active_any = any(
+            r is not None and (not self.paged or r.get("active"))
+            for r in self._slots)
+        if not active_any:
+            # nothing decodable yet (empty, or every occupant is still
+            # mid-chunked-prefill) — the pump above still made progress
+            reg.set_gauge("serving/slot_occupancy", self.occupancy)
             return []
         t0 = time.time()
         with reg.timer("serving/decode_tick"):
-            self._cache, self._state, tok = decode_step(
-                self.model, self.params, self._cache, self._state,
-                self._rng, self.gen_cfg)
+            if self.paged:
+                # growth/COW decisions against the PRE-tick lengths —
+                # the tick's write position — then one table upload
+                self._page_maintenance()
+                self._sync_pt()
+                self._cache, self._state, tok = decode_step(
+                    self.model, self.params, self._cache, self._state,
+                    self._rng, self.gen_cfg, self._pt_dev_dec)
+            else:
+                self._cache, self._state, tok = decode_step(
+                    self.model, self.params, self._cache, self._state,
+                    self._rng, self.gen_cfg)
             tok = np.asarray(tok)   # device sync inside the timer
         self._tick_time += time.time() - t0
         self._ticks += 1
         finished = np.asarray(self._state.finished)
         dec_count = np.asarray(self._state.dec_count)
         done: List[Completion] = []
+        now = time.time()
         for slot, req in enumerate(self._slots):
-            if req is None:
+            if req is None or (self.paged and not req.get("active")):
                 continue
             req["tokens"].append(int(tok[slot]))
+            if "ttft" not in req:
+                req["ttft"] = now - req["submit_t"]
+                self._ttfts.append(req["ttft"])
+            if self.paged:
+                req["cur_len"] += 1
             self._decode_tokens += 1
             if finished[slot]:
                 done.append(self._evict(slot, "eos"))
@@ -280,8 +652,10 @@ class GenerationServer:
         return [done[i] for i in ids]
 
     def summary(self) -> dict:
-        """Counters + decode tokens/s for the server's lifetime so far
-        (also emitted to the flight recorder)."""
+        """Counters + decode tokens/s + TTFT percentiles for the
+        server's lifetime so far (also emitted to the flight
+        recorder). Paged servers add pool occupancy and the allocator
+        sharing stats."""
         tps = self._decode_tokens / self._tick_time \
             if self._tick_time > 0 else 0.0
         s = {"slots": self.num_slots, "occupancy": self.occupancy,
@@ -289,5 +663,16 @@ class GenerationServer:
              "decode_tokens": self._decode_tokens,
              "decode_time_sec": round(self._tick_time, 4),
              "tokens_per_sec": round(tps, 2), **self._counts}
+        if self._ttfts:
+            ms = np.asarray(self._ttfts) * 1000.0
+            s["ttft_p50_ms"] = round(float(np.percentile(ms, 50)), 3)
+            s["ttft_p99_ms"] = round(float(np.percentile(ms, 99)), 3)
+        if self.paged:
+            s["paged"] = True
+            s["page_size"] = self._page
+            s["pool_pages"] = self._alloc.num_pages
+            s["pages_in_use"] = self._alloc.pages_in_use
+            s["prefill_chunks"] = self._prefill_chunk_count
+            s.update(self._alloc.stats)
         self._emit("serving_summary", **s)
         return s

@@ -84,6 +84,21 @@ class GPTConfig:
     #:   groups from the routing counts (falls back to the XLA expert
     #:   einsums per-site when the kernel rejects the shape).
     moe_dispatch: str = "einsum"
+    #: Paged KV serving (core/paging.py + core/serving.py): fixed page
+    #: size in TOKENS. 0 = contiguous per-slot cache (the default; the
+    #: training path never pages). When > 0 it must be a multiple of
+    #: 128 — the same lane-width rounding ``cache_capacity`` applies —
+    #: and divide ``cache_capacity``, so a slot's logical capacity is
+    #: exactly ``max_kv_pages`` pages and every page tiles the
+    #: flash-decode kernel.
+    kv_page_size: int = 0
+    #: Physical pages in the global KV pool (the per-layer cache leaf
+    #: becomes ``[kv_pool_pages, heads, head_dim, kv_page_size]``).
+    #: Page 0 is the reserved null page, so the pool must hold at
+    #: least ``max_kv_pages + 1`` pages — one maximum-length request
+    #: plus the null page — or a single request could deadlock the
+    #: server. Required (> 0) whenever ``kv_page_size`` is set.
+    kv_pool_pages: int = 0
     dtype: str = "float32"                # compute dtype (bf16 for AMP-O2)
     param_dtype: str = "float32"
 
@@ -193,6 +208,42 @@ class GPTConfig:
                 raise ValueError(
                     f"unknown moe_dispatch {self.moe_dispatch!r} "
                     f"(expected 'einsum', 'sort' or 'sort_pallas')")
+        # Paged-KV composition: the three sizes must agree BEFORE any
+        # device allocation happens — a page that does not tile the
+        # capacity (or the lane width) would knock decode off the
+        # flash_decode_paged kernel or leave unreachable pool columns,
+        # and an undersized pool deadlocks the first max-length request.
+        if self.kv_page_size or self.kv_pool_pages:
+            if self.kv_page_size <= 0:
+                raise ValueError(
+                    f"kv_pool_pages ({self.kv_pool_pages}) is set but "
+                    f"kv_page_size is {self.kv_page_size}; paged KV "
+                    f"needs both (set kv_page_size to a multiple of "
+                    f"128 that divides cache_capacity "
+                    f"{self.cache_capacity})")
+            if self.kv_page_size % 128:
+                raise ValueError(
+                    f"kv_page_size ({self.kv_page_size}) must be a "
+                    f"multiple of 128 — the same TPU-lane rounding "
+                    f"cache_capacity uses, so every page tiles the "
+                    f"flash-decode kernel's 128-aligned KV blocks")
+            if self.cache_capacity % self.kv_page_size:
+                raise ValueError(
+                    f"cache_capacity ({self.cache_capacity}, "
+                    f"max_position_embeddings "
+                    f"{self.max_position_embeddings} rounded up to "
+                    f"128) must be divisible by kv_page_size "
+                    f"({self.kv_page_size}) so a slot's page table "
+                    f"covers it exactly (max_kv_pages = "
+                    f"capacity / page)")
+            if self.kv_pool_pages < self.max_kv_pages + 1:
+                raise ValueError(
+                    f"kv_pool_pages ({self.kv_pool_pages}) must be at "
+                    f"least max_kv_pages + 1 = {self.max_kv_pages + 1} "
+                    f"(one maximum-length request's "
+                    f"{self.max_kv_pages} pages plus the reserved "
+                    f"null page 0), or a single request can deadlock "
+                    f"the page pool")
 
     @property
     def head_dim(self) -> int:
@@ -210,6 +261,15 @@ class GPTConfig:
         bounded by ``max_position_embeddings`` (the embedding table
         size) and causal/validity masking never reads them."""
         return -(-self.max_position_embeddings // 128) * 128
+
+    @property
+    def max_kv_pages(self) -> int:
+        """Width of a slot's page table under paged KV serving:
+        ``cache_capacity / kv_page_size`` logical pages cover one
+        slot's full capacity. 0 when paging is off."""
+        if not self.kv_page_size:
+            return 0
+        return self.cache_capacity // self.kv_page_size
 
     @classmethod
     def from_config(cls, config) -> "GPTConfig":

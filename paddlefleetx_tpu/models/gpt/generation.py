@@ -604,7 +604,8 @@ def prefill_into_slots(model, params, cache, state: SlotState,
 
 @partial(jax.jit, static_argnames=("model", "gen_cfg"))
 def decode_step(model, params, cache, state: SlotState,
-                rng: jax.Array, gen_cfg: GenerationConfig):
+                rng: jax.Array, gen_cfg: GenerationConfig,
+                page_table=None):
     """One shared decode tick over the whole slot batch.
 
     Mirrors the lockstep ``body`` of :func:`generate` slot-for-slot —
@@ -661,7 +662,7 @@ def decode_step(model, params, cache, state: SlotState,
         {"params": params, "cache": cache}, token[:, None],
         position_ids=step_pos[:, None], use_cache=True,
         deterministic=True, cache_lengths=state.lengths,
-        mutable=["cache"])
+        page_table=page_table, mutable=["cache"])
     cache = _constrain_slot_cache(mutated["cache"])
     new_state = SlotState(
         lengths=jnp.where(state.active, state.lengths + 1,
@@ -674,6 +675,108 @@ def decode_step(model, params, cache, state: SlotState,
         active=state.active,
         last_logits=logits2[:, -1].astype(jnp.float32))
     return cache, new_state, token
+
+
+# -- paged KV primitives (core/paging.py owns the host bookkeeping) ----
+#
+# With cfg.kv_page_size/kv_pool_pages set, the serving cache stops
+# being [slots, h, d, capacity] rows and becomes ONE global page pool
+# [kv_pool_pages, h, d, kv_page_size] per layer that every slot reaches
+# through a [slots, max_kv_pages] page table (model.py paged branch).
+# The jitted pieces below are deliberately dumb — shape-stable scatter/
+# copy/activate kernels — while allocation, refcounts, COW decisions
+# and prefix sharing stay host-side in core/serving.py + core/paging.py.
+
+
+def init_page_pool(model, params, num_slots: int):
+    """Zeroed global KV page-pool tree for a paged server, shaped by
+    ``jax.eval_shape`` over a paged decode apply (no compile, no
+    FLOPs). ``model.config`` must carry ``kv_page_size`` /
+    ``kv_pool_pages`` (the server builds that twin config)."""
+    cfg = model.config
+    shapes = jax.eval_shape(
+        lambda p: model.apply(
+            {"params": p}, jnp.zeros((num_slots, 1), jnp.int32),
+            use_cache=True, deterministic=True,
+            cache_lengths=jnp.zeros((num_slots,), jnp.int32),
+            page_table=jnp.zeros((num_slots, cfg.max_kv_pages),
+                                 jnp.int32),
+            mutable=["cache"])[1]["cache"],
+        params)
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
+@partial(jax.jit, static_argnames=("model",))
+def prefill_chunk_paged(model, params, cache, input_chunk: jax.Array,
+                        chunk_start: jax.Array, page_table: jax.Array):
+    """One page-aligned chunk of a chunked prefill.
+
+    ``input_chunk`` is ``[n, chunk]`` token ids (the tail past the
+    prompt right-padded with any token — its KV lands beyond the
+    prompt length, where the per-slot ragged masking never reads and
+    the first decode writes overwrite); ``chunk_start`` ``[n]`` is each
+    row's absolute position of the chunk's first token (a multiple of
+    ``kv_page_size``); ``page_table`` ``[n, max_kv_pages]`` carries
+    just the prefilling rows. The chunk's KV scatters straight into
+    its physical pages (model.py ``chunk_start`` branch) while the
+    queries attend every earlier position through the page-table
+    gather. Returns ``(cache, logits)`` with fp32 ``[n, chunk, V]``
+    logits — the server picks row ``prompt_len - 1 - chunk_start`` of
+    the final chunk as the first sampling distribution. One compiled
+    shape per ``(n, chunk)``.
+    """
+    n, c = input_chunk.shape
+    mpe = model.config.max_position_embeddings
+    pos = jnp.clip(
+        jnp.asarray(chunk_start, jnp.int32)[:, None] +
+        jnp.arange(c, dtype=jnp.int32)[None, :], 0, mpe - 1)
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, input_chunk,
+        position_ids=pos, use_cache=True, deterministic=True,
+        chunk_start=chunk_start, page_table=page_table,
+        mutable=["cache"])
+    return (_constrain_slot_cache(mutated["cache"]),
+            logits.astype(jnp.float32))
+
+
+@jax.jit
+def copy_kv_pages(cache, src: jax.Array, dst: jax.Array):
+    """Device-side copy of physical pages ``src -> dst`` (both
+    ``[k]`` int32) in every KV pool leaf — the copy half of a
+    copy-on-write split; the host (server) rewires the page table and
+    refcounts around it."""
+    def cp(path, leaf):
+        name = getattr(path[-1], "key", "")
+        if name in ("cached_key", "cached_value"):
+            ax = leaf.ndim - 4
+            sel = (slice(None),) * ax
+            return leaf.at[sel + (dst,)].set(leaf[sel + (src,)])
+        return leaf
+    return jax.tree_util.tree_map_with_path(cp, cache)
+
+
+@jax.jit
+def activate_slot(state: SlotState, slot: jax.Array,
+                  length: jax.Array, dec_count: jax.Array,
+                  nonce: jax.Array, appeared_row: jax.Array,
+                  last_logits_row: jax.Array) -> SlotState:
+    """Flip one slot live from host-computed state — the paged
+    admission paths (chunked-prefill completion, whole-prompt registry
+    hit, preempted-request resume) activate through here instead of
+    ``prefill_into_slots``'s scatter. ``dec_count`` is nonzero only
+    for resumes, so a requeued request's min-length processing and
+    sampling stream continue exactly where they stopped."""
+    slot = jnp.asarray(slot, jnp.int32)
+    return SlotState(
+        lengths=state.lengths.at[slot].set(
+            jnp.asarray(length, jnp.int32)),
+        dec_count=state.dec_count.at[slot].set(
+            jnp.asarray(dec_count, jnp.int32)),
+        nonce=state.nonce.at[slot].set(jnp.asarray(nonce, jnp.int32)),
+        appeared=state.appeared.at[slot].set(appeared_row),
+        finished=state.finished.at[slot].set(False),
+        active=state.active.at[slot].set(True),
+        last_logits=state.last_logits.at[slot].set(last_logits_row))
 
 
 def left_pad_batch(sequences, pad_id: int):

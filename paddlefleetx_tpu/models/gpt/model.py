@@ -175,7 +175,8 @@ class MultiHeadAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, attn_bias=None, use_cache: bool = False,
-                 deterministic: bool = True, cache_lengths=None):
+                 deterministic: bool = True, cache_lengths=None,
+                 page_table=None, chunk_start=None):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         h, nh, hd = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
@@ -212,7 +213,85 @@ class MultiHeadAttention(nn.Module):
 
         query_offset = 0
         kv_cache_layout = False
-        if use_cache:
+        page_table_arg = None
+        if use_cache and page_table is not None:
+            # Paged KV (core/paging.py): the cache variables hold the
+            # GLOBAL page pool [kv_pool_pages, h, d, kv_page_size] —
+            # one pool shared by every slot — and each batch row
+            # reaches its tokens through its page_table row (logical
+            # page j of row i lives in physical page page_table[i, j]).
+            # Page layout keeps the [h, d, S-minor] tiling of the
+            # contiguous cache, just cut into kv_page_size columns.
+            # Two write modes:
+            #   - ragged decode (cache_lengths): one token per row at
+            #     that row's position — look up the physical page of
+            #     position//page_size and scatter the column at
+            #     position%page_size. Inactive slots' page-table rows
+            #     are all NULL_PAGE, so their dead writes land in the
+            #     reserved garbage page.
+            #   - chunked prefill (chunk_start): the chunk is
+            #     page-aligned and spans whole pages, so the fresh
+            #     chunk KV drops straight into its physical pages with
+            #     one scatter — no gather/modify/scatter round trip.
+            # Reads go through ops/attention.py's page_table
+            # indirection (flash_decode_paged walks the table via
+            # scalar prefetch; the dense fallback gathers).
+            page = cfg.kv_page_size
+            if not page or not cfg.kv_pool_pages:
+                raise ValueError(
+                    "page_table passed but kv_page_size/kv_pool_pages "
+                    "are not configured (GPTConfig)")
+            cache_k = self.variable(
+                "cache", "cached_key", jnp.zeros,
+                (cfg.kv_pool_pages, nh, hd, page), dtype)
+            cache_v = self.variable(
+                "cache", "cached_value", jnp.zeros,
+                (cfg.kv_pool_pages, nh, hd, page), dtype)
+            pt = jnp.asarray(page_table, jnp.int32)
+            if cache_lengths is not None:
+                if x.shape[1] != 1:
+                    raise ValueError(
+                        "paged ragged decode (cache_lengths) is "
+                        "single-token only; multi-token writes go "
+                        "through chunk_start")
+                pos = jnp.clip(
+                    jnp.asarray(cache_lengths, jnp.int32), 0,
+                    cfg.cache_capacity - 1)
+                pid = jnp.take_along_axis(
+                    pt, (pos // page)[:, None], axis=1)[:, 0]
+                cache_k.value = cache_k.value.at[pid, :, :,
+                                                 pos % page].set(
+                    k.transpose(0, 2, 3, 1)[..., 0])
+                cache_v.value = cache_v.value.at[pid, :, :,
+                                                 pos % page].set(
+                    v.transpose(0, 2, 3, 1)[..., 0])
+                query_offset = pos                      # [b]
+            elif chunk_start is not None:
+                c = x.shape[1]
+                if c % page:
+                    raise ValueError(
+                        f"chunked prefill length {c} must be a "
+                        f"multiple of kv_page_size {page}")
+                cp = c // page
+                c0 = jnp.asarray(chunk_start, jnp.int32)
+                pids = jnp.take_along_axis(
+                    pt, (c0 // page)[:, None] +
+                    jnp.arange(cp, dtype=jnp.int32)[None, :], axis=1)
+                # [b, h, d, c] -> [b, cp, h, d, page] page-major blocks
+                chunk_kv = lambda t: t.transpose(0, 2, 3, 1).reshape(  # noqa: E731
+                    x.shape[0], nh, hd, cp, page).transpose(
+                    0, 3, 1, 2, 4)
+                cache_k.value = cache_k.value.at[pids].set(chunk_kv(k))
+                cache_v.value = cache_v.value.at[pids].set(chunk_kv(v))
+                query_offset = c0                       # [b]
+            else:
+                raise ValueError(
+                    "page_table requires cache_lengths (ragged decode)"
+                    " or chunk_start (chunked prefill)")
+            k, v = cache_k.value, cache_v.value
+            kv_cache_layout = True
+            page_table_arg = pt
+        elif use_cache:
             # Decode: roll the new keys/values into the preallocated
             # cache. Capacity is cache_capacity (max_position_embeddings
             # rounded up to a 128 multiple so the minor dim always
@@ -321,7 +400,8 @@ class MultiHeadAttention(nn.Module):
                 dropout_rate=cfg.attention_probs_dropout_prob,
                 dropout_rng=dropout_rng, deterministic=deterministic,
                 use_flash=cfg.use_flash_attention,
-                kv_cache_layout=kv_cache_layout)
+                kv_cache_layout=kv_cache_layout,
+                page_table=page_table_arg)
         if use_ulysses:
             # all-to-all back: seq re-shards over cp, heads gather
             out = with_logical_constraint(
@@ -358,7 +438,8 @@ class TransformerDecoderLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, attn_bias=None, use_cache: bool = False,
-                 deterministic: bool = True, cache_lengths=None):
+                 deterministic: bool = True, cache_lengths=None,
+                 page_table=None, chunk_start=None):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         pdtype = jnp.dtype(cfg.param_dtype)
@@ -372,7 +453,8 @@ class TransformerDecoderLayer(nn.Module):
         residual = x
         y = ln("norm1")(x)
         y = MultiHeadAttention(cfg, name="self_attn")(
-            y, attn_bias, use_cache, deterministic, cache_lengths)
+            y, attn_bias, use_cache, deterministic, cache_lengths,
+            page_table, chunk_start)
         y = nn.Dropout(cfg.hidden_dropout_prob, name="dropout1")(
             y, deterministic=deterministic)
         x = residual + y
@@ -458,7 +540,8 @@ class GPTModel(nn.Module):
     @nn.compact
     def __call__(self, input_ids, position_ids=None, attn_bias=None,
                  use_cache: bool = False, deterministic: bool = True,
-                 position_offset=0, cache_lengths=None):
+                 position_offset=0, cache_lengths=None,
+                 page_table=None, chunk_start=None):
         cfg = self.config
         static_offset = position_offset if isinstance(position_offset, int) \
             else 0
@@ -491,7 +574,8 @@ class GPTModel(nn.Module):
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, scanned=True, name="decoder")(
-                x, attn_bias, use_cache, deterministic, cache_lengths)
+                x, attn_bias, use_cache, deterministic, cache_lengths,
+                page_table, chunk_start)
             moe_aux = aux_stack.sum() if cfg.moe_num_experts else None
         else:
             moe_aux = jnp.zeros((), jnp.float32) \
@@ -499,7 +583,7 @@ class GPTModel(nn.Module):
             for i in range(cfg.num_layers):
                 x = block(cfg, name=f"decoder_{i}")(
                     x, attn_bias, use_cache, deterministic,
-                    cache_lengths)
+                    cache_lengths, page_table, chunk_start)
                 if cfg.moe_num_experts:
                     x, aux = x
                     moe_aux = moe_aux + aux
@@ -538,10 +622,11 @@ class GPTForPretraining(nn.Module):
     @nn.compact
     def __call__(self, input_ids, position_ids=None, attn_bias=None,
                  use_cache: bool = False, deterministic: bool = True,
-                 position_offset=0, cache_lengths=None):
+                 position_offset=0, cache_lengths=None,
+                 page_table=None, chunk_start=None):
         x = GPTModel(self.config, name="gpt")(
             input_ids, position_ids, attn_bias, use_cache, deterministic,
-            position_offset, cache_lengths)
+            position_offset, cache_lengths, page_table, chunk_start)
         word_emb = _word_embedding(
             self.variables["params"]["gpt"]["embeddings"])
         return tied_logits(x, word_emb)

@@ -127,6 +127,22 @@ def _kernel_dropout_enabled() -> bool:
 DENSE_NONCAUSAL_MAX_SKV = 2048
 
 
+def _gather_kv_pages(pool, page_table):
+    """Resolve a paged KV pool back to per-row contiguous layout: the
+    XLA-side mirror of the ``flash_decode_paged`` index-map
+    indirection. ``pool [num_pages, h, d, page]`` gathered by
+    ``page_table [b, max_pages]`` becomes ``[b, h, d,
+    max_pages * page]`` with each row's logical positions back in
+    order — after which the ordinary per-row-offset causal masking of
+    :func:`_xla_attention` applies unchanged (positions past a row's
+    offset are masked whatever garbage its unwritten/null pages hold).
+    Materializes every row at full capacity, so it is the parity
+    oracle and fallback, not the fast path."""
+    g = jnp.take(pool, page_table, axis=0)     # [b, m, h, d, page]
+    b, m, h, d, p = g.shape
+    return g.transpose(0, 2, 3, 1, 4).reshape(b, h, d, m * p)
+
+
 def _xla_attention(q, k, v, bias, causal, query_offset, dropout_rate,
                    dropout_rng, deterministic, softmax_in_fp32,
                    kv_cache_layout=False):
@@ -176,13 +192,27 @@ def dot_product_attention(
         deterministic: bool = True,
         softmax_in_fp32: bool = True,
         use_flash: bool = False,
-        kv_cache_layout: bool = False) -> jax.Array:
+        kv_cache_layout: bool = False,
+        page_table: Optional[jax.Array] = None) -> jax.Array:
     """Causal attention; dispatches to the Pallas flash kernel on TPU.
 
     ``bias`` is an additive mask broadcastable to ``[b, h, sq, sk]``
     (the reference's ``attn_mask`` convention, additive -1e4 style).
+
+    ``page_table`` (requires ``kv_cache_layout``): ``k``/``v`` are the
+    PAGED pool ``[num_pages, h, d, page]`` and each row's logical
+    cache is ``page_table[row]``'s pages in order (``core/paging.py``).
+    Single-token ragged decode takes ``flash_decode_paged``
+    (``attention/flash_decode_paged`` counter); everything else —
+    chunked prefill, kernel rejection, ``use_flash=False`` — gathers
+    the rows contiguous (:func:`_gather_kv_pages`) and rides the
+    per-row-offset dense path (dispatch matrix: docs/inference.md).
     """
     skv = k.shape[3] if kv_cache_layout else k.shape[1]
+    if page_table is not None:
+        if not kv_cache_layout:
+            raise ValueError("page_table requires kv_cache_layout")
+        skv = page_table.shape[1] * k.shape[3]
     # training dropout on the kernel path: in-kernel philox masks
     # (reference fused softmax-with-dropout, hybrid_model.py:277-285).
     # Bias (ERNIE padding masks, GPT attn_mask) rides into the kernel
@@ -224,7 +254,21 @@ def dot_product_attention(
              and bias.shape[-1] == skv))
         try:
             from .pallas import flash_attention as fa
-            if decode_bias_ok and kv_cache_layout:
+            if kv_cache_layout and page_table is not None:
+                if causal and q.shape[1] == 1 and bias is None and \
+                        getattr(query_offset, "ndim", 0) == 1:
+                    # paged ragged decode: the kernel's scalar
+                    # prefetch walks the slot->page indirection table
+                    # (flash_decode_paged) — each row streams only its
+                    # own pages
+                    out = fa.flash_decode_paged(q, k, v, query_offset,
+                                                page_table)
+                    metrics.inc("attention/flash_decode_paged")
+                    return out
+                # chunked prefill (sq > 1) and other paged shapes fall
+                # through to the shared kv_cache_layout fallback
+                # counter and the gather + dense path below
+            elif decode_bias_ok and kv_cache_layout:
                 if getattr(query_offset, "ndim", 0) == 1:
                     # ragged slot decode: a [b] offset vector (the
                     # continuous-batching server's per-slot lengths) —
@@ -261,6 +305,13 @@ def dot_product_attention(
     elif not use_flash:
         metrics.inc("attention/fallback/flash_disabled")
     metrics.inc("attention/dense")
+    if page_table is not None:
+        # matching indirection for the dense path: gather each row's
+        # pages back into contiguous [b, h, d, capacity] order, after
+        # which the per-row offset masking below needs no page
+        # awareness at all
+        k = _gather_kv_pages(k, page_table)
+        v = _gather_kv_pages(v, page_table)
     return _xla_attention(q, k, v, bias, causal, query_offset, dropout_rate,
                           dropout_rng, deterministic, softmax_in_fp32,
                           kv_cache_layout=kv_cache_layout)

@@ -1257,3 +1257,118 @@ def flash_decode_ragged(q, k, v, query_offsets, bias=None,
             f"ragged offsets must be [b={b}], got {offs.shape}")
     return _flash_decode_call(q, k, v, offs, bias, block_kv,
                               ragged=True)
+
+
+def _paged_decode_kernel(off_ref, pt_ref, *refs, **kw):
+    """:func:`_decode_kernel` behind TWO prefetched scalars: the
+    per-row offsets AND the page table. The table is consumed entirely
+    by the BlockSpec index maps (physical-page redirection happens in
+    the grid, before the kernel body runs); the body itself masks and
+    block-skips against LOGICAL positions exactly as the ragged kernel
+    does, so it needs only the offsets."""
+    del pt_ref
+    _decode_kernel(off_ref, *refs, **kw)
+
+
+def flash_decode_paged(q, k, v, query_offsets, page_table, bias=None,
+                       block_kv: int = DEFAULT_BLOCK_KV):
+    """Per-row decode through a PAGED KV pool: row ``i`` of
+    ``q [b, 1, h, d]`` attends to positions ``<= query_offsets[i]`` of
+    its logical cache, whose physical storage is scattered across the
+    global pool ``k/v [num_pages, h, d, page_size]`` according to
+    ``page_table [b, max_pages]`` (int32 physical page ids;
+    ``core/paging.py``).
+
+    Same kernel body, grid walk, and per-row block clamping as
+    :func:`flash_decode_ragged` — the ONLY difference is the KV
+    BlockSpec index map, which redirects logical block ``kb`` to block
+    ``kb % blocks_per_page`` of physical page
+    ``page_table[i, kb // blocks_per_page]``. Both scalars prefetch
+    (``PrefetchScalarGridSpec(num_scalar_prefetch=2)``) so the
+    redirection is resolved before each block's HBM->VMEM copy issues,
+    and the clamp keeps a short row from streaming pages it never
+    wrote. Block size is the largest 128-aligned divisor of the page
+    size that fits the VMEM budget, so a block never straddles two
+    (physically unrelated) pages.
+
+    Inference-only; no bias operand (serving decode carries none —
+    per-slot validity lives in the offsets). Raises
+    NotImplementedError where the caller must fall back to the XLA
+    gather path (``ops/attention.py::_gather_kv_pages``).
+    """
+    if jax.default_backend() != "tpu" and not _interpret():
+        raise NotImplementedError("flash kernel targets TPU")
+    if bias is not None:
+        raise NotImplementedError(
+            "flash_decode_paged takes no bias (per-slot validity is "
+            "the offsets')")
+    b, sq, h, d = q.shape
+    if sq != 1:
+        raise NotImplementedError("flash_decode is single-token only")
+    if d % 8:
+        raise NotImplementedError(f"head_dim {d} unsupported")
+    if k.ndim != 4 or k.shape[1] != h or k.shape[2] != d:
+        raise NotImplementedError(
+            f"paged pool must be [P, {h}, {d}, page], got {k.shape}")
+    page = k.shape[3]
+    offs = jnp.asarray(query_offsets, jnp.int32)
+    if offs.ndim != 1 or offs.shape[0] != b:
+        raise NotImplementedError(
+            f"ragged offsets must be [b={b}], got {offs.shape}")
+    pt = jnp.asarray(page_table, jnp.int32)
+    if pt.ndim != 2 or pt.shape[0] != b:
+        raise NotImplementedError(
+            f"page_table must be [b={b}, max_pages], got {pt.shape}")
+    max_pages = pt.shape[1]
+    # block the PAGE, not the logical capacity: a kv block must stay
+    # inside one physical page for the redirection to be a pure index
+    # remap
+    block_kv = _auto_block(page, block_kv, 128)
+    budget = 8 * 1024 * 1024
+    while block_kv > 128 and page % (block_kv // 2) == 0 and \
+            4 * h * d * block_kv * k.dtype.itemsize > budget:
+        block_kv //= 2
+    if page % block_kv or block_kv % 128 or \
+            4 * h * d * block_kv * k.dtype.itemsize > budget:
+        raise NotImplementedError(
+            f"page size {page} not tileable by {block_kv} within "
+            f"VMEM budget (h={h}, d={d})")
+    bpp = page // block_kv                     # blocks per page
+    num_kv = max_pages * bpp                   # logical capacity walk
+
+    qp = q.transpose(0, 2, 3, 1)               # [b, h, d, 1]
+
+    def kv_block(bi, ki, off, pt):
+        # clamp to the row's live block (same dead-block elision as
+        # the ragged kernel), then redirect through the page table
+        kb = jnp.minimum(ki, off[bi] // block_kv)
+        return (pt[bi, kb // bpp], 0, 0, kb % bpp)
+
+    in_specs = [
+        pl.BlockSpec((1, h, d, 1),
+                     lambda bi, ki, off, pt: (bi, 0, 0, 0)),
+        pl.BlockSpec((1, h, d, block_kv), kv_block),
+        pl.BlockSpec((1, h, d, block_kv), kv_block),
+    ]
+    kernel = functools.partial(_paged_decode_kernel, sm_scale=d ** -0.5,
+                               block_kv=block_kv, num_kv=num_kv,
+                               has_bias=False, ragged=True)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, num_kv),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (1, h, d, 1),
+                lambda bi, ki, off, pt: (bi, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((h, 1), jnp.float32),
+                pltpu.VMEM((h, 1), jnp.float32),
+                pltpu.VMEM((h, d), jnp.float32),
+            ],
+        ),
+        out_shape=_sds((b, h, d, 1), q.dtype, q),
+        interpret=_interpret(),
+    )(offs, pt, qp, k, v)
+    return out.transpose(0, 3, 1, 2)

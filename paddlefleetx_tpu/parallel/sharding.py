@@ -130,7 +130,11 @@ def make_sharding_rules(topo: TopologyConfig) -> Rules:
         # core/serving.py): slots are the decode batch, so they ride
         # the dataflow plane like "batch" while mp stays over the
         # cache's heads dim ("act_heads") — a slot server under mp
-        # shards every slot's cache by head, never by slot content
+        # shards every slot's cache by head, never by slot content.
+        # Under the paged cache the same name carries the POOL axis
+        # of the global [kv_pool_pages, heads, d, page] KV store:
+        # pages, like slots, are dataflow-plane content mp must not
+        # split (the page-table indirection is per-row host state)
         ("cache_slots", DATA_AXES),
     )
 

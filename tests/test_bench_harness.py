@@ -599,6 +599,30 @@ def test_bench_serving_runs_offline(capsys):
     assert rec["prompt_len_range"] == [4, 24]
     assert rec["max_dec_len"] == 12 and rec["seed"] == 0
     assert 0 < rec["decode_ticks"] <= rec["requests"] * rec["max_dec_len"]
+    # paged KV-cache fields: the bench defaults to the paged server
+    # so the headline number exercises the density path
+    assert rec["paged"] is True
+    assert rec["page_size"] == 128 and rec["pool_pages"] >= 2
+    # TTFT percentiles ride in the record (ms, admission + prefill
+    # queueing included); p99 >= p50 > 0 on any non-empty trace
+    assert rec["ttft_p50_ms"] > 0
+    assert rec["ttft_p99_ms"] >= rec["ttft_p50_ms"]
+
+
+def test_bench_serving_paged_knob_off(monkeypatch, capsys):
+    """PFX_BENCH_SERVING_PAGED=0 falls back to the PR-5 contiguous
+    per-slot cache and the record says so (page fields zeroed), so
+    perf CI can A/B the two layouts on the identical trace."""
+    monkeypatch.setenv("PFX_BENCH_SERVING_PAGED", "0")
+    monkeypatch.setenv("PFX_BENCH_SERVING_REQUESTS", "3")
+    monkeypatch.setenv("PFX_BENCH_SERVING_MAX_PROMPT", "8")
+    monkeypatch.setenv("PFX_BENCH_SERVING_DEC_LEN", "4")
+    bench.bench_serving()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["paged"] is False
+    assert rec["page_size"] == 0 and rec["pool_pages"] == 0
+    assert rec["value"] > 0
+    assert rec["ttft_p50_ms"] > 0  # TTFT reported on both layouts
 
 
 def test_bench_serving_env_knobs_pin_trace(monkeypatch, capsys):

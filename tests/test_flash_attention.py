@@ -465,6 +465,145 @@ def test_ragged_decode_dispatch_and_counter():
         reg.reset()
 
 
+def _paged_batch(b=4, h=4, d=64, page=128, pool=14, max_pages=3,
+                 seed=21):
+    """Random paged-decode inputs: global KV pool + a page table whose
+    rows map distinct non-null pages (the allocator never maps page 0
+    under a live position)."""
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, 1, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(pool, h, d, page)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(pool, h, d, page)), jnp.float32)
+    ids = rng.permutation(np.arange(1, pool))[:b * max_pages]
+    pt = jnp.asarray(ids.reshape(b, max_pages), jnp.int32)
+    return q, k, v, pt
+
+
+def test_flash_decode_paged_matches_xla_gather():
+    """flash_decode_paged (scalar-prefetch page-table walk) == the XLA
+    oracle run on the gathered contiguous view — including rows whose
+    live length stops mid-page, and rows sharing a physical page."""
+    from paddlefleetx_tpu.ops.attention import _gather_kv_pages
+    from paddlefleetx_tpu.ops.pallas.flash_attention import (
+        flash_decode_paged,
+    )
+    q, k, v, pt = _paged_batch()
+    # row 3 shares row 0's first page (the COW/prefix-sharing shape)
+    pt = pt.at[3, 0].set(pt[0, 0])
+    offs = jnp.asarray([0, 130, 255, 383], jnp.int32)
+    kg, vg = _gather_kv_pages(k, pt), _gather_kv_pages(v, pt)
+    ref = _xla_attention(q, kg, vg, None, True, offs, 0.0, None, True,
+                         True, kv_cache_layout=True)
+    got = flash_decode_paged(q, k, v, offs, pt)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-6, rtol=2e-6)
+    # pages past each row's length are never read: poison every pool
+    # page the rows' live prefixes don't reach
+    live = np.zeros(k.shape[0], bool)
+    for i, off in enumerate(np.asarray(offs)):
+        for j in range(int(off) // 128 + 1):
+            live[int(pt[i, j])] = True
+    poison = jnp.asarray(~live)[:, None, None, None]
+    got2 = flash_decode_paged(q, jnp.where(poison, 1e3, k),
+                              jnp.where(poison, -1e3, v), offs, pt)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(got),
+                               atol=2e-6, rtol=2e-6)
+
+
+def test_flash_decode_paged_identity_table_matches_ragged():
+    """A pool laid out contiguously with an identity page table is the
+    SAME logical cache as the PR-5 contiguous layout, so the paged
+    kernel must reproduce flash_decode_ragged bit-for-tolerance."""
+    from paddlefleetx_tpu.ops.pallas.flash_attention import (
+        flash_decode_paged, flash_decode_ragged,
+    )
+    b, S, page = 4, 256, 128
+    m = S // page
+    q, k, v = _decode_batch(b=b, S=S, seed=22)
+    offs = jnp.asarray([0, 5, 130, 255], jnp.int32)
+    # pool[1 + bi*m + j] holds row bi's logical page j
+    def to_pool(t):
+        t = np.asarray(t)                      # [b, h, d, S]
+        pages = t.reshape(*t.shape[:3], m, page)
+        pool = np.zeros((1 + b * m, t.shape[1], t.shape[2], page),
+                        t.dtype)
+        pool[1:] = pages.transpose(0, 3, 1, 2, 4).reshape(
+            b * m, t.shape[1], t.shape[2], page)
+        return jnp.asarray(pool)
+    pt = jnp.asarray(
+        1 + np.arange(b * m).reshape(b, m), jnp.int32)
+    got = flash_decode_paged(q, to_pool(k), to_pool(v), offs, pt)
+    ref = flash_decode_ragged(q, k, v, offs, block_kv=128)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-6, rtol=2e-6)
+
+
+def test_flash_decode_paged_rejects_bad_shapes():
+    from paddlefleetx_tpu.ops.pallas.flash_attention import (
+        flash_decode_paged,
+    )
+    q, k, v, pt = _paged_batch(b=2, pool=5, max_pages=2, seed=23)
+    offs = jnp.zeros((2,), jnp.int32)
+    with pytest.raises(NotImplementedError):  # bias unsupported
+        flash_decode_paged(q, k, v, offs, pt,
+                           bias=jnp.zeros((2, 1, 1, 256)))
+    with pytest.raises(NotImplementedError):  # multi-token q
+        flash_decode_paged(jnp.concatenate([q, q], 1), k, v, offs, pt)
+    with pytest.raises(NotImplementedError):  # offsets batch mismatch
+        flash_decode_paged(q, k, v, jnp.zeros((3,), jnp.int32), pt)
+    with pytest.raises(NotImplementedError):  # page_table not [b, m]
+        flash_decode_paged(q, k, v, offs, pt[0])
+    with pytest.raises(NotImplementedError):  # pool head mismatch
+        flash_decode_paged(q, k[:, :2], v[:, :2], offs, pt)
+    with pytest.raises(NotImplementedError):  # page not 128-tileable
+        flash_decode_paged(q, k[..., :64], v[..., :64], offs, pt)
+
+
+def test_paged_decode_dispatch_and_counter():
+    """dot_product_attention routes (ragged offsets + page_table) to
+    the paged kernel (counter `attention/flash_decode_paged`) and the
+    kernel-rejected shapes to the dense gather fallback with identical
+    per-row masking — the docs/inference.md paged dispatch row."""
+    from paddlefleetx_tpu.observability import metrics
+    from paddlefleetx_tpu.ops.attention import (
+        _gather_kv_pages, dot_product_attention,
+    )
+    q, k, v, pt = _paged_batch(b=2, pool=7, max_pages=2, seed=24)
+    offs = jnp.asarray([17, 200], jnp.int32)
+    reg = metrics.get_registry()
+    metrics.set_enabled(True)
+    reg.reset()
+    try:
+        out = dot_product_attention(q, k, v, causal=True,
+                                    query_offset=offs, use_flash=True,
+                                    kv_cache_layout=True,
+                                    page_table=pt)
+        assert reg.counter("attention/flash_decode_paged") == 1
+        assert reg.counter("attention/dense") == 0
+        kg, vg = _gather_kv_pages(k, pt), _gather_kv_pages(v, pt)
+        ref = _xla_attention(q, kg, vg, None, True, offs, 0.0, None,
+                             True, True, kv_cache_layout=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-6, rtol=2e-6)
+        # head_dim the kernel rejects -> gather + dense, same masking
+        reg.reset()
+        q2, k2, v2 = q[..., :44], k[:, :, :44], v[:, :, :44]
+        out2 = dot_product_attention(q2, k2, v2, causal=True,
+                                     query_offset=offs, use_flash=True,
+                                     kv_cache_layout=True,
+                                     page_table=pt)
+        assert reg.counter("attention/fallback/kernel_rejected") == 1
+        assert reg.counter("attention/dense") == 1
+        kg2, vg2 = _gather_kv_pages(k2, pt), _gather_kv_pages(v2, pt)
+        ref2 = _xla_attention(q2, kg2, vg2, None, True, offs, 0.0,
+                              None, True, True, kv_cache_layout=True)
+        np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2),
+                                   atol=2e-6, rtol=2e-6)
+    finally:
+        metrics.set_enabled(False)
+        reg.reset()
+
+
 def test_kernel_dropout_gate_and_fallback(monkeypatch):
     """The in-kernel dropout dispatch (PFX_FLASH_DROPOUT=1) must fall
     back to the XLA dense path on CPU (prng has no interpret

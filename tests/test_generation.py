@@ -596,6 +596,37 @@ def test_cache_capacity_rounds_up_to_128(model_and_params):
     assert leaves and all(l.shape[-1] == 128 for l in leaves)
 
 
+def test_kv_page_size_validation_composes_with_capacity():
+    """The paged-cache knobs must compose with `cache_capacity`:
+    `kv_page_size` a multiple of 128 that divides the (already
+    128-rounded) capacity, and `kv_pool_pages` at least
+    `max_kv_pages + 1` (page 0 is the reserved null page AND one
+    request must always fit so preemption can make progress)."""
+    mk = lambda **kw: GPTConfig(vocab_size=96, hidden_size=32,
+                                num_layers=2, num_attention_heads=4,
+                                max_position_embeddings=512, **kw)
+    # defaults: paging off, zero knobs valid
+    cfg = mk()
+    assert cfg.kv_page_size == 0 and cfg.kv_pool_pages == 0
+    # a valid paged config and the derived page count
+    cfg = mk(kv_page_size=128, kv_pool_pages=9)
+    assert cfg.max_kv_pages == 4  # 512 / 128
+    assert mk(kv_page_size=256, kv_pool_pages=3).max_kv_pages == 2
+    with pytest.raises(ValueError):  # pool without a page size
+        mk(kv_page_size=0, kv_pool_pages=8)
+    with pytest.raises(ValueError):  # not a multiple of 128
+        mk(kv_page_size=64, kv_pool_pages=16)
+    with pytest.raises(ValueError):  # does not divide cache_capacity
+        mk(kv_page_size=384, kv_pool_pages=4)
+    with pytest.raises(ValueError):  # pool < max_kv_pages + 1
+        mk(kv_page_size=128, kv_pool_pages=4)
+    # rounding interplay: mpe 129 -> capacity 256 -> 2 pages of 128
+    cfg = GPTConfig(vocab_size=96, hidden_size=32, num_layers=2,
+                    num_attention_heads=4, max_position_embeddings=129,
+                    kv_page_size=128, kv_pool_pages=3)
+    assert cfg.cache_capacity == 256 and cfg.max_kv_pages == 2
+
+
 def test_beam_gather_cache_reorders_under_mp_mesh(model_and_params):
     """Beam search's `_gather_cache` batch reordering must commute
     with an mp mesh whose cache leaves are sharded over heads (the

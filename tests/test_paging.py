@@ -1,0 +1,235 @@
+"""PageAllocator: the host-side state machine under the paged KV cache.
+
+The allocator is pure bookkeeping (no device traffic), which makes it
+cheap to hammer: the randomized trace test below replays thousands of
+admit / grow / COW-split / evict / preempt transitions — the exact
+moves ``core/serving.py`` makes between decode ticks — and asserts
+:meth:`PageAllocator.check`'s invariants after every single one. The
+deterministic tests pin each transition's contract on its own.
+"""
+
+import numpy as np
+import pytest
+
+from paddlefleetx_tpu.core.paging import (
+    NULL_PAGE, PageAllocator, PagePoolExhausted, page_prefix_keys,
+    prompt_key,
+)
+
+
+# -- content keys ------------------------------------------------------
+
+
+def test_page_prefix_keys_chain_over_full_pages():
+    toks = list(range(300))
+    keys = page_prefix_keys(toks, 128)
+    assert len(keys) == 2  # 300 // 128 full pages; the tail hashes not
+    # chain property: key j digests pages 0..j, so sharing any prefix
+    # of full pages means sharing the leading keys
+    other = toks[:256] + [999] * 44
+    assert page_prefix_keys(other, 128) == keys
+    diverge = toks[:128] + [7] + toks[129:]
+    keys2 = page_prefix_keys(diverge, 128)
+    assert keys2[0] == keys[0] and keys2[1] != keys[1]
+
+
+def test_prompt_key_is_length_tagged():
+    a, b = list(range(10)), list(range(12))
+    assert prompt_key(a) != prompt_key(b)
+    assert prompt_key(a) == prompt_key(list(range(10)))
+    assert prompt_key(a).startswith("L10:")
+
+
+# -- allocator basics --------------------------------------------------
+
+
+def test_alloc_release_roundtrip():
+    a = PageAllocator(num_pages=4, page_size=128)
+    assert a.free_pages == 3 and a.pages_in_use == 0
+    p1, p2 = a.alloc(), a.alloc()
+    assert NULL_PAGE not in (p1, p2) and p1 != p2
+    assert a.refcount(p1) == 1 and a.pages_in_use == 2
+    assert a.release(p1) is True  # freed
+    assert a.refcount(p1) == 0 and a.free_pages == 2
+    a.check()
+
+
+def test_alloc_is_deterministic_low_ids_first():
+    a = PageAllocator(num_pages=5, page_size=128)
+    assert [a.alloc() for _ in range(4)] == [1, 2, 3, 4]
+    with pytest.raises(PagePoolExhausted):
+        a.alloc()
+    assert a.try_alloc() is None
+
+
+def test_retain_release_refcounting():
+    a = PageAllocator(num_pages=3, page_size=128)
+    p = a.alloc()
+    assert a.retain(p) == 2
+    assert a.release(p) is False  # still referenced
+    assert a.refcount(p) == 1
+    assert a.release(p) is True
+    with pytest.raises(ValueError):
+        a.release(p)  # double free
+    with pytest.raises(ValueError):
+        a.retain(p)  # retain of a free page
+    a.check()
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError):
+        PageAllocator(num_pages=1, page_size=128)  # only the null page
+    with pytest.raises(ValueError):
+        PageAllocator(num_pages=4, page_size=0)
+
+
+# -- registries --------------------------------------------------------
+
+
+def test_prefix_registry_first_writer_wins_and_dies_with_page():
+    a = PageAllocator(num_pages=5, page_size=2)
+    p1, p2 = a.alloc(), a.alloc()
+    a.register_prefix("k", p1)
+    a.register_prefix("k", p2)  # late duplicate: ignored
+    assert a.lookup_prefix("k") == p1
+    a.release(p1)
+    assert a.lookup_prefix("k") is None  # entry died with the page
+    a.check()
+    with pytest.raises(ValueError):
+        a.register_prefix("k2", p1)  # page is free now
+
+
+def test_prompt_registry_shares_pages_and_payload():
+    a = PageAllocator(num_pages=6, page_size=2)
+    pages = [a.alloc(), a.alloc()]
+    a.register_prompt("P", pages, payload="logits-row")
+    got = a.lookup_prompt("P")
+    assert got == (tuple(pages), "logits-row")
+    # consumer retains every page it maps (the documented contract)
+    for p in got[0]:
+        a.retain(p)
+    # producer evicts; the entry survives because the consumer's refs
+    # keep every member page live
+    for p in pages:
+        assert a.release(p) is False
+    assert a.lookup_prompt("P") is not None
+    a.check()
+    # consumer evicts too -> pages free -> entry and its reverse maps
+    # on OTHER member pages are dropped
+    for p in pages:
+        assert a.release(p) is True
+    assert a.lookup_prompt("P") is None
+    a.check()
+
+
+def test_prompt_registry_partial_release_drops_whole_entry():
+    # one member page dying invalidates the page list, so the entry
+    # must vanish even though the other page is still live
+    a = PageAllocator(num_pages=6, page_size=2)
+    p1, p2 = a.alloc(), a.alloc()
+    a.register_prompt("P", [p1, p2], payload=None)
+    a.release(p1)
+    assert a.lookup_prompt("P") is None
+    assert a.refcount(p2) == 1  # survivor unaffected
+    a.check()
+
+
+def test_register_prompt_rejects_free_pages():
+    a = PageAllocator(num_pages=4, page_size=2)
+    p = a.alloc()
+    a.release(p)
+    with pytest.raises(ValueError):
+        a.register_prompt("P", [p], payload=None)
+
+
+# -- randomized state-machine trace ------------------------------------
+
+
+def test_randomized_admit_evict_preempt_trace():
+    """Replay the server's transition mix against a model: admissions
+    that share via both registries, decode growth, COW splits, and
+    evict/preempt (both release), with ``check()`` after every step
+    and an independent per-request page ledger cross-checked at the
+    end of every request's life."""
+    rng = np.random.default_rng(0)
+    page = 4
+    a = PageAllocator(num_pages=17, page_size=page)  # 16 usable
+    live = {}  # req id -> list of (pid, shared_bool at map time)
+    next_id = 0
+    for step in range(3000):
+        op = rng.choice(["admit", "grow", "cow", "evict"])
+        if op == "admit":
+            # random prompt from a tiny pool so prefix/prompt hits occur
+            base = rng.integers(0, 3)
+            L = int(rng.integers(1, 3 * page + 1))
+            toks = [int(base)] * L  # content-determined sharing
+            hit = a.lookup_prompt(prompt_key(toks))
+            pages = []
+            if hit is not None:
+                for pid in hit[0]:
+                    a.retain(pid)
+                    pages.append(pid)
+            else:
+                keys = page_prefix_keys(toks, page)[:(L - 1) // page]
+                owned_from = 0
+                for k in keys:
+                    pid = a.lookup_prefix(k)
+                    if pid is None:
+                        break
+                    a.retain(pid)
+                    pages.append(pid)
+                    owned_from += 1
+                need = -(-L // page) - owned_from
+                got = []
+                for _ in range(need):
+                    pid = a.try_alloc()
+                    if pid is None:
+                        break
+                    got.append(pid)
+                if len(got) < need:  # pool full: roll back this admit
+                    for pid in got + pages:
+                        a.release(pid)
+                    a.check()
+                    continue
+                pages += got
+                for j, k in enumerate(keys):
+                    a.register_prefix(k, pages[j])
+                a.register_prompt(prompt_key(toks), pages, payload=L)
+            live[next_id] = pages
+            next_id += 1
+        elif op == "grow" and live:
+            rid = int(rng.choice(list(live)))
+            pid = a.try_alloc()
+            if pid is not None:
+                live[rid].append(pid)
+        elif op == "cow" and live:
+            rid = int(rng.choice(list(live)))
+            pages = live[rid]
+            j = int(rng.integers(0, len(pages)))
+            if a.refcount(pages[j]) > 1:  # the server's write gate
+                new = a.try_alloc()
+                if new is not None:
+                    a.release(pages[j])
+                    pages[j] = new
+                    a.stats["cow_splits"] += 1
+        elif op == "evict" and live:
+            rid = int(rng.choice(list(live)))
+            for pid in live.pop(rid):
+                a.release(pid)
+        a.check()
+        # cross-check: pages_in_use equals the distinct pages the
+        # ledger references, and every refcount matches the ledger
+        refs = {}
+        for pages in live.values():
+            for pid in pages:
+                refs[pid] = refs.get(pid, 0) + 1
+        assert a.pages_in_use == len(refs)
+        for pid, n in refs.items():
+            assert a.refcount(pid) == n, (step, pid)
+    # drain everything: the pool must come back whole
+    for rid in list(live):
+        for pid in live.pop(rid):
+            a.release(pid)
+    a.check()
+    assert a.pages_in_use == 0 and a.free_pages == 16
+    assert a.stats["allocs"] == a.stats["frees"]

@@ -246,6 +246,261 @@ def test_inference_engine_surface(model_and_params):
     assert [c.tokens for c in comps] == ref
 
 
+# -- paged KV cache ----------------------------------------------------
+#
+# Same acceptance bar as above, but the server runs the paged cache:
+# global page pool + page-table indirection, chunked prefill
+# interleaved with decode, refcounted COW prefix sharing, and
+# pool-exhaustion preemption. Parity must survive ALL of it.
+
+# one page per slot: the degenerate paged layout (every slot still
+# goes through the page table and the pool)
+PCFG = GPTConfig(vocab_size=96, hidden_size=32, num_layers=2,
+                 num_attention_heads=4, max_position_embeddings=128,
+                 hidden_dropout_prob=0.0,
+                 attention_probs_dropout_prob=0.0)
+# multi-page: 512-capacity slots over 128-token pages, long shared
+# prefixes span pages and chunked prefill takes several ticks
+PCFG512 = GPTConfig(vocab_size=96, hidden_size=32, num_layers=2,
+                    num_attention_heads=4,
+                    max_position_embeddings=512,
+                    hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+
+
+@pytest.fixture(scope="module")
+def paged_model_and_params():
+    model = GPTForPretraining(PCFG)
+    variables = model.init({"params": jax.random.key(0)},
+                           jnp.zeros((1, 8), jnp.int32))
+    return model, variables["params"]
+
+
+@pytest.fixture(scope="module")
+def paged512_model_and_params():
+    model = GPTForPretraining(PCFG512)
+    variables = model.init({"params": jax.random.key(0)},
+                           jnp.zeros((1, 8), jnp.int32))
+    return model, variables["params"]
+
+
+def _drain(srv, done):
+    while srv.pending or srv.occupancy:
+        for c in srv.step():
+            done[c.request_id] = c
+    return done
+
+
+@pytest.mark.parametrize("num_slots,order", [
+    (1, list(range(6))),            # fully sequential
+    (2, [5, 4, 3, 2, 1, 0]),        # reversed admission
+    (3, [2, 0, 4, 1, 5, 3]),        # shuffled admission
+    (6, list(range(6))),            # everything admitted at once
+])
+def test_paged_parity_matrix_greedy(paged_model_and_params, num_slots,
+                                    order):
+    """The parity matrix, paged edition: page-table indirection,
+    chunked prefill, and prompt-registry sharing (PROMPTS has dupes)
+    must all be invisible in the tokens."""
+    model, params = paged_model_and_params
+    gen_cfg = _greedy_cfg()
+    ref = _lockstep(model, params, PROMPTS, gen_cfg)
+    srv = GenerationServer(model, params, gen_cfg,
+                           num_slots=num_slots, page_size=128,
+                           prefill_chunk_pages=1)
+    prompts = [PROMPTS[i] for i in order]
+    comps = srv.run(prompts)
+    assert [c.tokens for c in comps] == [ref[i] for i in order]
+    srv._alloc.check()
+    assert srv._alloc.pages_in_use == 0  # drained pool is whole
+
+
+def test_paged_mid_run_admission_parity(paged512_model_and_params):
+    """Requests submitted mid-decode — including one sharing a
+    multi-page prefix with a live slot and one identical to a live
+    prompt — still complete to their lockstep rows."""
+    model, params = paged512_model_and_params
+    gen_cfg = _greedy_cfg(max_dec=6)
+    rng = np.random.default_rng(3)
+    base = rng.integers(0, EOS, 300).tolist()
+    shared = base[:256] + rng.integers(0, EOS, 20).tolist()
+    prompts = [base, shared, list(base), [7, 8, 9]]
+    ref = _lockstep(model, params, prompts, gen_cfg)
+    srv = GenerationServer(model, params, gen_cfg, num_slots=3,
+                           page_size=128, pool_pages=24,
+                           prefill_chunk_pages=1)
+    done = {}
+    ids = [srv.submit(base)]
+    for _ in range(6):          # prefill (3 chunks) + a few ticks
+        for c in srv.step():
+            done[c.request_id] = c
+    ids += [srv.submit(p) for p in prompts[1:]]
+    _drain(srv, done)
+    assert [done[i].tokens for i in ids] == ref
+    # the staggered trace actually exercised both registries
+    assert srv._alloc.stats["prefix_hits"] >= 1
+    assert srv._alloc.stats["prompt_hits"] >= 1
+    srv._alloc.check()
+    assert srv._alloc.pages_in_use == 0
+
+
+def test_paged_sampling_is_slot_and_pool_independent(
+        paged_model_and_params):
+    """Sampled tokens are a function of (server rng, submission
+    index) — not of slot count, pool size, or chunk size."""
+    model, params = paged_model_and_params
+    gen_cfg = GenerationConfig(max_dec_len=6,
+                               decode_strategy="sampling",
+                               top_k=8, top_p=0.9, temperature=0.7,
+                               eos_token_id=EOS, pad_token_id=PAD)
+    runs = []
+    for num_slots, pool in ((1, 3), (3, 9)):
+        srv = GenerationServer(model, params, gen_cfg,
+                               num_slots=num_slots, page_size=128,
+                               pool_pages=pool,
+                               prefill_chunk_pages=1,
+                               rng=jax.random.key(5))
+        runs.append([c.tokens for c in srv.run(PROMPTS[:4])])
+    assert runs[0] == runs[1]
+
+
+def test_paged_cow_refcounts_on_shared_prompt(
+        paged512_model_and_params):
+    """The COW ledger, step by step: an identical prompt admits by
+    sharing EVERY page of the live producer (refcount 2, zero prefill
+    compute), and the first decode write splits the partial last page
+    — refcounts back to 1, one `cow_splits`, tokens unperturbed."""
+    model, params = paged512_model_and_params
+    gen_cfg = _greedy_cfg(max_dec=6)
+    rng = np.random.default_rng(4)
+    base = rng.integers(0, EOS, 140).tolist()   # full page + partial
+    ref = _lockstep(model, params, [base, base], gen_cfg)
+    srv = GenerationServer(model, params, gen_cfg, num_slots=3,
+                           page_size=128, pool_pages=12,
+                           prefill_chunk_pages=1)
+    done = {}
+    a = srv.submit(base)
+    for _ in range(3):                  # 2 prefill chunks + activate
+        for c in srv.step():
+            done[c.request_id] = c
+    a_pages = [int(p) for p in srv._pt[0, :2]]
+    assert all(srv._alloc.refcount(p) == 1 for p in a_pages)
+    chunks_before = srv.summary()["prefill_chunks"]
+    c_id = srv.submit(base)             # identical -> prompt hit
+    srv._admit()                        # admit WITHOUT a decode tick
+    assert srv._alloc.stats["prompt_hits"] == 1
+    # BEFORE the split: every page shared, including the partial one
+    assert all(srv._alloc.refcount(p) == 2 for p in a_pages)
+    assert srv.summary()["prefill_chunks"] == chunks_before  # no work
+    for c in srv.step():                # first write -> COW split
+        done[c.request_id] = c
+    assert srv._alloc.stats["cow_splits"] >= 1
+    # the full prefix page stays shared; the split page unwound
+    assert srv._alloc.refcount(a_pages[0]) == 2
+    assert srv._alloc.refcount(a_pages[1]) == 1
+    _drain(srv, done)
+    # AFTER: the divergent-write page was split, refcounts unwound,
+    # the pool drained whole, and both rows match lockstep
+    assert srv._alloc.stats["cow_splits"] >= 1
+    assert done[a].tokens == ref[0] and done[c_id].tokens == ref[1]
+    srv._alloc.check()
+    assert srv._alloc.pages_in_use == 0
+    assert srv._alloc.stats["allocs"] == srv._alloc.stats["frees"]
+
+
+def test_paged_pool_exhaustion_preempts_then_readmits(
+        paged512_model_and_params):
+    """Pool-exhaustion preemption end to end: a slot that cannot grow
+    preempts its neighbor (pages released mid-flight), the victim
+    requeues at the FRONT with its generated tokens, readmits after
+    the survivor drains, and still completes its lockstep row — no
+    leaked pages, no corrupted state."""
+    model, params = paged512_model_and_params
+    gen_cfg = _greedy_cfg(max_dec=10)
+    rng = np.random.default_rng(5)
+    # lengths tuned so both slots must grow a page mid-decode while
+    # the pool (4 usable pages) only has one spare
+    pa = rng.integers(0, EOS, 250).tolist()     # 2 pages, grows @256
+    pb = rng.integers(0, EOS, 124).tolist()     # 1 page, grows @128
+    ref = _lockstep(model, params, [pa, pb], gen_cfg)
+    srv = GenerationServer(model, params, gen_cfg, num_slots=2,
+                           page_size=128, pool_pages=5,
+                           prefill_chunk_pages=1)
+    done = {}
+    ids = [srv.submit(pa), srv.submit(pb)]
+    _drain(srv, done)
+    assert srv.summary()["preempted"] >= 1  # somebody got bumped
+    assert [done[i].tokens for i in ids] == ref
+    srv._alloc.check()
+    assert srv._alloc.pages_in_use == 0
+    assert srv._alloc.stats["allocs"] == srv._alloc.stats["frees"]
+
+
+def test_paged_serving_smoke_interpret_kernel(
+        paged512_model_and_params, tmp_path):
+    """CI smoke (`-k smoke`), paged edition: a shared system-prompt
+    prefix and one LONG chunked prefill interleaved with live decode
+    ticks, on the PAGED PALLAS KERNEL in interpret mode with the
+    flight recorder on — the events.jsonl trail feeds CI's
+    failure-diagnostics artifact."""
+    _, params = paged512_model_and_params
+    kcfg = GPTConfig(**{**PCFG512.__dict__,
+                        "use_flash_attention": True})
+    model = GPTForPretraining(kcfg)
+    gen_cfg = _greedy_cfg(max_dec=4)
+    rng = np.random.default_rng(6)
+    system = rng.integers(0, EOS, 130).tolist()
+    p_short = [5, 9, 2]
+    p_long = system + rng.integers(0, EOS, 170).tolist()   # 3 chunks
+    p_follow = system + rng.integers(0, EOS, 20).tolist()
+    ref = _lockstep(model, params, [p_short, p_long, p_follow],
+                    gen_cfg)
+    events = tmp_path / "events.jsonl"
+    metrics.set_enabled(True)
+    reg = metrics.get_registry()
+    reg.reset()
+    try:
+        srv = GenerationServer(model, params, gen_cfg, num_slots=3,
+                               page_size=128, pool_pages=16,
+                               prefill_chunk_pages=1,
+                               events_path=str(events))
+        done = {}
+        ids = [srv.submit(p_short), srv.submit(p_long)]
+        # p_long's prefill chunks interleave p_short's decode ticks;
+        # step until p_long finishes prefilling and publishes its
+        # system-prefix page (at most 3 chunks + slack)
+        from paddlefleetx_tpu.core.paging import page_prefix_keys
+        sys_key = page_prefix_keys(p_long, 128)[0]
+        for _ in range(8):
+            for c in srv.step():
+                done[c.request_id] = c
+            if srv._alloc.lookup_prefix(sys_key) is not None:
+                break
+        assert srv._alloc.lookup_prefix(sys_key) is not None
+        ids.append(srv.submit(p_follow))   # shares system[0:128]
+        _drain(srv, done)
+        assert [done[i].tokens for i in ids] == ref
+        assert reg.counter("attention/flash_decode_paged") >= 1
+        assert reg.counter("serving/prefill_chunks") >= 4
+        assert reg.counter("serving/prefix_hits") >= 1
+        assert reg.counter("serving/cow_splits") == \
+            srv._alloc.stats["cow_splits"]
+        kinds = [json.loads(l)["event"] for l in
+                 events.read_text().splitlines()]
+        assert kinds[0] == "serving_start"
+        assert "serving_prefill_chunk" in kinds
+        assert "serving_admit" in kinds and "serving_evict" in kinds
+        summ = srv.summary()
+        assert summ["paged"] is True and summ["page_size"] == 128
+        assert summ["pages_in_use"] == 0
+        assert summ["prefill_chunks"] >= 4
+        assert summ["ttft_p50_ms"] > 0
+        srv._alloc.check()
+    finally:
+        metrics.set_enabled(False)
+        reg.reset()
+
+
 def test_slot_cache_sharded_under_mp_mesh(model_and_params):
     """Under an mp mesh with the ``cache_slots`` rule active, served
     greedy completions still equal the single-device lockstep rows —
