@@ -395,6 +395,14 @@ class GenerationServer:
                     if pid is None:
                         break
                     shared_pids.append(pid)
+                # chunked prefill resumes at a CHUNK boundary: keep
+                # only a chunk-aligned count of shared pages, or the
+                # chunk-rounded tail below outgrows the page table
+                # (start + n_chunks*chunk can exceed cache_capacity
+                # when start is mid-chunk) — the dropped pages just
+                # recompute locally with the rest of the prompt
+                cpp = self._chunk // self._page
+                del shared_pids[len(shared_pids) - len(shared_pids) % cpp:]
             start = len(shared_pids) * self._page
             n_chunks = -(-(L - start) // self._chunk)
             total_pages = (start + n_chunks * self._chunk) // self._page
@@ -447,6 +455,17 @@ class GenerationServer:
             return
         self._prefilling.popleft()
         del req["prefill_pos"]
+        # the chunk-rounded admission allocated pages for the final
+        # chunk's pad tail too; that KV is never read, so hand those
+        # pages straight back to the pool instead of pinning them (and
+        # the registries below) until evict
+        used = -(-L // self._page)
+        if used < req["num_pages"]:
+            for j in range(used, req["num_pages"]):
+                self._alloc.release(int(self._pt[slot, j]))
+                self._pt[slot, j] = NULL_PAGE
+            req["num_pages"] = used
+            self._pt_dirty = True
         # the last real token sits at chunk row L - 1 - c0
         last = np.asarray(logits[0, L - 1 - c0])
         self._activate(slot, last)

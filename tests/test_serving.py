@@ -501,6 +501,70 @@ def test_paged_serving_smoke_interpret_kernel(
         reg.reset()
 
 
+def test_paged_shared_prefix_chunk_alignment(paged512_model_and_params):
+    """Regression: a shared prefix whose page count is NOT a multiple
+    of ``prefill_chunk_pages`` used to leave the chunked-prefill start
+    mid-chunk, so the chunk-rounded allocation outgrew the page table
+    (IndexError in admission) or wedged the queue head on a tight
+    pool. Sharing must truncate to a chunk boundary instead — and a
+    chunk-ALIGNED prefix must still share every page."""
+    model, params = paged512_model_and_params
+    gen_cfg = _greedy_cfg(max_dec=4)
+    rng = np.random.default_rng(7)
+    sys1 = rng.integers(0, EOS, 130).tolist()
+    # 1-page prefix + tail rounding to full capacity: 398 tokens over
+    # 256-token chunks from start=128 is 5 pages > max_kv_pages=4
+    p_over = sys1[:128] + rng.integers(0, EOS, 270).tolist()
+    sys2 = rng.integers(0, EOS, 260).tolist()
+    p_aligned = sys2[:256] + rng.integers(0, EOS, 44).tolist()
+    prompts = [sys1, sys2, p_over, p_aligned]
+    ref = _lockstep(model, params, prompts, gen_cfg)
+    srv = GenerationServer(model, params, gen_cfg, num_slots=4,
+                           page_size=128, pool_pages=16,
+                           prefill_chunk_pages=2)
+    done = {}
+    ids = [srv.submit(sys1), srv.submit(sys2)]
+    for _ in range(3):      # 1 + 2 chunks: both prefixes registered
+        for c in srv.step():
+            done[c.request_id] = c
+    ids += [srv.submit(p_over), srv.submit(p_aligned)]
+    _drain(srv, done)
+    assert [done[i].tokens for i in ids] == ref
+    # p_aligned mapped both sys2 pages; p_over's lone-page hit was
+    # dropped at the chunk boundary rather than overflowing the table
+    assert srv._alloc.stats["prefix_hits"] == 2
+    srv._alloc.check()
+    assert srv._alloc.pages_in_use == 0
+    assert srv._alloc.stats["allocs"] == srv._alloc.stats["frees"]
+
+
+def test_paged_final_chunk_pad_pages_released(paged512_model_and_params):
+    """The final prefill chunk's pad-only pages return to the pool the
+    moment prefill completes instead of staying pinned until evict: a
+    120-token prompt admitted over 256-token chunks holds
+    ceil(120/128)=1 page while decoding, not the 2 it was chunk-
+    rounded to at admission."""
+    from paddlefleetx_tpu.core.paging import NULL_PAGE
+    model, params = paged512_model_and_params
+    gen_cfg = _greedy_cfg(max_dec=4)
+    rng = np.random.default_rng(8)
+    p = rng.integers(0, EOS, 120).tolist()
+    ref = _lockstep(model, params, [p], gen_cfg)
+    srv = GenerationServer(model, params, gen_cfg, num_slots=1,
+                           page_size=128, pool_pages=16,
+                           prefill_chunk_pages=2)
+    rid = srv.submit(p)
+    srv.step()              # one 256-token chunk completes prefill
+    assert srv._slots[0]["num_pages"] == 1
+    assert srv._alloc.pages_in_use == 1
+    assert all(int(x) == NULL_PAGE for x in srv._pt[0, 1:])
+    done = _drain(srv, {})
+    assert done[rid].tokens == ref[0]
+    srv._alloc.check()
+    assert srv._alloc.pages_in_use == 0
+    assert srv._alloc.stats["allocs"] == srv._alloc.stats["frees"]
+
+
 def test_slot_cache_sharded_under_mp_mesh(model_and_params):
     """Under an mp mesh with the ``cache_slots`` rule active, served
     greedy completions still equal the single-device lockstep rows —
