@@ -38,11 +38,27 @@ fixed-size pages reached through a slot->page table
   (``prefill_chunk_paged``), so admitting a long prompt never stalls
   tokens/s for running slots.
 
+Speculative decoding (``GenerationConfig.spec_method``/``spec_tokens``):
+decode at small batch is latency-bound on the per-step collectives, so
+the tick instead drafts ``k`` tokens per slot from a host draft source
+(``core/spec.py`` — n-gram self-speculation by default), scores the
+whole ``[slots, k+1]`` window in ONE jitted forward (``verify_step``'s
+within-window causal mask over the same ragged/paged attention), and
+commits the per-slot accepted prefix — 1..k+1 tokens per tick, so
+accepting slots advance by different counts (the per-row lengths and
+page tables above are exactly the substrate this needs; pages past a
+slot's accepted point are handed straight back to the pool). Greedy
+speculative output is token-exact vs the non-speculative server.
+
 Telemetry (docs/observability.md): ``serving/slot_occupancy`` and
 ``serving/pages_in_use`` gauges, ``serving/admitted`` /
 ``serving/evicted`` / ``serving/preempted`` / ``serving/prefix_hits``
-/ ``serving/cow_splits`` / ``serving/prefill_chunks`` counters, a
-``serving/decode_tick`` timer, and a tokens/s + TTFT p50/p99 summary;
+/ ``serving/cow_splits`` / ``serving/prefill_chunks`` /
+``serving/decode_tokens`` counters (committed tokens, NOT ticks — with
+spec decode 1 tick != 1 token), the ``serving/spec_drafted`` /
+``serving/spec_accepted`` counters + ``serving/spec_accept_rate``
+gauge, a ``serving/decode_tick`` timer, and a tokens/s + TTFT p50/p99
+summary;
 an optional flight recorder mirrors admissions/evictions to an
 ``events.jsonl`` stream CI's failure-diagnostics artifact collects.
 """
@@ -62,7 +78,7 @@ import numpy as np
 from ..models.gpt.generation import (
     GenerationConfig, _unrolled_twin, activate_slot, copy_kv_pages,
     decode_step, init_page_pool, init_slot_cache, init_slot_state,
-    prefill_chunk_paged, prefill_into_slots,
+    prefill_chunk_paged, prefill_into_slots, verify_step,
 )
 from ..observability import metrics
 from ..observability.recorder import FlightRecorder
@@ -71,6 +87,7 @@ from .paging import (
     NULL_PAGE, PageAllocator, PagePoolExhausted, page_prefix_keys,
     prompt_key,
 )
+from .spec import make_draft_source
 
 
 def default_prefill_buckets(max_prompt_len: int) -> Tuple[int, ...]:
@@ -182,6 +199,15 @@ class GenerationServer:
         self.model, self.params = model, params
         self.gen_cfg = gen_cfg
         self.num_slots = num_slots
+        # speculative decoding: the host draft source proposes, the
+        # jitted verify_step scores/commits; spec-off is the plain
+        # decode_step tick
+        self.spec = gen_cfg.spec_method is not None
+        self._spec_k = gen_cfg.spec_tokens
+        self._draft = make_draft_source(gen_cfg.spec_method) \
+            if self.spec else None
+        self._spec_drafted = 0
+        self._spec_accepted = 0
         self._max_prompt = cfg.max_position_embeddings - gen_cfg.max_dec_len
         if self._max_prompt < 1:
             raise ValueError(
@@ -213,7 +239,9 @@ class GenerationServer:
                    max_dec_len=gen_cfg.max_dec_len,
                    paged=self.paged,
                    page_size=self._page if self.paged else 0,
-                   pool_pages=cfg.kv_pool_pages if self.paged else 0)
+                   pool_pages=cfg.kv_pool_pages if self.paged else 0,
+                   spec=self.spec,
+                   spec_tokens=self._spec_k if self.spec else 0)
         if self.paged:
             logger.info(
                 "GenerationServer (paged): %d slots, %d-page pool of "
@@ -349,7 +377,8 @@ class GenerationServer:
             self._state, jnp.int32(slot), jnp.int32(len(seq)),
             jnp.int32(len(req["tokens"])), jnp.int32(req["nonce"]),
             jnp.asarray(appeared),
-            jnp.asarray(last_logits_row, jnp.float32))
+            jnp.asarray(last_logits_row, jnp.float32),
+            jnp.int32(req.pop("spec_rejected", -1)))
         req["active"] = True
         req["cur_len"] = len(seq)
         self._pt_dirty = True   # decode view must unhide this row
@@ -514,6 +543,11 @@ class GenerationServer:
         prefills prompt+tokens and resumes the sampling stream at the
         preserved dec_count — token-for-token as if never preempted."""
         req = self._slots[victim]
+        if req.get("active") and self.spec:
+            # a pending rejection-residual exclusion must survive the
+            # round trip or the resumed stream's next draw is biased
+            req["spec_rejected"] = int(
+                np.asarray(self._state.rejected)[victim])
         self._release_pages(victim)
         if victim in self._prefilling:
             self._prefilling.remove(victim)
@@ -529,38 +563,46 @@ class GenerationServer:
         self._emit("serving_preempt", request=req["id"], slot=victim,
                    reason="pages", tokens=len(req["tokens"]))
 
-    def _page_maintenance(self) -> None:
-        """Before every decode tick: each active slot's NEXT write
-        position (its current length) must land in a page it owns
-        exclusively — map a fresh page at a page boundary, and split
+    def _page_maintenance(self, window: int = 1) -> None:
+        """Before every decode tick: each active slot's next ``window``
+        write positions (``cur_len .. cur_len + window - 1`` — one for
+        a plain tick, k+1 for a verify tick) must land in pages it owns
+        exclusively — map fresh pages at page boundaries, and split
         shared pages copy-on-write (device page copy + host refcount
-        handoff) at the first divergent write."""
+        handoff) at the first divergent write. Pages mapped for window
+        positions past a verify tick's accepted point are returned to
+        the pool by the post-tick rollback in :meth:`step`."""
         for slot in range(self.num_slots):
             req = self._slots[slot]
             if req is None or not req.get("active"):
                 continue
-            pos = req["cur_len"]
-            if pos >= self.model.config.cache_capacity:
-                continue   # length bound enforced at submit
-            j = pos // self._page
-            if j >= req["num_pages"]:
-                self._pt[slot, j] = self._alloc_or_preempt(slot)
-                req["num_pages"] = j + 1
-                self._pt_dirty = True
-            else:
-                pid = int(self._pt[slot, j])
-                if self._alloc.refcount(pid) > 1:
-                    new = self._alloc_or_preempt(slot)
-                    self._cache = copy_kv_pages(
-                        self._cache, jnp.asarray([pid], jnp.int32),
-                        jnp.asarray([new], jnp.int32))
-                    self._alloc.release(pid)
-                    self._pt[slot, j] = new
+            for w in range(window):
+                pos = req["cur_len"] + w
+                if pos >= self.model.config.cache_capacity:
+                    # length bound enforced at submit; a verify
+                    # window's tail past capacity clips to
+                    # capacity - 1 and is never committed (mmax)
+                    break
+                j = pos // self._page
+                if j >= req["num_pages"]:
+                    self._pt[slot, j] = self._alloc_or_preempt(slot)
+                    req["num_pages"] = j + 1
                     self._pt_dirty = True
-                    self._alloc.stats["cow_splits"] += 1
-                    metrics.inc("serving/cow_splits")
-                    self._emit("serving_cow_split", request=req["id"],
-                               slot=slot, page=j, src=pid, dst=new)
+                else:
+                    pid = int(self._pt[slot, j])
+                    if self._alloc.refcount(pid) > 1:
+                        new = self._alloc_or_preempt(slot)
+                        self._cache = copy_kv_pages(
+                            self._cache, jnp.asarray([pid], jnp.int32),
+                            jnp.asarray([new], jnp.int32))
+                        self._alloc.release(pid)
+                        self._pt[slot, j] = new
+                        self._pt_dirty = True
+                        self._alloc.stats["cow_splits"] += 1
+                        metrics.inc("serving/cow_splits")
+                        self._emit("serving_cow_split",
+                                   request=req["id"], slot=slot,
+                                   page=j, src=pid, dst=new)
 
     def _evict(self, slot: int, reason: str) -> Completion:
         req = self._slots[slot]
@@ -605,7 +647,8 @@ class GenerationServer:
 
     def step(self) -> List[Completion]:
         """Admit what fits, advance at most one prefill chunk (paged),
-        tick every ACTIVE slot one token, evict and return whatever
+        tick every ACTIVE slot — one token plain, 1..k+1 committed
+        tokens speculative — then evict and return whatever
         finished."""
         self._admit()
         reg = metrics.get_registry()
@@ -613,49 +656,113 @@ class GenerationServer:
             self._prefill_pump()
             reg.set_gauge("serving/pages_in_use",
                           self._alloc.pages_in_use)
-        active_any = any(
-            r is not None and (not self.paged or r.get("active"))
-            for r in self._slots)
-        if not active_any:
+        live = [s for s, r in enumerate(self._slots)
+                if r is not None and (not self.paged or r.get("active"))]
+        if not live:
             # nothing decodable yet (empty, or every occupant is still
             # mid-chunked-prefill) — the pump above still made progress
             reg.set_gauge("serving/slot_occupancy", self.occupancy)
             return []
         t0 = time.time()
         with reg.timer("serving/decode_tick"):
-            if self.paged:
-                # growth/COW decisions against the PRE-tick lengths —
-                # the tick's write position — then one table upload
-                self._page_maintenance()
-                self._sync_pt()
-                self._cache, self._state, tok = decode_step(
-                    self.model, self.params, self._cache, self._state,
-                    self._rng, self.gen_cfg, self._pt_dev_dec)
+            if self.spec:
+                # host drafts ride down with the tick; inactive rows
+                # are zeros the verify mask never commits
+                k = self._spec_k
+                drafts = np.zeros((self.num_slots, k), np.int32)
+                for slot in live:
+                    req = self._slots[slot]
+                    drafts[slot] = self._draft.propose(
+                        req["prompt"] + req["tokens"], k)
+                if self.paged:
+                    # growth/COW decisions cover the whole k+1-token
+                    # write window — then one table upload
+                    self._page_maintenance(window=k + 1)
+                    self._sync_pt()
+                    self._cache, self._state, window, counts = \
+                        verify_step(
+                            self.model, self.params, self._cache,
+                            self._state, jnp.asarray(drafts),
+                            self._rng, self.gen_cfg, self._pt_dev_dec)
+                else:
+                    self._cache, self._state, window, counts = \
+                        verify_step(
+                            self.model, self.params, self._cache,
+                            self._state, jnp.asarray(drafts),
+                            self._rng, self.gen_cfg)
+                window = np.asarray(window)   # device sync in-timer
+                counts = np.asarray(counts)
             else:
-                self._cache, self._state, tok = decode_step(
-                    self.model, self.params, self._cache, self._state,
-                    self._rng, self.gen_cfg)
-            tok = np.asarray(tok)   # device sync inside the timer
+                if self.paged:
+                    # growth/COW decisions against the PRE-tick
+                    # lengths — the tick's write position — then one
+                    # table upload
+                    self._page_maintenance()
+                    self._sync_pt()
+                    self._cache, self._state, tok = decode_step(
+                        self.model, self.params, self._cache,
+                        self._state, self._rng, self.gen_cfg,
+                        self._pt_dev_dec)
+                else:
+                    self._cache, self._state, tok = decode_step(
+                        self.model, self.params, self._cache,
+                        self._state, self._rng, self.gen_cfg)
+                tok = np.asarray(tok)   # device sync inside the timer
+                window = tok[:, None]
+                counts = np.ones((self.num_slots,), np.int32)
         self._tick_time += time.time() - t0
         self._ticks += 1
         finished = np.asarray(self._state.finished)
         dec_count = np.asarray(self._state.dec_count)
         done: List[Completion] = []
         now = time.time()
-        for slot, req in enumerate(self._slots):
+        committed = 0
+        ticked = 0
+        for slot in live:
+            req = self._slots[slot]
             if req is None or (self.paged and not req.get("active")):
+                # preempted out from under the tick by page
+                # maintenance (pool exhaustion) — nothing committed
                 continue
-            req["tokens"].append(int(tok[slot]))
+            ticked += 1
+            m = int(counts[slot])
+            req["tokens"].extend(int(t) for t in window[slot, :m])
             if "ttft" not in req:
                 req["ttft"] = now - req["submit_t"]
                 self._ttfts.append(req["ttft"])
             if self.paged:
-                req["cur_len"] += 1
-            self._decode_tokens += 1
+                req["cur_len"] += m
+                if self.spec:
+                    # rejected-KV rollback: pages wholly past the
+                    # accepted point go straight back to the pool (the
+                    # partial page's stale columns sit past cur_len
+                    # and are overwritten before any masked read)
+                    used = -(-req["cur_len"] // self._page)
+                    if used < req["num_pages"]:
+                        for j in range(used, req["num_pages"]):
+                            self._alloc.release(int(self._pt[slot, j]))
+                            self._pt[slot, j] = NULL_PAGE
+                        req["num_pages"] = used
+                        self._pt_dirty = True
+            committed += m
+            self._decode_tokens += m
             if finished[slot]:
                 done.append(self._evict(slot, "eos"))
             elif dec_count[slot] >= self.gen_cfg.max_dec_len:
                 done.append(self._evict(slot, "length"))
+        metrics.inc("serving/decode_tokens", committed)
+        if self.spec:
+            drafted = self._spec_k * ticked
+            accepted = committed - ticked      # t0s are not drafts
+            self._spec_drafted += drafted
+            self._spec_accepted += accepted
+            metrics.inc("serving/spec_drafted", drafted)
+            metrics.inc("serving/spec_accepted", accepted)
+            reg.set_gauge(
+                "serving/spec_accept_rate",
+                self._spec_accepted / max(self._spec_drafted, 1))
+            self._emit("serving_spec", drafted=drafted,
+                       accepted=accepted, committed=committed)
         reg.set_gauge("serving/slot_occupancy", self.occupancy)
         return done
 
@@ -686,6 +793,12 @@ class GenerationServer:
             ms = np.asarray(self._ttfts) * 1000.0
             s["ttft_p50_ms"] = round(float(np.percentile(ms, 50)), 3)
             s["ttft_p99_ms"] = round(float(np.percentile(ms, 99)), 3)
+        if self.spec:
+            s["spec_tokens"] = self._spec_k
+            s["spec_drafted"] = self._spec_drafted
+            s["spec_accepted"] = self._spec_accepted
+            s["spec_accept_rate"] = round(
+                self._spec_accepted / max(self._spec_drafted, 1), 4)
         if self.paged:
             s["paged"] = True
             s["page_size"] = self._page

@@ -71,8 +71,33 @@ class GenerationConfig:
     #: that from its own noise. Set False for sort-exact candidate
     #: sets. Beam search ignores this and always scores exactly.
     approx_top_k: bool = True
+    #: speculative decoding on the slot server (core/serving.py):
+    #: None = off; "ngram" = draft-model-free self-speculation — each
+    #: request's own emitted history proposes ``spec_tokens`` draft
+    #: tokens by suffix match (core/spec.py) and ONE verify forward
+    #: scores the whole run (verify_step). The interface is a draft
+    #: SOURCE, so a small draft-model method can slot in later.
+    spec_method: Optional[str] = None
+    #: drafted tokens per verify tick (k); each tick commits
+    #: 1..k+1 tokens. Only read when spec_method is set.
+    spec_tokens: int = 4
 
     def __post_init__(self):
+        if self.spec_method is not None:
+            if self.spec_method not in ("ngram",):
+                raise ValueError(
+                    f"unknown spec_method {self.spec_method!r} "
+                    f"(supported: 'ngram')")
+            if self.spec_tokens < 1:
+                raise ValueError(
+                    f"spec_tokens must be >= 1, got "
+                    f"{self.spec_tokens}")
+            if self.decode_strategy == "beam_search":
+                raise ValueError(
+                    "speculative decoding (spec_method) serves "
+                    "sampling/greedy_search only; beam search scores "
+                    "every candidate exactly and stays on the "
+                    "lockstep generate() path")
         if self.num_return_sequences < 1:
             raise ValueError(
                 f"num_return_sequences must be >= 1, got "
@@ -500,6 +525,12 @@ class SlotState(NamedTuple):
     active: jax.Array
     #: [slots, V] f32 — logits the next tick samples from
     last_logits: jax.Array
+    #: [slots] int32 — draft token the previous verify tick REJECTED
+    #: under sampling (-1 = none): the standard rejection-sampling
+    #: residual excludes it, so the next tick's sample from
+    #: ``last_logits`` masks it out post-filter (verify_step). Always
+    #: -1 under greedy and with speculation off.
+    rejected: jax.Array
 
 
 def init_slot_state(num_slots: int, vocab_size: int) -> SlotState:
@@ -510,7 +541,8 @@ def init_slot_state(num_slots: int, vocab_size: int) -> SlotState:
         lengths=z, dec_count=z, nonce=z,
         appeared=jnp.zeros((num_slots, vocab_size), bool),
         finished=f, active=f,
-        last_logits=jnp.zeros((num_slots, vocab_size), jnp.float32))
+        last_logits=jnp.zeros((num_slots, vocab_size), jnp.float32),
+        rejected=jnp.full((num_slots,), -1, jnp.int32))
 
 
 def init_slot_cache(model, params, num_slots: int):
@@ -598,7 +630,8 @@ def prefill_into_slots(model, params, cache, state: SlotState,
         appeared=state.appeared.at[slot_ids].set(appeared),
         finished=state.finished.at[slot_ids].set(False),
         active=state.active.at[slot_ids].set(True),
-        last_logits=state.last_logits.at[slot_ids].set(last))
+        last_logits=state.last_logits.at[slot_ids].set(last),
+        rejected=state.rejected.at[slot_ids].set(-1))
     return cache, state
 
 
@@ -673,8 +706,171 @@ def decode_step(model, params, cache, state: SlotState,
         appeared=appeared,
         finished=finished,
         active=state.active,
-        last_logits=logits2[:, -1].astype(jnp.float32))
+        last_logits=logits2[:, -1].astype(jnp.float32),
+        rejected=state.rejected)
     return cache, new_state, token
+
+
+#: fold_in salt separating a verify tick's ACCEPT uniform at request
+#: step c+j from the categorical the NEXT tick draws at the same step
+#: when that draft is rejected (the correction token) — without it the
+#: two draws would share a key and correlate, breaking the
+#: rejection-sampling guarantee.
+SPEC_ACCEPT_SALT = 7919
+
+
+@partial(jax.jit, static_argnames=("model", "gen_cfg"))
+def verify_step(model, params, cache, state: SlotState,
+                drafts: jax.Array, rng: jax.Array,
+                gen_cfg: GenerationConfig, page_table=None):
+    """One SPECULATIVE tick: score ``k`` drafted tokens per slot in a
+    single forward and commit the accepted prefix (+1 sampled token).
+
+    ``drafts [slots, k]`` are the host draft source's guesses for each
+    request's NEXT k tokens AFTER the one this tick samples
+    (``core/spec.py``; draft content only affects throughput, never
+    output). The tick:
+
+    1. samples ``t0`` from ``last_logits`` through exactly
+       :func:`decode_step`'s processor/sampling pipeline (same
+       ``(nonce, dec_count)`` key fold — the spec-off stream), with
+       the previous tick's ``rejected`` draft masked out post-filter
+       (the rejection-sampling residual);
+    2. runs the model ONCE over the ``[slots, k+1]`` window
+       ``[t0, d_1..d_k]`` at positions ``lengths .. lengths + k``
+       (ragged multi-token cache writes + the within-window causal
+       verify mask — ``flash_decode_ragged``/``flash_decode_paged``
+       or the XLA fallback, docs/inference.md);
+    3. walks the drafts left to right: draft ``d_j`` is committed iff
+       every earlier window token committed, none of them was EOS,
+       the per-request budget allows it (``dec_count + j <
+       max_dec_len`` — the sequential server would have evicted), and
+       it passes the accept test — greedy: ``d_j`` equals the argmax
+       of the processed logits at its position (teacher-forced logits
+       are the sequential logits, so greedy output is token-exact
+       spec-off); sampling: a salted per-step uniform under the
+       draft's model probability (deterministic draft proposal ⇒ the
+       standard rejection rule accepts with prob ``p(d_j)`` and the
+       residual excludes ``d_j``, recorded in ``rejected`` for the
+       next tick).
+
+    Rejected KV needs no device-side undo: lengths only advance by the
+    committed count, so the next window overwrites the stale columns
+    before any masked read reaches them (paged: the server frees/nulls
+    pages past the accepted point).
+
+    Returns ``(cache, state, window, counts)`` — ``window [slots,
+    k+1]`` holds the tick's token run (entry 0 = ``t0``), ``counts
+    [slots]`` how many of them committed (1..k+1; the host appends
+    ``window[slot, :counts[slot]]``).
+    """
+    slots, k = drafts.shape
+    vocab = model.config.vocab_size
+    eos, pad = gen_cfg.eos_token_id, gen_cfg.pad_token_id
+    arange_s = jnp.arange(slots)
+
+    def processed(raw, appeared, dec_count):
+        lg = repetition_penalty_processor(
+            raw, appeared, gen_cfg.repetition_penalty)
+        return min_length_processor(
+            lg, dec_count[:, None], gen_cfg.min_dec_len, eos)
+
+    def step_keys(dec_count, salt=None):
+        def one(n, c):
+            kk = jax.random.fold_in(jax.random.fold_in(rng, n), c)
+            return kk if salt is None else jax.random.fold_in(kk, salt)
+        return jax.vmap(one)(state.nonce, dec_count)
+
+    # -- t0: decode_step's sampling pipeline, residual-masked ---------
+    logits = processed(state.last_logits, state.appeared,
+                       state.dec_count)
+    if gen_cfg.decode_strategy == "greedy_search":
+        t0 = jnp.argmax(logits, axis=-1)
+    elif gen_cfg.decode_strategy == "sampling":
+        lg = logits / jnp.maximum(gen_cfg.temperature, 1e-6)
+        lg = top_k_top_p_filter(lg, gen_cfg.top_k, gen_cfg.top_p,
+                                approx=gen_cfg.approx_top_k)
+        # rejection-sampling residual: the draft the PREVIOUS tick
+        # rejected is excluded from this draw (-1 matches nothing, so
+        # spec-off slots sample bit-identically to decode_step)
+        lg = jnp.where(
+            jnp.arange(vocab)[None, :] == state.rejected[:, None],
+            NEG_INF, lg)
+        t0 = jax.vmap(
+            lambda kk, row: jax.random.categorical(kk, row))(
+            step_keys(state.dec_count), lg)
+    else:
+        raise ValueError(
+            f"verify_step supports sampling/greedy_search, got "
+            f"{gen_cfg.decode_strategy!r}")
+    t0 = jnp.where(state.finished | ~state.active,
+                   pad, t0).astype(jnp.int32)
+
+    # -- one forward over the [slots, k+1] window ---------------------
+    window = jnp.concatenate(
+        [t0[:, None], jnp.asarray(drafts, jnp.int32)], axis=1)
+    mpe = model.config.max_position_embeddings
+    pos = jnp.clip(
+        state.lengths[:, None] +
+        jnp.arange(k + 1, dtype=jnp.int32)[None, :], 0, mpe - 1)
+    logits2, mutated = model.apply(
+        {"params": params, "cache": cache}, window,
+        position_ids=pos, use_cache=True, deterministic=True,
+        cache_lengths=state.lengths, page_table=page_table,
+        mutable=["cache"])
+    cache = _constrain_slot_cache(mutated["cache"])
+    logits_w = logits2.astype(jnp.float32)     # [slots, k+1, V]
+
+    # -- vectorized accept/reject, left to right ----------------------
+    fin = state.finished | (state.active & (t0 == eos))
+    appeared = state.appeared.at[arange_s, t0].set(True)
+    commit = jnp.ones((slots,), bool)          # t0 always emits
+    counts = jnp.ones((slots,), jnp.int32)
+    rejected_new = jnp.full((slots,), -1, jnp.int32)
+    mmax = gen_cfg.max_dec_len - state.dec_count
+    for j in range(1, k + 1):
+        dj = window[:, j]
+        lg = processed(logits_w[:, j - 1], appeared,
+                       state.dec_count + j)
+        if gen_cfg.decode_strategy == "greedy_search":
+            ok = dj == jnp.argmax(lg, axis=-1)
+        else:
+            lg = lg / jnp.maximum(gen_cfg.temperature, 1e-6)
+            lg = top_k_top_p_filter(lg, gen_cfg.top_k, gen_cfg.top_p,
+                                    approx=gen_cfg.approx_top_k)
+            p = jax.nn.softmax(lg, axis=-1)
+            pj = jnp.take_along_axis(p, dj[:, None], axis=1)[:, 0]
+            u = jax.vmap(jax.random.uniform)(
+                step_keys(state.dec_count + j, SPEC_ACCEPT_SALT))
+            ok = u < pj
+        can = commit & ~fin & state.active & (j < mmax)
+        cj = can & ok
+        if gen_cfg.decode_strategy == "sampling":
+            # at most one (can & ~ok) per slot — commit chains stop at
+            # the first rejection
+            rejected_new = jnp.where(can & ~ok, dj, rejected_new)
+        commit = cj
+        counts = counts + cj
+        appeared = appeared.at[arange_s, dj].max(cj)
+        fin = fin | (cj & (dj == eos))
+
+    new_state = SlotState(
+        lengths=jnp.where(state.active, state.lengths + counts,
+                          state.lengths),
+        dec_count=jnp.where(state.active, state.dec_count + counts,
+                            state.dec_count),
+        nonce=state.nonce,
+        appeared=appeared,
+        finished=fin,
+        active=state.active,
+        # the logits AFTER the last committed token — the next tick's
+        # t0 distribution (on a rejection this is the residual's
+        # source distribution; combined with the `rejected` mask it
+        # completes the rejection-sampling rule)
+        last_logits=jnp.take_along_axis(
+            logits_w, (counts - 1)[:, None, None], axis=1)[:, 0],
+        rejected=rejected_new)
+    return cache, new_state, window, counts
 
 
 # -- paged KV primitives (core/paging.py owns the host bookkeeping) ----
@@ -759,13 +955,16 @@ def copy_kv_pages(cache, src: jax.Array, dst: jax.Array):
 def activate_slot(state: SlotState, slot: jax.Array,
                   length: jax.Array, dec_count: jax.Array,
                   nonce: jax.Array, appeared_row: jax.Array,
-                  last_logits_row: jax.Array) -> SlotState:
+                  last_logits_row: jax.Array,
+                  rejected: jax.Array) -> SlotState:
     """Flip one slot live from host-computed state — the paged
     admission paths (chunked-prefill completion, whole-prompt registry
     hit, preempted-request resume) activate through here instead of
     ``prefill_into_slots``'s scatter. ``dec_count`` is nonzero only
     for resumes, so a requeued request's min-length processing and
-    sampling stream continue exactly where they stopped."""
+    sampling stream continue exactly where they stopped; ``rejected``
+    (-1 outside resumes of a speculative sampling server) likewise
+    restores a pending rejection-residual exclusion (verify_step)."""
     slot = jnp.asarray(slot, jnp.int32)
     return SlotState(
         lengths=state.lengths.at[slot].set(
@@ -776,7 +975,9 @@ def activate_slot(state: SlotState, slot: jax.Array,
         appeared=state.appeared.at[slot].set(appeared_row),
         finished=state.finished.at[slot].set(False),
         active=state.active.at[slot].set(True),
-        last_logits=state.last_logits.at[slot].set(last_logits_row))
+        last_logits=state.last_logits.at[slot].set(last_logits_row),
+        rejected=state.rejected.at[slot].set(
+            jnp.asarray(rejected, jnp.int32)))
 
 
 def left_pad_batch(sequences, pad_id: int):

@@ -249,23 +249,38 @@ class MultiHeadAttention(nn.Module):
                 (cfg.kv_pool_pages, nh, hd, page), dtype)
             pt = jnp.asarray(page_table, jnp.int32)
             if cache_lengths is not None:
-                if x.shape[1] != 1:
-                    raise ValueError(
-                        "paged ragged decode (cache_lengths) is "
-                        "single-token only; multi-token writes go "
-                        "through chunk_start")
-                pos = jnp.clip(
+                base = jnp.clip(
                     jnp.asarray(cache_lengths, jnp.int32), 0,
                     cfg.cache_capacity - 1)
-                pid = jnp.take_along_axis(
-                    pt, (pos // page)[:, None], axis=1)[:, 0]
-                cache_k.value = cache_k.value.at[pid, :, :,
-                                                 pos % page].set(
-                    k.transpose(0, 2, 3, 1)[..., 0])
-                cache_v.value = cache_v.value.at[pid, :, :,
-                                                 pos % page].set(
-                    v.transpose(0, 2, 3, 1)[..., 0])
-                query_offset = pos                      # [b]
+                if x.shape[1] == 1:
+                    pid = jnp.take_along_axis(
+                        pt, (base // page)[:, None], axis=1)[:, 0]
+                    cache_k.value = cache_k.value.at[pid, :, :,
+                                                     base % page].set(
+                        k.transpose(0, 2, 3, 1)[..., 0])
+                    cache_v.value = cache_v.value.at[pid, :, :,
+                                                     base % page].set(
+                        v.transpose(0, 2, 3, 1)[..., 0])
+                else:
+                    # speculative verify window: row i's W tokens land
+                    # at positions lengths[i] .. lengths[i] + W - 1,
+                    # each resolved through the page table (the server
+                    # pre-maps/COWs every page the window touches —
+                    # _page_maintenance(window)). Positions clipped at
+                    # capacity land in the last column, which is never
+                    # read before eviction (commit clamp). Advanced
+                    # indexing on dims 0 and 3 puts the index dims
+                    # first, so the value IS k/v's native [b, W, h, d].
+                    wpos = jnp.clip(
+                        jnp.asarray(cache_lengths, jnp.int32)[:, None]
+                        + jnp.arange(x.shape[1], dtype=jnp.int32)[
+                            None, :], 0, cfg.cache_capacity - 1)
+                    pid = jnp.take_along_axis(pt, wpos // page, axis=1)
+                    cache_k.value = cache_k.value.at[
+                        pid, :, :, wpos % page].set(k)
+                    cache_v.value = cache_v.value.at[
+                        pid, :, :, wpos % page].set(v)
+                query_offset = base                     # [b]
             elif chunk_start is not None:
                 c = x.shape[1]
                 if c % page:
@@ -327,20 +342,33 @@ class MultiHeadAttention(nn.Module):
                 # per-row-offset fallback). cache_index is left
                 # untouched: the slot lengths live with the server's
                 # SlotState, not in the cache collection.
-                if x.shape[1] != 1:
-                    raise ValueError(
-                        "cache_lengths (ragged slot decode) is "
-                        "single-token only; prefill writes at offset 0 "
-                        "through the scalar cache_index path")
                 rows = jnp.arange(x.shape[0])
-                pos = jnp.clip(
+                base = jnp.clip(
                     jnp.asarray(cache_lengths, jnp.int32), 0,
                     capacity - 1)
-                cache_k.value = cache_k.value.at[rows, :, :, pos].set(
-                    k.transpose(0, 2, 3, 1)[..., 0])
-                cache_v.value = cache_v.value.at[rows, :, :, pos].set(
-                    v.transpose(0, 2, 3, 1)[..., 0])
-                query_offset = pos                      # [b]
+                if x.shape[1] == 1:
+                    cache_k.value = cache_k.value.at[
+                        rows, :, :, base].set(
+                        k.transpose(0, 2, 3, 1)[..., 0])
+                    cache_v.value = cache_v.value.at[
+                        rows, :, :, base].set(
+                        v.transpose(0, 2, 3, 1)[..., 0])
+                else:
+                    # speculative verify window (see the paged branch
+                    # above): scatter row i's W columns at
+                    # lengths[i] .. lengths[i] + W - 1; rejected
+                    # columns are overwritten by the next window
+                    # before any read (the next tick's window starts
+                    # at the accepted length)
+                    wpos = jnp.clip(
+                        jnp.asarray(cache_lengths, jnp.int32)[:, None]
+                        + jnp.arange(x.shape[1], dtype=jnp.int32)[
+                            None, :], 0, capacity - 1)
+                    cache_k.value = cache_k.value.at[
+                        rows[:, None], :, :, wpos].set(k)
+                    cache_v.value = cache_v.value.at[
+                        rows[:, None], :, :, wpos].set(v)
+                query_offset = base                     # [b]
             else:
                 idx = cache_index.value
                 cache_k.value = jax.lax.dynamic_update_slice(

@@ -126,6 +126,15 @@ def _kernel_dropout_enabled() -> bool:
 # break-even moves with TPU generation and head dim; retune here.
 DENSE_NONCAUSAL_MAX_SKV = 2048
 
+# Widest multi-token window the VERIFY decode kernels take
+# (speculative k-token verification, k+1 <= this). Chunked paged
+# prefill also arrives as per-row-offset multi-token attention but in
+# page-sized chunks (>= 128 tokens), far past any sane draft length —
+# this bound keeps it on the gather + dense path the kernels were
+# never shaped for (the verify kernel unrolls its window statically,
+# so a huge window would also explode the program).
+MAX_VERIFY_WINDOW = 32
+
 
 def _gather_kv_pages(pool, page_table):
     """Resolve a paged KV pool back to per-row contiguous layout: the
@@ -203,7 +212,12 @@ def dot_product_attention(
     PAGED pool ``[num_pages, h, d, page]`` and each row's logical
     cache is ``page_table[row]``'s pages in order (``core/paging.py``).
     Single-token ragged decode takes ``flash_decode_paged``
-    (``attention/flash_decode_paged`` counter); everything else —
+    (``attention/flash_decode_paged`` counter); a short multi-token
+    window (``1 < sq <= MAX_VERIFY_WINDOW``, per-row offsets, no
+    bias) is the speculative k-token VERIFY and takes the same kernel
+    with the within-window causal mask
+    (``attention/flash_decode_paged_verify`` /
+    ``attention/flash_decode_ragged_verify``); everything else —
     chunked prefill, kernel rejection, ``use_flash=False`` — gathers
     the rows contiguous (:func:`_gather_kv_pages`) and rides the
     per-row-offset dense path (dispatch matrix: docs/inference.md).
@@ -265,9 +279,19 @@ def dot_product_attention(
                                                 page_table)
                     metrics.inc("attention/flash_decode_paged")
                     return out
-                # chunked prefill (sq > 1) and other paged shapes fall
-                # through to the shared kv_cache_layout fallback
-                # counter and the gather + dense path below
+                if causal and 1 < q.shape[1] <= MAX_VERIFY_WINDOW \
+                        and bias is None \
+                        and getattr(query_offset, "ndim", 0) == 1:
+                    # speculative k-token verify over the paged pool:
+                    # same table walk, within-window causal mask
+                    # (docs/inference.md, speculative decoding)
+                    out = fa.flash_decode_paged(q, k, v, query_offset,
+                                                page_table)
+                    metrics.inc("attention/flash_decode_paged_verify")
+                    return out
+                # chunked prefill (page-sized sq) and other paged
+                # shapes fall through to the shared kv_cache_layout
+                # fallback counter and the gather + dense path below
             elif decode_bias_ok and kv_cache_layout:
                 if getattr(query_offset, "ndim", 0) == 1:
                     # ragged slot decode: a [b] offset vector (the
@@ -283,6 +307,15 @@ def dot_product_attention(
                 out = fa.flash_decode(q, k, v, query_offset,
                                       bias=bias)
                 metrics.inc("attention/flash_decode")
+                return out
+            elif kv_cache_layout and causal and bias is None \
+                    and 1 < q.shape[1] <= MAX_VERIFY_WINDOW \
+                    and getattr(query_offset, "ndim", 0) == 1:
+                # speculative k-token verify over the contiguous slot
+                # cache: window query j of row i masks keys
+                # <= query_offset[i] + j (within-window causal mask)
+                out = fa.flash_decode_ragged(q, k, v, query_offset)
+                metrics.inc("attention/flash_decode_ragged_verify")
                 return out
             # non-causal at short seq: the dense XLA batched matmul
             # beats the kernel (measured on ERNIE h=768/s=512/d=64:

@@ -1123,16 +1123,84 @@ def _decode_kernel(off_ref, q_ref, k_ref, v_ref, *refs, sm_scale,
             o_ref.dtype)
 
 
+def _verify_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+                   acc_scr, *, sm_scale, block_kv, num_kv, window,
+                   ragged=True):
+    """Speculative k-token VERIFY over the KV cache: ``window`` query
+    tokens per row, where query ``j`` sits at cache position
+    ``offset + j`` and attends keys ``<= offset + j`` — the
+    within-window causal mask speculative decoding needs to score a
+    drafted token run in ONE pass (docs/inference.md).
+
+    Per query the math is exactly :func:`_decode_kernel`'s matvec +
+    online softmax (a static Python loop over ``j`` unrolls into
+    ``window`` independent VPU passes sharing each resident KV block),
+    so greedy verification is bit-compatible with sequential
+    single-token decode: a block wholly past query ``j``'s last live
+    position contributes masked-out scores only (``alpha == 1``,
+    ``p == 0`` — block 0 is always live, so ``m`` is finite before any
+    dead block arrives) and the running ``m/l/acc`` state passes
+    through unchanged. Scratch carries one ``[h, 1]`` / ``[h, d]``
+    state row per window position. No bias operand (serving decode
+    carries none — per-slot validity lives in the offsets)."""
+    ki = pl.program_id(1)
+    offset = off_ref[pl.program_id(0)] if ragged else off_ref[0]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # a block participates when ANY window query can see it; per-query
+    # liveness is the mask below
+    @pl.when(ki * block_kv <= offset + (window - 1))
+    def _block():
+        k_pos = ki * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_kv), 1)
+        k = k_ref[0].astype(jnp.float32)           # [h, d, bkv]
+        v = v_ref[0].astype(jnp.float32)
+        for j in range(window):
+            live = k_pos <= offset + j             # [1, bkv]
+            qj = q_ref[0, :, :, j].astype(jnp.float32)   # [h, d]
+            s = jnp.sum(qj[:, :, None] * k, axis=1) * sm_scale
+            s = jnp.where(live, s, NEG_INF)        # [h, bkv]
+            m_prev = m_scr[j]                      # [h, 1]
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(s, axis=1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            l_scr[j] = l_scr[j] * alpha + jnp.sum(p, axis=1,
+                                                  keepdims=True)
+            acc_scr[j] = acc_scr[j] * alpha + jnp.sum(p[:, None, :] * v,
+                                                      axis=2)
+            m_scr[j] = m_new
+
+    @pl.when(ki == num_kv - 1)
+    def _finish():
+        o = acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)   # [W, h, d]
+        o_ref[0] = o.transpose(1, 2, 0).astype(o_ref.dtype)
+
+
 def _flash_decode_call(q, k, v, off, bias, block_kv: int, ragged: bool):
     """Shared shape-check + ``pallas_call`` builder behind
     :func:`flash_decode` (``off [1]``, one shared cache index) and
-    :func:`flash_decode_ragged` (``off [b]``, per-slot lengths). Raises
-    NotImplementedError where the caller must fall back to XLA."""
+    :func:`flash_decode_ragged` (``off [b]``, per-slot lengths). With
+    ``sq > 1`` the queries are a speculative VERIFY window — query
+    ``j`` of row ``i`` sits at position ``off[i] + j`` and the
+    windowed kernel (:func:`_verify_kernel`) applies the within-window
+    causal mask; bias is single-token only. Raises NotImplementedError
+    where the caller must fall back to XLA."""
     if jax.default_backend() != "tpu" and not _interpret():
         raise NotImplementedError("flash kernel targets TPU")
     b, sq, h, d = q.shape
-    if sq != 1:
-        raise NotImplementedError("flash_decode is single-token only")
+    window = sq
+    if window < 1:
+        raise NotImplementedError("empty decode window")
+    if window > 1 and bias is not None:
+        raise NotImplementedError(
+            "verify window (sq > 1) takes no bias (per-slot validity "
+            "is the offsets')")
     skv = k.shape[3]
     # largest 128-aligned divisor <= block_kv: capacities that are
     # 128-multiples but not block_kv-multiples (e.g. 1280) stay on the
@@ -1155,8 +1223,8 @@ def _flash_decode_call(q, k, v, off, bias, block_kv: int, ragged: bool):
         raise NotImplementedError(f"head_dim {d} unsupported")
     num_kv = skv // block_kv
 
-    # [b, 1, h, d] -> [b, h, d, 1]: the query token as a lane-1
-    # column per head, matching the cache's d-major tiles
+    # [b, W, h, d] -> [b, h, d, W]: the query token(s) as lane
+    # column(s) per head, matching the cache's d-major tiles
     qp = q.transpose(0, 2, 3, 1)
 
     # clamp the kv block index once past the live length: skipped
@@ -1165,13 +1233,16 @@ def _flash_decode_call(q, k, v, off, bias, block_kv: int, ragged: bool):
     # cache it has actually filled (the compute skip alone would
     # still stream the full capacity). Ragged, each ROW clamps
     # against its own length — the per-slot cost model of the
-    # continuous-batching server.
+    # continuous-batching server. A verify window's LAST query
+    # (position off + window - 1) sets the walk bound; earlier
+    # queries just mask the tail blocks out.
     def kv_block(bi, ki, off):
-        row = off[bi] if ragged else off[0]
+        row = (off[bi] if ragged else off[0]) + (window - 1)
         return jnp.minimum(ki, row // block_kv)
 
     in_specs = [
-        pl.BlockSpec((1, h, d, 1), lambda bi, ki, off: (bi, 0, 0, 0)),
+        pl.BlockSpec((1, h, d, window),
+                     lambda bi, ki, off: (bi, 0, 0, 0)),
         pl.BlockSpec((1, h, d, block_kv),
                      lambda bi, ki, off: (bi, 0, 0,
                                           kv_block(bi, ki, off))),
@@ -1190,10 +1261,25 @@ def _flash_decode_call(q, k, v, off, bias, block_kv: int, ragged: bool):
             (1, 1, block_kv),
             lambda bi, ki, off: (bi, 0, kv_block(bi, ki, off))))
 
-    kernel = functools.partial(_decode_kernel, sm_scale=d ** -0.5,
-                               block_kv=block_kv, num_kv=num_kv,
-                               has_bias=bias is not None,
-                               ragged=ragged)
+    if window == 1:
+        kernel = functools.partial(_decode_kernel, sm_scale=d ** -0.5,
+                                   block_kv=block_kv, num_kv=num_kv,
+                                   has_bias=bias is not None,
+                                   ragged=ragged)
+        scratch = [
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ]
+    else:
+        kernel = functools.partial(_verify_kernel, sm_scale=d ** -0.5,
+                                   block_kv=block_kv, num_kv=num_kv,
+                                   window=window, ragged=ragged)
+        scratch = [
+            pltpu.VMEM((window, h, 1), jnp.float32),
+            pltpu.VMEM((window, h, 1), jnp.float32),
+            pltpu.VMEM((window, h, d), jnp.float32),
+        ]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -1201,17 +1287,13 @@ def _flash_decode_call(q, k, v, off, bias, block_kv: int, ragged: bool):
             grid=(b, num_kv),
             in_specs=in_specs,
             out_specs=pl.BlockSpec(
-                (1, h, d, 1), lambda bi, ki, off: (bi, 0, 0, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((h, 1), jnp.float32),
-                pltpu.VMEM((h, 1), jnp.float32),
-                pltpu.VMEM((h, d), jnp.float32),
-            ],
+                (1, h, d, window), lambda bi, ki, off: (bi, 0, 0, 0)),
+            scratch_shapes=scratch,
         ),
-        out_shape=_sds((b, h, d, 1), q.dtype, q),
+        out_shape=_sds((b, h, d, window), q.dtype, q),
         interpret=_interpret(),
     )(off, *operands)
-    # [b, h, d, 1] -> [b, 1, h, d]
+    # [b, h, d, W] -> [b, W, h, d]
     return out.transpose(0, 3, 1, 2)
 
 
@@ -1246,9 +1328,14 @@ def flash_decode_ragged(q, k, v, query_offsets, bias=None,
     offsets prefetch as a ``[b]`` scalar operand so both the in-kernel
     masking and the block-skip index maps read the PER-ROW length —
     a freshly admitted slot walks only its own short cache while a
-    long-running neighbour streams its full one. Inference-only;
-    raises NotImplementedError where the caller must fall back to the
-    XLA per-row-offset path (``ops/attention.py::_xla_attention``).
+    long-running neighbour streams its full one.
+
+    ``sq > 1`` is the speculative VERIFY window (no bias): query ``j``
+    of row ``i`` sits at position ``query_offsets[i] + j`` and masks
+    keys ``<= query_offsets[i] + j`` (:func:`_verify_kernel`) — one
+    pass scores a whole drafted token run. Inference-only; raises
+    NotImplementedError where the caller must fall back to the XLA
+    per-row-offset path (``ops/attention.py::_xla_attention``).
     """
     b = q.shape[0]
     offs = jnp.asarray(query_offsets, jnp.int32)
@@ -1268,6 +1355,14 @@ def _paged_decode_kernel(off_ref, pt_ref, *refs, **kw):
     does, so it needs only the offsets."""
     del pt_ref
     _decode_kernel(off_ref, *refs, **kw)
+
+
+def _paged_verify_kernel(off_ref, pt_ref, *refs, **kw):
+    """:func:`_verify_kernel` behind the paged kernel's two prefetched
+    scalars — same delegation as :func:`_paged_decode_kernel`: the
+    page table lives entirely in the index maps."""
+    del pt_ref
+    _verify_kernel(off_ref, *refs, **kw)
 
 
 def flash_decode_paged(q, k, v, query_offsets, page_table, bias=None,
@@ -1291,6 +1386,10 @@ def flash_decode_paged(q, k, v, query_offsets, page_table, bias=None,
     size that fits the VMEM budget, so a block never straddles two
     (physically unrelated) pages.
 
+    ``sq > 1`` is the speculative VERIFY window: the within-window
+    causal mask of :func:`flash_decode_ragged` over the paged pool
+    (:func:`_paged_verify_kernel`).
+
     Inference-only; no bias operand (serving decode carries none —
     per-slot validity lives in the offsets). Raises
     NotImplementedError where the caller must fall back to the XLA
@@ -1303,8 +1402,9 @@ def flash_decode_paged(q, k, v, query_offsets, page_table, bias=None,
             "flash_decode_paged takes no bias (per-slot validity is "
             "the offsets')")
     b, sq, h, d = q.shape
-    if sq != 1:
-        raise NotImplementedError("flash_decode is single-token only")
+    window = sq
+    if window < 1:
+        raise NotImplementedError("empty decode window")
     if d % 8:
         raise NotImplementedError(f"head_dim {d} unsupported")
     if k.ndim != 4 or k.shape[1] != h or k.shape[2] != d:
@@ -1336,23 +1436,41 @@ def flash_decode_paged(q, k, v, query_offsets, page_table, bias=None,
     bpp = page // block_kv                     # blocks per page
     num_kv = max_pages * bpp                   # logical capacity walk
 
-    qp = q.transpose(0, 2, 3, 1)               # [b, h, d, 1]
+    qp = q.transpose(0, 2, 3, 1)               # [b, h, d, W]
 
     def kv_block(bi, ki, off, pt):
         # clamp to the row's live block (same dead-block elision as
-        # the ragged kernel), then redirect through the page table
-        kb = jnp.minimum(ki, off[bi] // block_kv)
+        # the ragged kernel; a verify window's last query sets the
+        # bound), then redirect through the page table
+        kb = jnp.minimum(ki, (off[bi] + (window - 1)) // block_kv)
         return (pt[bi, kb // bpp], 0, 0, kb % bpp)
 
     in_specs = [
-        pl.BlockSpec((1, h, d, 1),
+        pl.BlockSpec((1, h, d, window),
                      lambda bi, ki, off, pt: (bi, 0, 0, 0)),
         pl.BlockSpec((1, h, d, block_kv), kv_block),
         pl.BlockSpec((1, h, d, block_kv), kv_block),
     ]
-    kernel = functools.partial(_paged_decode_kernel, sm_scale=d ** -0.5,
-                               block_kv=block_kv, num_kv=num_kv,
-                               has_bias=False, ragged=True)
+    if window == 1:
+        kernel = functools.partial(_paged_decode_kernel,
+                                   sm_scale=d ** -0.5,
+                                   block_kv=block_kv, num_kv=num_kv,
+                                   has_bias=False, ragged=True)
+        scratch = [
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ]
+    else:
+        kernel = functools.partial(_paged_verify_kernel,
+                                   sm_scale=d ** -0.5,
+                                   block_kv=block_kv, num_kv=num_kv,
+                                   window=window, ragged=True)
+        scratch = [
+            pltpu.VMEM((window, h, 1), jnp.float32),
+            pltpu.VMEM((window, h, 1), jnp.float32),
+            pltpu.VMEM((window, h, d), jnp.float32),
+        ]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -1360,15 +1478,11 @@ def flash_decode_paged(q, k, v, query_offsets, page_table, bias=None,
             grid=(b, num_kv),
             in_specs=in_specs,
             out_specs=pl.BlockSpec(
-                (1, h, d, 1),
+                (1, h, d, window),
                 lambda bi, ki, off, pt: (bi, 0, 0, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((h, 1), jnp.float32),
-                pltpu.VMEM((h, 1), jnp.float32),
-                pltpu.VMEM((h, d), jnp.float32),
-            ],
+            scratch_shapes=scratch,
         ),
-        out_shape=_sds((b, h, d, 1), q.dtype, q),
+        out_shape=_sds((b, h, d, window), q.dtype, q),
         interpret=_interpret(),
     )(offs, pt, qp, k, v)
     return out.transpose(0, 3, 1, 2)

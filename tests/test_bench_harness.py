@@ -584,10 +584,14 @@ def test_bench_moe_runs_offline(capsys):
 
 def test_bench_serving_runs_offline(capsys):
     """The continuous-batching bench's tiny CPU path must execute end
-    to end and emit a finite decode-tokens/s record with the pinned
-    metric grammar (same record shape the on-chip 345M run emits)."""
+    to end and emit TWO records on the same seeded trace — the plain
+    decode-tokens/s headline and the speculative A/B companion — with
+    the pinned metric grammar (same record shapes the on-chip 345M
+    run emits)."""
     bench.bench_serving()
-    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    lines = capsys.readouterr().out.strip().splitlines()
+    recs = [json.loads(ln) for ln in lines if ln.startswith("{")]
+    rec, spec = recs[-2], recs[-1]
     assert rec["metric"] == bench.METRIC_BY_MODE["serving"]
     assert rec["metric"] == \
         "gpt345m_serving_decode_tokens_per_sec_per_chip"
@@ -607,6 +611,38 @@ def test_bench_serving_runs_offline(capsys):
     # queueing included); p99 >= p50 > 0 on any non-empty trace
     assert rec["ttft_p50_ms"] > 0
     assert rec["ttft_p99_ms"] >= rec["ttft_p50_ms"]
+    # the speculative A/B record: same trace fields, its own metric
+    # name, the accepted-token rate, and a tokens/s from COMMITTED
+    # tokens (decode_ticks can differ from the plain run, the token
+    # count cannot)
+    assert spec["metric"] == \
+        "gpt345m_serving_spec_decode_tokens_per_sec_per_chip"
+    assert spec["value"] > 0 and spec["unit"] == "tokens/s"
+    assert spec["requests"] == rec["requests"]
+    assert spec["seed"] == rec["seed"]
+    assert spec["spec_tokens"] == 4            # the default k
+    assert 0.0 <= spec["spec_accept_rate"] <= 1.0
+
+
+def test_bench_serving_spec_knobs(monkeypatch, capsys):
+    """PFX_BENCH_SERVING_SPEC=0 suppresses the A/B record entirely;
+    _SPEC_TOKENS overrides the draft width and is echoed back."""
+    monkeypatch.setenv("PFX_BENCH_SERVING_REQUESTS", "3")
+    monkeypatch.setenv("PFX_BENCH_SERVING_MAX_PROMPT", "8")
+    monkeypatch.setenv("PFX_BENCH_SERVING_DEC_LEN", "4")
+    monkeypatch.setenv("PFX_BENCH_SERVING_SPEC", "0")
+    bench.bench_serving()
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert json.loads(lines[-1])["metric"] == \
+        bench.METRIC_BY_MODE["serving"]          # no spec record
+    monkeypatch.setenv("PFX_BENCH_SERVING_SPEC", "1")
+    monkeypatch.setenv("PFX_BENCH_SERVING_SPEC_TOKENS", "2")
+    bench.bench_serving()
+    spec = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])
+    assert spec["metric"] == \
+        "gpt345m_serving_spec_decode_tokens_per_sec_per_chip"
+    assert spec["spec_tokens"] == 2
 
 
 def test_bench_serving_paged_knob_off(monkeypatch, capsys):
@@ -614,6 +650,7 @@ def test_bench_serving_paged_knob_off(monkeypatch, capsys):
     per-slot cache and the record says so (page fields zeroed), so
     perf CI can A/B the two layouts on the identical trace."""
     monkeypatch.setenv("PFX_BENCH_SERVING_PAGED", "0")
+    monkeypatch.setenv("PFX_BENCH_SERVING_SPEC", "0")
     monkeypatch.setenv("PFX_BENCH_SERVING_REQUESTS", "3")
     monkeypatch.setenv("PFX_BENCH_SERVING_MAX_PROMPT", "8")
     monkeypatch.setenv("PFX_BENCH_SERVING_DEC_LEN", "4")
@@ -635,6 +672,7 @@ def test_bench_serving_env_knobs_pin_trace(monkeypatch, capsys):
     monkeypatch.setenv("PFX_BENCH_SERVING_MIN_PROMPT", "4")
     monkeypatch.setenv("PFX_BENCH_SERVING_MAX_PROMPT", "6")
     monkeypatch.setenv("PFX_BENCH_SERVING_DEC_LEN", "5")
+    monkeypatch.setenv("PFX_BENCH_SERVING_SPEC", "0")
     bench.bench_serving()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["requests"] == 3 and rec["slots"] == 1

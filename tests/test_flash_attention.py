@@ -547,8 +547,11 @@ def test_flash_decode_paged_rejects_bad_shapes():
     with pytest.raises(NotImplementedError):  # bias unsupported
         flash_decode_paged(q, k, v, offs, pt,
                            bias=jnp.zeros((2, 1, 1, 256)))
-    with pytest.raises(NotImplementedError):  # multi-token q
-        flash_decode_paged(jnp.concatenate([q, q], 1), k, v, offs, pt)
+    with pytest.raises(NotImplementedError):  # bias + verify window
+        flash_decode_paged(jnp.concatenate([q, q], 1), k, v, offs, pt,
+                           bias=jnp.zeros((2, 1, 1, 256)))
+    with pytest.raises(NotImplementedError):  # empty window
+        flash_decode_paged(q[:, :0], k, v, offs, pt)
     with pytest.raises(NotImplementedError):  # offsets batch mismatch
         flash_decode_paged(q, k, v, jnp.zeros((3,), jnp.int32), pt)
     with pytest.raises(NotImplementedError):  # page_table not [b, m]
@@ -599,6 +602,138 @@ def test_paged_decode_dispatch_and_counter():
                               None, True, True, kv_cache_layout=True)
         np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2),
                                    atol=2e-6, rtol=2e-6)
+    finally:
+        metrics.set_enabled(False)
+        reg.reset()
+
+
+def test_flash_decode_ragged_verify_window_matches_xla():
+    """The speculative k-token VERIFY window: sq > 1 ragged decode ==
+    the XLA per-row-offset oracle (query j of row i sees keys <=
+    offs[i] + j — the within-window causal mask), garbage past each
+    row's window never leaks, and a window of 1 degenerates to the
+    single-token kernel exactly."""
+    from paddlefleetx_tpu.ops.pallas.flash_attention import (
+        flash_decode_ragged,
+    )
+    rng = np.random.default_rng(31)
+    b, S, h, d, W = 4, 256, 2, 64, 4
+    q = jnp.asarray(rng.normal(size=(b, W, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h, d, S)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h, d, S)), jnp.float32)
+    # rows whose windows start at 0, mid-block, a block edge, and the
+    # last admissible start (offs + W - 1 == S - 1)
+    offs = jnp.asarray([0, 5, 127, S - W], jnp.int32)
+    ref = _xla_attention(q, k, v, None, True, offs, 0.0, None, True,
+                         True, kv_cache_layout=True)
+    got = flash_decode_ragged(q, k, v, offs, block_kv=128)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-6, rtol=2e-6)
+    # nothing past each row's LAST window position is ever read
+    mask = np.arange(S)[None, :] > (np.asarray(offs)[:, None] + W - 1)
+    k2 = jnp.where(jnp.asarray(mask)[:, None, None, :], 1e3, k)
+    v2 = jnp.where(jnp.asarray(mask)[:, None, None, :], -1e3, v)
+    got2 = flash_decode_ragged(q, k2, v2, offs, block_kv=128)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(got),
+                               atol=2e-6, rtol=2e-6)
+    # W = 1 is the original single-token kernel, column for column
+    np.testing.assert_allclose(
+        np.asarray(flash_decode_ragged(q[:, :1], k, v, offs,
+                                       block_kv=128)),
+        np.asarray(got[:, :1]), atol=2e-6, rtol=2e-6)
+
+
+def test_flash_decode_paged_verify_window_matches_xla():
+    """The verify window over the PAGED pool: same within-window
+    causal mask through the page-table walk, validated against the
+    XLA oracle on the gathered contiguous view — including a window
+    that CROSSES a page boundary mid-run."""
+    from paddlefleetx_tpu.ops.attention import _gather_kv_pages
+    from paddlefleetx_tpu.ops.pallas.flash_attention import (
+        flash_decode_paged,
+    )
+    rng = np.random.default_rng(32)
+    b, h, d, page, pool, mp, W = 4, 4, 64, 128, 14, 3, 4
+    q = jnp.asarray(rng.normal(size=(b, W, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(pool, h, d, page)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(pool, h, d, page)), jnp.float32)
+    ids = rng.permutation(np.arange(1, pool))[:b * mp]
+    pt = jnp.asarray(ids.reshape(b, mp), jnp.int32)
+    # row 1's window spans the page-0/page-1 boundary (126..129); row
+    # 3 ends exactly at the table's last position
+    offs = jnp.asarray([0, 126, 200, mp * page - W], jnp.int32)
+    kg, vg = _gather_kv_pages(k, pt), _gather_kv_pages(v, pt)
+    ref = _xla_attention(q, kg, vg, None, True, offs, 0.0, None, True,
+                         True, kv_cache_layout=True)
+    got = flash_decode_paged(q, k, v, offs, pt)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-6, rtol=2e-6)
+    # pages no row's window reaches are never read
+    live = np.zeros(pool, bool)
+    for i, off in enumerate(np.asarray(offs)):
+        for j in range((int(off) + W - 1) // page + 1):
+            live[int(pt[i, j])] = True
+    poison = jnp.asarray(~live)[:, None, None, None]
+    got2 = flash_decode_paged(q, jnp.where(poison, 1e3, k),
+                              jnp.where(poison, -1e3, v), offs, pt)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(got),
+                               atol=2e-6, rtol=2e-6)
+
+
+def test_verify_window_dispatch_and_counters():
+    """dot_product_attention routes a short multi-token window with
+    per-row offsets to the verify kernels (`attention/*_verify`
+    counters), and a window past MAX_VERIFY_WINDOW — chunked
+    prefill's shape — to the dense path, never the kernel."""
+    from paddlefleetx_tpu.observability import metrics
+    from paddlefleetx_tpu.ops.attention import (
+        MAX_VERIFY_WINDOW, _gather_kv_pages, dot_product_attention,
+    )
+    rng = np.random.default_rng(33)
+    b, S, h, d, W = 2, 256, 2, 64, 3
+    q = jnp.asarray(rng.normal(size=(b, W, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h, d, S)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h, d, S)), jnp.float32)
+    offs = jnp.asarray([17, 200], jnp.int32)
+    reg = metrics.get_registry()
+    metrics.set_enabled(True)
+    reg.reset()
+    try:
+        out = dot_product_attention(q, k, v, causal=True,
+                                    query_offset=offs, use_flash=True,
+                                    kv_cache_layout=True)
+        assert reg.counter("attention/flash_decode_ragged_verify") == 1
+        assert reg.counter("attention/dense") == 0
+        ref = _xla_attention(q, k, v, None, True, offs, 0.0, None,
+                             True, True, kv_cache_layout=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-6, rtol=2e-6)
+        # paged edition
+        reg.reset()
+        qp, kp, vp, pt = _paged_batch(b=2, pool=7, max_pages=2,
+                                      seed=34)
+        qp = jnp.concatenate([qp] * W, axis=1)
+        outp = dot_product_attention(qp, kp, vp, causal=True,
+                                     query_offset=offs,
+                                     use_flash=True,
+                                     kv_cache_layout=True,
+                                     page_table=pt)
+        assert reg.counter("attention/flash_decode_paged_verify") == 1
+        assert reg.counter("attention/dense") == 0
+        kg, vg = _gather_kv_pages(kp, pt), _gather_kv_pages(vp, pt)
+        refp = _xla_attention(qp, kg, vg, None, True, offs, 0.0, None,
+                              True, True, kv_cache_layout=True)
+        np.testing.assert_allclose(np.asarray(outp), np.asarray(refp),
+                                   atol=2e-6, rtol=2e-6)
+        # a chunked-prefill-sized window stays OFF the verify kernel
+        reg.reset()
+        big = MAX_VERIFY_WINDOW + 1
+        qb = jnp.asarray(rng.normal(size=(b, big, h, d)), jnp.float32)
+        dot_product_attention(qb, k, v, causal=True,
+                              query_offset=jnp.zeros((b,), jnp.int32),
+                              use_flash=True, kv_cache_layout=True)
+        assert reg.counter("attention/flash_decode_ragged_verify") == 0
+        assert reg.counter("attention/dense") == 1
     finally:
         metrics.set_enabled(False)
         reg.reset()

@@ -627,6 +627,34 @@ def test_kv_page_size_validation_composes_with_capacity():
     assert cfg.cache_capacity == 256 and cfg.max_kv_pages == 2
 
 
+def test_spec_knob_validation():
+    """The speculative-decoding knobs (`GenerationConfig.spec_method`
+    / `spec_tokens`) validate at construction: only the shipped
+    'ngram' draft source is accepted, at least one draft token must
+    be requested, and beam search — which reorders the batch every
+    step — cannot compose with speculation."""
+    mk = lambda **kw: GenerationConfig(max_dec_len=8,
+                                       eos_token_id=95,
+                                       pad_token_id=95, **kw)
+    # defaults: speculation off, knobs inert
+    cfg = mk()
+    assert cfg.spec_method is None and cfg.spec_tokens >= 1
+    # a valid speculative config, both served strategies
+    assert mk(spec_method="ngram", spec_tokens=4).spec_tokens == 4
+    assert mk(decode_strategy="sampling", spec_method="ngram",
+              spec_tokens=1).spec_method == "ngram"
+    with pytest.raises(ValueError, match="spec_method"):
+        mk(spec_method="draft_model")     # not shipped (yet)
+    with pytest.raises(ValueError, match="spec_tokens"):
+        mk(spec_method="ngram", spec_tokens=0)
+    with pytest.raises(ValueError, match="spec"):
+        mk(decode_strategy="beam_search", num_beams=2,
+           spec_method="ngram")
+    # spec_tokens only validates when speculation is ON — the default
+    # config never trips on it
+    assert mk(spec_tokens=0).spec_method is None
+
+
 def test_beam_gather_cache_reorders_under_mp_mesh(model_and_params):
     """Beam search's `_gather_cache` batch reordering must commute
     with an mp mesh whose cache leaves are sharded over heads (the

@@ -9,6 +9,7 @@ Pallas kernel on CPU; the rest of the suite runs the XLA per-row
 fallback (same masking, the kernels' oracle).
 """
 
+import dataclasses
 import json
 import os
 
@@ -591,3 +592,397 @@ def test_slot_cache_sharded_under_mp_mesh(model_and_params):
         srv = GenerationServer(model, params_s, gen_cfg, num_slots=2)
         comps = srv.run(PROMPTS[:4])
     assert [c.tokens for c in comps] == ref
+
+
+# -- speculative decoding ----------------------------------------------
+#
+# Drafted k-token verify (verify_step + core/spec.py): greedy output
+# must equal the NON-speculative server token-exactly — whatever the
+# drafts propose, the slot count, the admission timing, or the cache
+# layout — because the teacher-forced verify logits are the sequential
+# logits and greedy acceptance is exact argmax match. Sampling keeps
+# the spec-off distribution via the standard rejection rule (salted
+# per-step uniforms + the residual's rejected-token exclusion).
+
+
+def _spec_cfg(base, k=3):
+    return dataclasses.replace(base, spec_method="ngram",
+                               spec_tokens=k)
+
+
+class _OracleDraft:
+    """Drafts the request's true continuation from a reference map —
+    every draft accepted under greedy (the tick-compression ceiling)."""
+
+    def __init__(self, ref_by_prompt):
+        self.ref = ref_by_prompt
+
+    def propose(self, history, k):
+        h = tuple(history)
+        for p, toks in self.ref.items():
+            full = list(p) + toks
+            if h == tuple(full[:len(h)]) and len(h) >= len(p):
+                tail = full[len(h) + 1:len(h) + 1 + k]
+                return tail + [0] * (k - len(tail))
+        return [0] * k
+
+
+class _WrongDraft:
+    """Always drafts an in-vocab token run the model never emits at
+    temperature 0 — every draft rejected, t0 still commits."""
+
+    def propose(self, history, k):
+        return [(history[-1] + 31) % 90] * k
+
+
+@pytest.mark.parametrize("num_slots,order,spec_tokens", [
+    (1, list(range(6)), 3),         # fully sequential
+    (2, list(range(6)), 1),         # minimal window
+    (2, [5, 4, 3, 2, 1, 0], 3),     # reversed admission
+    (3, [2, 0, 4, 1, 5, 3], 4),     # shuffled admission
+    (6, list(range(6)), 3),         # everything admitted at once
+])
+def test_spec_parity_matrix_greedy(model_and_params, num_slots, order,
+                                   spec_tokens):
+    """The speculative parity matrix: greedy spec-on == spec-off ==
+    lockstep, over slot counts x admission orders x draft widths."""
+    model, params = model_and_params
+    gen_cfg = _greedy_cfg()
+    ref = _lockstep(model, params, PROMPTS, gen_cfg)
+    srv = GenerationServer(model, params,
+                           _spec_cfg(gen_cfg, spec_tokens),
+                           num_slots=num_slots)
+    prompts = [PROMPTS[i] for i in order]
+    comps = srv.run(prompts)
+    assert [c.tokens for c in comps] == [ref[i] for i in order]
+    assert all(c.finish_reason in ("eos", "length") for c in comps)
+
+
+@pytest.mark.parametrize("num_slots,order", [
+    (1, list(range(6))),
+    (3, [2, 0, 4, 1, 5, 3]),
+    (6, list(range(6))),
+])
+def test_paged_spec_parity_matrix_greedy(paged_model_and_params,
+                                         num_slots, order):
+    """The speculative parity matrix, PAGED edition: the k+1-token
+    window maintenance, multi-token page writes, and rejected-page
+    rollback must all be invisible in the tokens — and the drained
+    pool must be whole (every rolled-back page found its way home)."""
+    model, params = paged_model_and_params
+    gen_cfg = _greedy_cfg()
+    ref = _lockstep(model, params, PROMPTS, gen_cfg)
+    srv = GenerationServer(model, params, _spec_cfg(gen_cfg),
+                           num_slots=num_slots, page_size=128,
+                           prefill_chunk_pages=1)
+    prompts = [PROMPTS[i] for i in order]
+    comps = srv.run(prompts)
+    assert [c.tokens for c in comps] == [ref[i] for i in order]
+    srv._alloc.check()
+    assert srv._alloc.pages_in_use == 0
+
+
+def test_spec_mid_run_admission_parity(model_and_params):
+    """Requests admitted while speculative slots sit at RAGGED depths
+    (different per-slot accepted counts) still complete to their
+    lockstep rows."""
+    model, params = model_and_params
+    gen_cfg = _greedy_cfg()
+    ref = _lockstep(model, params, PROMPTS, gen_cfg)
+    srv = GenerationServer(model, params, _spec_cfg(gen_cfg),
+                           num_slots=2)
+    done = {}
+    ids = [srv.submit(p) for p in PROMPTS[:2]]
+    for _ in range(2):
+        for c in srv.step():
+            done[c.request_id] = c
+    ids += [srv.submit(p) for p in PROMPTS[2:]]
+    _drain(srv, done)
+    assert [done[i].tokens for i in ids] == ref
+
+
+def test_spec_oracle_drafts_compress_ticks(model_and_params):
+    """With an oracle draft source (the true continuation), every
+    draft is accepted: the whole trace finishes in ~max_dec_len/(k+1)
+    ticks, accept rate 1.0 in telemetry AND the summary, and the
+    tokens still match lockstep — committed tokens, not ticks, is
+    what serving/decode_tokens counts."""
+    model, params = model_and_params
+    gen_cfg = _greedy_cfg()
+    ref = _lockstep(model, params, PROMPTS[:3], gen_cfg)
+    ref_map = {tuple(p): t for p, t in zip(PROMPTS[:3], ref)}
+    metrics.set_enabled(True)
+    reg = metrics.get_registry()
+    reg.reset()
+    try:
+        srv = GenerationServer(model, params, _spec_cfg(gen_cfg, 3),
+                               num_slots=3)
+        srv._draft = _OracleDraft(ref_map)
+        comps = srv.run(PROMPTS[:3])
+        assert [c.tokens for c in comps] == ref
+        summ = srv.summary()
+        assert summ["spec_accept_rate"] == 1.0
+        assert summ["spec_drafted"] == summ["spec_accepted"] > 0
+        # 8 tokens/request at 4 tokens/tick = 2 ticks per request
+        assert summ["decode_ticks"] == 2
+        assert summ["decode_tokens"] == sum(len(t) for t in ref)
+        assert reg.counter("serving/decode_tokens") == \
+            summ["decode_tokens"]
+        assert reg.counter("serving/spec_accepted") == \
+            summ["spec_accepted"]
+        assert reg.gauge("serving/spec_accept_rate") == 1.0
+    finally:
+        metrics.set_enabled(False)
+        reg.reset()
+
+
+def test_spec_wrong_drafts_still_exact(model_and_params):
+    """The adversarial floor: a draft source that is ALWAYS wrong
+    commits exactly one token per tick (the t0 sample), accept rate
+    0.0, output still lockstep-exact — drafts can only ever cost
+    throughput, never correctness."""
+    model, params = model_and_params
+    gen_cfg = _greedy_cfg()
+    ref = _lockstep(model, params, PROMPTS[:3], gen_cfg)
+    srv = GenerationServer(model, params, _spec_cfg(gen_cfg, 3),
+                           num_slots=2)
+    srv._draft = _WrongDraft()
+    comps = srv.run(PROMPTS[:3])
+    assert [c.tokens for c in comps] == ref
+    summ = srv.summary()
+    assert summ["spec_accepted"] == 0
+    assert summ["spec_accept_rate"] == 0.0
+
+
+def test_spec_greedy_chain_stops_at_first_mismatch(model_and_params):
+    """The commit chain rule on one verify tick: drafts
+    [t1, t2, WRONG, t4] commit exactly [t0, t1, t2] — a correct draft
+    AFTER a rejection must not commit (its context was wrong)."""
+    from paddlefleetx_tpu.models.gpt.generation import (
+        decode_step, verify_step,
+    )
+    model, params = model_and_params
+    gen_cfg = _greedy_cfg()
+    srv = GenerationServer(model, params, gen_cfg, num_slots=2)
+    for p in PROMPTS[:2]:
+        srv.submit(p)
+    srv._admit()
+    model_u, params_u = srv.model, srv.params
+    # sequential oracle: four plain ticks from a snapshot
+    cache, state = srv._cache, srv._state
+    seq = []
+    c, s = cache, state
+    for _ in range(4):
+        c, s, tok = decode_step(model_u, params_u, c, s,
+                                srv._rng, gen_cfg)
+        seq.append(np.asarray(tok))
+    seq = np.stack(seq, 1)                    # [slots, 4]
+    drafts = seq[:, 1:].copy()
+    drafts[:, 2] = (seq[:, 3] + 7) % 90       # wrong at j=3
+    _, s2, window, counts = verify_step(
+        model_u, params_u, cache, state,
+        jnp.asarray(drafts, jnp.int32), srv._rng, gen_cfg)
+    assert np.asarray(counts).tolist() == [3, 3]
+    np.testing.assert_array_equal(np.asarray(window)[:, :3],
+                                  seq[:, :3])
+    # lengths/dec_count advanced by the per-slot committed counts
+    assert (np.asarray(s2.lengths) - np.asarray(state.lengths)
+            ).tolist() == [3, 3]
+    assert np.asarray(s2.dec_count).tolist() == [3, 3]
+
+
+def test_spec_sampling_accept_rule(model_and_params):
+    """The rejection-sampling rule, pinned at its deterministic
+    limits: at near-zero temperature the filtered distribution is a
+    point mass, so drafting the sequential continuation accepts
+    everything and drafting anything else rejects at the first draft
+    — and the rejected draft lands in SlotState.rejected so the next
+    tick's draw excludes it."""
+    from paddlefleetx_tpu.models.gpt.generation import (
+        decode_step, verify_step,
+    )
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(
+        max_dec_len=8, decode_strategy="sampling", top_k=4,
+        top_p=1.0, temperature=1e-4, eos_token_id=EOS,
+        pad_token_id=PAD)
+    srv = GenerationServer(model, params, gen_cfg, num_slots=2)
+    for p in PROMPTS[:2]:
+        srv.submit(p)
+    srv._admit()
+    model_u, params_u = srv.model, srv.params
+    cache, state = srv._cache, srv._state
+    seq = []
+    c, s = cache, state
+    for _ in range(3):
+        c, s, tok = decode_step(model_u, params_u, c, s,
+                                srv._rng, gen_cfg)
+        seq.append(np.asarray(tok))
+    seq = np.stack(seq, 1)                    # [slots, 3]
+    # (a) true continuation -> all accepted (p(draft) ~ 1)
+    _, s_ok, window, counts = verify_step(
+        model_u, params_u, cache, state,
+        jnp.asarray(seq[:, 1:], jnp.int32), srv._rng, gen_cfg)
+    assert np.asarray(counts).tolist() == [3, 3]
+    np.testing.assert_array_equal(np.asarray(window), seq)
+    assert np.asarray(s_ok.rejected).tolist() == [-1, -1]
+    # (b) wrong first draft -> rejected (p(draft) ~ 0), only t0
+    # commits, and the reject is recorded for the next tick's draw
+    wrong = (seq[:, 1:].copy() + 11) % 90
+    _, s_rej, window2, counts2 = verify_step(
+        model_u, params_u, cache, state,
+        jnp.asarray(wrong, jnp.int32), srv._rng, gen_cfg)
+    assert np.asarray(counts2).tolist() == [1, 1]
+    np.testing.assert_array_equal(np.asarray(window2)[:, 0],
+                                  seq[:, 0])
+    assert np.asarray(s_rej.rejected).tolist() == \
+        wrong[:, 0].tolist()
+
+
+def test_spec_rejected_token_excluded_from_next_draw(model_and_params):
+    """The residual exclusion: when SlotState.rejected holds the very
+    token the filtered distribution concentrates on, the next tick
+    must sample something ELSE — without the mask the rejected draft
+    would be re-drawn and the output distribution would double-count
+    it."""
+    from paddlefleetx_tpu.models.gpt.generation import verify_step
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(
+        max_dec_len=8, decode_strategy="sampling", top_k=4,
+        top_p=1.0, temperature=1e-4, eos_token_id=EOS,
+        pad_token_id=PAD)
+    srv = GenerationServer(model, params, gen_cfg, num_slots=2)
+    for p in PROMPTS[:2]:
+        srv.submit(p)
+    srv._admit()
+    cache, state = srv._cache, srv._state
+    k = 2
+    zeros = jnp.zeros((2, k), jnp.int32)
+    _, _, window, _ = verify_step(srv.model, srv.params, cache, state,
+                                  zeros, srv._rng, gen_cfg)
+    t0 = np.asarray(window)[:, 0]             # the point-mass tokens
+    state_rej = state._replace(
+        rejected=jnp.asarray(t0, jnp.int32))
+    _, _, window2, _ = verify_step(srv.model, srv.params, cache,
+                                   state_rej, zeros, srv._rng,
+                                   gen_cfg)
+    t0_excl = np.asarray(window2)[:, 0]
+    assert all(a != b for a, b in zip(t0_excl, t0))
+
+
+def test_spec_sampling_is_slot_and_order_independent(model_and_params):
+    """Speculative sampling draws stay a function of (server rng,
+    submission index): the same trace served with 1 and 3 slots —
+    different tick groupings, different accept patterns — emits
+    identical tokens."""
+    model, params = model_and_params
+    gen_cfg = GenerationConfig(
+        max_dec_len=6, decode_strategy="sampling", top_k=8,
+        top_p=0.9, temperature=0.7, eos_token_id=EOS,
+        pad_token_id=PAD, spec_method="ngram", spec_tokens=3)
+    runs = []
+    for num_slots in (1, 3):
+        srv = GenerationServer(model, params, gen_cfg,
+                               num_slots=num_slots,
+                               rng=jax.random.PRNGKey(7))
+        runs.append([c.tokens for c in srv.run(PROMPTS)])
+    assert runs[0] == runs[1]
+
+
+def test_paged_spec_serving_smoke_interpret_kernel(
+        paged_model_and_params, tmp_path):
+    """CI smoke (`-k smoke`), speculative edition: staggered admits
+    over the PAGED pool with the interpret-mode VERIFY kernel
+    (`attention/flash_decode_paged_verify`) carrying every tick, the
+    flight recorder streaming `serving_spec` events, and greedy
+    parity holding through it all."""
+    _, params = paged_model_and_params
+    kcfg = GPTConfig(**{**PCFG.__dict__, "use_flash_attention": True})
+    model = GPTForPretraining(kcfg)
+    gen_cfg = _greedy_cfg(max_dec=4)
+    ref = _lockstep(model, params, PROMPTS[:3], gen_cfg)
+    events = tmp_path / "events.jsonl"
+    metrics.set_enabled(True)
+    reg = metrics.get_registry()
+    reg.reset()
+    try:
+        srv = GenerationServer(model, params, _spec_cfg(gen_cfg, 3),
+                               num_slots=2, page_size=128,
+                               prefill_chunk_pages=1,
+                               events_path=str(events))
+        done = {}
+        ids = [srv.submit(p) for p in PROMPTS[:2]]
+        srv.step()                       # stagger the third admit
+        ids.append(srv.submit(PROMPTS[2]))
+        _drain(srv, done)
+        assert [done[i].tokens for i in ids] == ref
+        assert reg.counter("attention/flash_decode_paged_verify") >= 1
+        assert reg.counter("serving/spec_drafted") > 0
+        assert reg.counter("serving/decode_tokens") == \
+            srv.summary()["decode_tokens"]
+        recs = [json.loads(l) for l in
+                events.read_text().splitlines()]
+        start = next(r for r in recs if r["event"] == "serving_start")
+        assert start["spec"] is True and start["spec_tokens"] == 3
+        spec_events = [r for r in recs if r["event"] == "serving_spec"]
+        assert spec_events
+        assert all(e["committed"] >= e["accepted"] >= 0
+                   for e in spec_events)
+        srv._alloc.check()
+        assert srv._alloc.pages_in_use == 0
+    finally:
+        metrics.set_enabled(False)
+        reg.reset()
+
+
+def test_ngram_draft_source_prompt_lookup():
+    """NgramDraftSource proposes the shifted continuation of the most
+    recent (longest-n-first) suffix match, pads with zeros past the end
+    of history, and falls back to all-zeros when nothing matches."""
+    from paddlefleetx_tpu.core.spec import (
+        NgramDraftSource, make_draft_source)
+    src = NgramDraftSource(max_ngram=3)
+    # suffix [2,3] matched at i=1; continuation [4,2,3] -> first token
+    # guesses the tick's own t0, so drafts are [2,3] padded to k=3
+    assert src.propose([1, 2, 3, 4, 2, 3], 3) == [2, 3, 0]
+    # longest n wins: trailing [7,8,9] matches earlier despite the
+    # shorter [9] also matching elsewhere
+    assert src.propose([7, 8, 9, 5, 6, 9, 7, 8, 9], 2) == [6, 9]
+    # no earlier occurrence of any suffix -> zeros
+    assert src.propose([1, 2, 3, 4], 2) == [0, 0]
+    # degenerate histories never index out of range
+    assert src.propose([], 2) == [0, 0]
+    assert src.propose([5], 2) == [0, 0]
+    # factory: the spec_method switch, and its error path
+    assert isinstance(make_draft_source("ngram", max_ngram=2),
+                      NgramDraftSource)
+    with pytest.raises(ValueError, match="spec_method"):
+        make_draft_source("draft_model")
+    with pytest.raises(ValueError, match="max_ngram"):
+        NgramDraftSource(max_ngram=0)
+
+
+def test_paged_spec_pool_exhaustion_preempts_mid_tick(
+        paged512_model_and_params):
+    """A speculative tick's page maintenance (k+1-position window) can
+    preempt a slot that is IN the tick's live set — the commit loop
+    must skip the victim (nothing committed for it), the victim
+    requeues with its rejected-residual state intact, and the final
+    tokens stay lockstep-exact with no leaked pages."""
+    model, params = paged512_model_and_params
+    gen_cfg = _spec_cfg(_greedy_cfg(max_dec=10), k=3)
+    rng = np.random.default_rng(5)
+    pa = rng.integers(0, EOS, 250).tolist()     # 2 pages, grows @256
+    pb = rng.integers(0, EOS, 124).tolist()     # 1 page, grows @128
+    ref = _lockstep(model, params, [pa, pb], _greedy_cfg(max_dec=10))
+    srv = GenerationServer(model, params, gen_cfg, num_slots=2,
+                           page_size=128, pool_pages=5,
+                           prefill_chunk_pages=1)
+    done = {}
+    ids = [srv.submit(pa), srv.submit(pb)]
+    _drain(srv, done)
+    assert srv.summary()["preempted"] >= 1  # somebody got bumped
+    assert [done[i].tokens for i in ids] == ref
+    srv._alloc.check()
+    assert srv._alloc.pages_in_use == 0
+    assert srv._alloc.stats["allocs"] == srv._alloc.stats["frees"]
