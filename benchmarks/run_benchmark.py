@@ -29,6 +29,7 @@ LOSS_RE = re.compile(r"loss: ([\d.]+)")
 
 
 def get_args(argv=None):
+    """Parse the TIPC-style benchmark CLI."""
     p = argparse.ArgumentParser()
     p.add_argument("--model_item", default="gpt_345M")
     p.add_argument("--config", required=True)
@@ -50,6 +51,8 @@ def get_args(argv=None):
 
 
 def run(args) -> dict:
+    """Run tools/train.py with the benchmark overrides and scrape
+    ips/loss from its log into the result dict."""
     cmd = [sys.executable, os.path.join(REPO, "tools", "train.py"),
            "-c", args.config,
            "-o", f"Engine.max_steps={args.max_steps}"]

@@ -1,0 +1,11 @@
+"""Repo code-style tooling: the docstring checker and pfxlint.
+
+Making ``codestyle`` a package lets the JAX-aware static-analysis
+suite run as a console module from the repo root::
+
+    python -m codestyle.pfxlint
+
+``docstring_checker.py`` stays runnable as a plain script too
+(``python codestyle/docstring_checker.py``) — nothing here imports
+heavyweight dependencies at package-import time.
+"""

@@ -1,0 +1,26 @@
+"""``pfxlint`` — a JAX-aware static-analysis suite for this repo.
+
+Two rule families over the whole tree (``python -m codestyle.pfxlint``
+from the repo root; full rule docs in ``docs/static_analysis.md``):
+
+- **Traced-context hazards** (PFX101-PFX103): a module-level call
+  graph (``callgraph.py``) marks every function reachable from a
+  ``jax.jit`` / ``pjit`` / ``shard_map`` / ``pl.pallas_call``
+  boundary, then host syncs, wall-clock/ambient-randomness reads and
+  Python branches on tracer-typed values are flagged inside that set.
+- **Contracts** (PFX201-PFX205 + D001-D006): dispatch counters vs the
+  docs matrices (both directions), ``PFX_*`` knob documentation (both
+  directions), Pallas call sites carrying an XLA fallback + counter,
+  and the docstring checker's enforced tier, tree-wide.
+
+Suppression: ``# pfxlint: disable=PFX101`` on the finding's line
+(``disable-file=`` for a whole file); long-lived exemptions live in
+``codestyle/pfxlint/baseline.txt`` with a justification comment.
+Exit codes: 0 clean, 1 unbaselined findings, 2 usage/parse error.
+"""
+
+from .engine import (Finding, LintContext, LintResult, run_lint,   # noqa: F401
+                     run_rules)
+
+__all__ = ["Finding", "LintContext", "LintResult", "run_lint",
+           "run_rules"]

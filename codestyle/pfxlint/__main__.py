@@ -1,0 +1,135 @@
+"""Console entry: ``python -m codestyle.pfxlint [paths] [options]``.
+
+Run from the repo root. With no paths, lints the full tree (the CI
+gate); with paths, restricts file-scoped rules to those files while
+tree-scoped contract rules still see the whole tree they need.
+
+Options:
+    --select CODES        comma-separated rule ids to run exclusively
+    --ignore CODES        comma-separated rule ids to drop
+    --baseline FILE       baseline path (default
+                          codestyle/pfxlint/baseline.txt)
+    --no-baseline         report baselined findings too
+    --write-baseline      rewrite the baseline from current findings
+    --list-rules          print rule ids and exit
+    --stats               print reachability/suppression statistics
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Optional
+
+
+def _usage(msg: str) -> int:
+    print(f"pfxlint: {msg}", file=sys.stderr)
+    print(__doc__, file=sys.stderr)
+    return 2
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI driver; returns the process exit code.
+
+    Args:
+        argv (list): argument vector without the program name; None
+            reads ``sys.argv[1:]``.
+
+    Returns:
+        0 clean, 1 unbaselined findings, 2 usage error.
+    """
+    from . import engine
+    from .rules import rule_codes
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    root = os.getcwd()
+    select = ignore = None
+    baseline_path = None
+    use_baseline = True
+    write_baseline = False
+    stats = False
+    paths: List[str] = []
+
+    known = set(rule_codes())
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a == "--list-rules":
+            print("\n".join(rule_codes()))
+            return 0
+        if a in ("--select", "--ignore", "--baseline", "--root"):
+            if i + 1 >= len(args):
+                return _usage(f"{a} needs a value")
+            val = args[i + 1]
+            if a == "--select":
+                select = {c.strip() for c in val.split(",") if c.strip()}
+                bad = select - known
+                if bad:
+                    return _usage(f"unknown rule id(s): {sorted(bad)}")
+            elif a == "--ignore":
+                ignore = {c.strip() for c in val.split(",") if c.strip()}
+                bad = ignore - known
+                if bad:
+                    return _usage(f"unknown rule id(s): {sorted(bad)}")
+            elif a == "--baseline":
+                baseline_path = val
+            else:
+                root = val
+            i += 2
+            continue
+        if a == "--no-baseline":
+            use_baseline = False
+        elif a == "--write-baseline":
+            write_baseline = True
+        elif a == "--stats":
+            stats = True
+        elif a.startswith("-"):
+            return _usage(f"unknown option {a!r}")
+        else:
+            paths.append(a)
+        i += 1
+
+    if not os.path.isdir(os.path.join(root, "codestyle")):
+        return _usage(
+            f"run from the repo root (no codestyle/ under {root!r})")
+
+    try:
+        result = engine.run_lint(
+            root, paths=paths or None, select=select, ignore=ignore,
+            baseline_path=baseline_path, use_baseline=use_baseline)
+    except SyntaxError as e:
+        print(f"pfxlint: cannot parse {e.filename}:{e.lineno}: "
+              f"{e.msg}", file=sys.stderr)
+        return 2
+
+    if write_baseline:
+        path = baseline_path or os.path.join(
+            root, "codestyle", "pfxlint", "baseline.txt")
+        engine.write_baseline(path, result.findings + result.baselined)
+        print(f"pfxlint: wrote {len(result.findings) + len(result.baselined)}"
+              f" fingerprints to {path}")
+        return 0
+
+    for f in result.findings:
+        print(f)
+    if result.unused_baseline:
+        print(f"pfxlint: note: {len(result.unused_baseline)} stale "
+              f"baseline fingerprint(s) no longer fire — prune them:",
+              file=sys.stderr)
+        for fp in result.unused_baseline:
+            print(f"  {fp}", file=sys.stderr)
+    if stats:
+        print(f"pfxlint: {len(result.findings)} finding(s), "
+              f"{len(result.baselined)} baselined, "
+              f"{len(result.suppressed)} suppressed inline",
+              file=sys.stderr)
+    if result.findings:
+        print(f"pfxlint: {len(result.findings)} finding(s) "
+              f"(suppress inline with '# pfxlint: disable=ID' or "
+              f"carry in the baseline — docs/static_analysis.md)",
+              file=sys.stderr)
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

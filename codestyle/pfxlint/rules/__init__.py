@@ -1,0 +1,59 @@
+"""The pfxlint rule registry and shared AST helpers.
+
+Every rule module exposes ``CODES`` (tuple of rule ids it can emit)
+and ``check(ctx) -> list[Finding]``. Registration is explicit — the
+ordered ``ALL_RULES`` list below — so output ordering and rule
+documentation (``docs/static_analysis.md``) stay in lockstep. The
+shared helpers are defined BEFORE the submodule imports at the bottom
+because the submodules import them back from this package.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+
+def own_nodes(fn_node: ast.AST) -> Iterator[ast.AST]:
+    """Yield the nodes belonging to ONE function body, skipping
+    nested function/class definitions (they are separate call-graph
+    entries with their own reachability); lambdas are kept — they run
+    inline under the same trace and are not indexed separately."""
+    stack = list(getattr(fn_node, "body", []))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def resolve_call(ctx, fn, call: ast.Call):
+    """Resolved global dotted name of a call's callee, or None."""
+    from ..callgraph import _dotted_from
+    dotted = _dotted_from(call.func)
+    if dotted is None:
+        return None
+    mod = ctx.callgraph.modules.get(fn.modname)
+    if mod is None:
+        return dotted
+    return ctx.callgraph.resolve_dotted(mod, dotted)
+
+
+from . import (counters, docstrings, fallbacks, host_sync,   # noqa: E402
+               knobs, nondeterminism, tracer_branch)
+
+#: ordered registry; docs/static_analysis.md mirrors this table
+ALL_RULES = [
+    host_sync, nondeterminism, tracer_branch,
+    counters, knobs, fallbacks, docstrings,
+]
+
+
+def rule_codes() -> list:
+    """Every rule id pfxlint can emit, in registry order."""
+    out = []
+    for mod in ALL_RULES:
+        out.extend(mod.CODES)
+    return out

@@ -1,0 +1,33 @@
+"""D001-D006 — docstring presence/shape, folded in from
+``codestyle/docstring_checker.py``.
+
+The standalone checker keeps its own CLI and STRICT tier (D007-D010,
+reference-parity, advisory); pfxlint folds in exactly the ENFORCED
+tier the old changed-files CI job ran — D001-D006 — and runs it over
+the whole tree instead of the diff. One implementation, two front
+doors: the rule imports ``check_source`` rather than reimplementing
+it, so ``tests/test_docstring_checker.py`` keeps pinning the
+semantics for both.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..engine import Finding
+
+CODES = ("D001", "D002", "D003", "D004", "D005", "D006")
+
+
+def check(ctx) -> List[Finding]:
+    """Run the enforced docstring tier over every scanned file."""
+    from codestyle import docstring_checker as dc
+    findings: List[Finding] = []
+    for sf in ctx.py_files:
+        for f in dc.check_source(sf.text, sf.path):
+            if f.code not in CODES:
+                continue
+            findings.append(Finding(
+                sf.path, f.line, f.code, f.message,
+                key=f.message))
+    return findings

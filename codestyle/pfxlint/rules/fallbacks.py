@@ -1,0 +1,149 @@
+"""PFX205 — Pallas kernel call sites carry a fallback + counter.
+
+The kernel-dispatch contract every matrix documents
+(``docs/attention_dispatch.md``, ``docs/moe.md``): production code
+never calls a Pallas kernel bare. The dispatch site wraps the call in
+``try/except (ImportError, NotImplementedError)`` so kernel admission
+failure degrades to the XLA path instead of crashing the step, and it
+increments a trace-time dispatch counter so telemetry can attest
+which lowering actually ran (``ops/attention.py::
+dot_product_attention`` and ``models/gpt/moe.py`` are the reference
+sites).
+
+The rule: any call that resolves into ``paddlefleetx_tpu.ops.pallas.*``
+from OUTSIDE ``ops/pallas/`` (the kernel modules themselves are the
+kernel, and scripts/benches exercising kernels directly are out of
+scope) must sit lexically inside a ``try`` with at least one handler,
+in a function that also registers at least one ``metrics`` series.
+
+Only calls whose target transitively launches a kernel (reaches a
+``pl.pallas_call`` through the in-tree call graph) count: admission
+probes like ``check_shapes`` raise ``NotImplementedError`` without
+ever touching the hardware, and callers legitimately hoist them ahead
+of the dispatch decision (``ops/ring_attention.py::_flash_block_ok``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..engine import Finding
+from . import resolve_call
+
+CODES = ("PFX205",)
+
+_KERNEL_NS = "paddlefleetx_tpu.ops.pallas."
+_SCOPE_PREFIX = "paddlefleetx_tpu/"
+_EXEMPT_PREFIX = "paddlefleetx_tpu/ops/pallas/"
+_REGISTER_ATTRS = {"inc", "set_gauge", "add_time", "timer"}
+
+
+def _has_metric_registration(fn_node: ast.AST) -> bool:
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Call):
+            f = node.func
+            attr = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if attr in _REGISTER_ATTRS:
+                return True
+    return False
+
+
+def _launches_kernel(ctx, gdot: str, cache: dict) -> bool:
+    """True when the in-tree function named ``gdot`` transitively
+    reaches a ``pallas_call``; True too when it cannot be resolved
+    in-tree (conservative — an unresolvable target in the kernel
+    namespace is assumed to launch)."""
+    cg = ctx.callgraph
+    if gdot in cache:
+        return cache[gdot]
+    cache[gdot] = False          # cycle guard: in-flight -> no launch
+    fn = cg._function_for_global(gdot)
+    if fn is None:
+        cache[gdot] = True
+        return True
+    mod = cg.modules.get(fn.modname)
+    result = False
+    for ref in fn.calls:
+        if ref.dotted is None or ref.is_self:
+            continue
+        g = cg.resolve_dotted(mod, ref.dotted) if mod else ref.dotted
+        if g.split(".")[-1] == "pallas_call":
+            result = True
+            break
+        if mod is not None and "." not in g and g in mod.functions:
+            g = f"{fn.modname}.{g}"   # same-module bare-name call
+        if cg._function_for_global(g) is not None and \
+                _launches_kernel(ctx, g, cache):
+            result = True
+            break
+    cache[gdot] = result
+    return result
+
+
+def _walk(node, in_try, fn, ctx, cache):
+    """Yield ``(call, in_try)`` for kernel-launching calls under
+    ``node``, tracking whether each sits inside a handled ``try``."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return
+    if isinstance(node, ast.Try):
+        handled = bool(node.handlers)
+        for child in node.body:
+            yield from _walk(child, in_try or handled, fn, ctx, cache)
+        for part in (node.handlers, node.orelse, node.finalbody):
+            for child in part:
+                yield from _walk(child, in_try, fn, ctx, cache)
+        return
+    if isinstance(node, ast.Call):
+        gdot = resolve_call(ctx, fn, node)
+        if gdot and gdot.startswith(_KERNEL_NS) and \
+                _launches_kernel(ctx, gdot, cache):
+            yield node, in_try
+    for child in ast.iter_child_nodes(node):
+        yield from _walk(child, in_try, fn, ctx, cache)
+
+
+def check(ctx) -> List[Finding]:
+    """Verify every out-of-kernel Pallas call is guarded + counted."""
+    findings: List[Finding] = []
+    launch_cache: dict = {}
+    for fn in ctx.callgraph.functions.values():
+        if not fn.path.startswith(_SCOPE_PREFIX) or \
+                fn.path.startswith(_EXEMPT_PREFIX):
+            continue
+        counted = None   # lazy: only computed when a kernel call hits
+        for call, in_try in _walk_fn(fn, ctx, launch_cache):
+            name = _callee_label(call)
+            if not in_try:
+                findings.append(Finding(
+                    fn.path, call.lineno, "PFX205",
+                    f"Pallas kernel call `{name}` outside a "
+                    f"try/except fallback in "
+                    f"`{fn.qualname.split(':', 1)[1]}` — wrap it so "
+                    f"kernel rejection degrades to the XLA path "
+                    f"(see ops/attention.py)",
+                    key=f"{fn.qualname}:{name}:try"))
+            if counted is None:
+                counted = _has_metric_registration(fn.node)
+            if not counted:
+                findings.append(Finding(
+                    fn.path, call.lineno, "PFX205",
+                    f"Pallas kernel call `{name}` in "
+                    f"`{fn.qualname.split(':', 1)[1]}` has no dispatch "
+                    f"counter in the enclosing function — telemetry "
+                    f"cannot attest this lowering (docs/"
+                    f"observability.md, Dispatch counters)",
+                    key=f"{fn.qualname}:{name}:counter"))
+    return findings
+
+
+def _walk_fn(fn, ctx, cache):
+    for stmt in fn.node.body:
+        yield from _walk(stmt, False, fn, ctx, cache)
+
+
+def _callee_label(call: ast.Call) -> str:
+    from ..callgraph import _dotted_from
+    return _dotted_from(call.func) or "<kernel>"

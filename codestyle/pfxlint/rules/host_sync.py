@@ -1,0 +1,107 @@
+"""PFX101 — host synchronization inside jit-reachable code.
+
+A host sync inside a traced function either crashes at trace time
+(``np.asarray`` on a tracer, ``float()`` on a tracer) or — worse —
+silently serializes the device pipeline every step
+(``.block_until_ready()``, ``jax.device_get``, ``.item()`` on a
+concrete array captured by closure). The GSPMD serving/training model
+this repo is built on (one program admitted from the host, PAPERS
+2105.04663) forbids all of them past the jit boundary.
+
+Flagged inside any function the call graph marks jit-reachable:
+
+- ``x.item()`` / ``x.block_until_ready()`` method calls;
+- ``jax.device_get(...)`` / ``jax.block_until_ready(...)``;
+- ``np.asarray`` / ``np.array`` / ``np.frombuffer`` on a non-literal
+  argument (literal lists/tuples are trace-time constants and fine);
+- ``float(x)`` / ``int(x)`` / ``bool(x)`` where ``x`` mentions a
+  tracer-typed parameter (sound for directly-jitted functions whose
+  non-static params ARE tracers; annotation-gated otherwise) — shape
+  arithmetic is exempt (``.shape`` / ``.ndim`` / ``len()`` uses).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..engine import Finding
+from . import own_nodes, resolve_call
+
+CODES = ("PFX101",)
+
+_NP_MATERIALIZE = {"numpy.asarray", "numpy.array", "numpy.frombuffer"}
+_JAX_SYNC = {"jax.device_get", "jax.block_until_ready"}
+_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+_SHAPE_ATTRS = {"shape", "ndim", "size", "dtype"}
+
+
+def _is_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(_is_literal(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return _is_literal(node.operand)
+    return False
+
+
+def _mentions_tracer(expr: ast.AST, tracer_params) -> bool:
+    """Whether a cast argument references a tracer param OUTSIDE
+    shape/len context (``int(x.shape[0])`` is static, ``int(x)`` is a
+    sync)."""
+    exempt = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and \
+                node.attr in _SHAPE_ATTRS and \
+                isinstance(node.value, ast.Name):
+            exempt.add(id(node.value))
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id == "len":
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name):
+                        exempt.add(id(sub))
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in tracer_params \
+                and id(node) not in exempt:
+            return True
+    return False
+
+
+def check(ctx) -> List[Finding]:
+    """Scan every jit-reachable function for host-sync hazards."""
+    findings: List[Finding] = []
+
+    def add(fn, node, what):
+        findings.append(Finding(
+            fn.path, node.lineno, "PFX101",
+            f"host sync `{what}` inside jit-reachable "
+            f"`{fn.qualname.split(':', 1)[1]}` "
+            f"(traced via: {fn.traced_via})",
+            key=f"{fn.qualname}:{what}"))
+
+    for fn in ctx.callgraph.reachable_functions():
+        tracers = fn.tracer_params
+        for node in own_nodes(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                if func.attr == "item" and not node.args:
+                    add(fn, node, ".item()")
+                    continue
+                if func.attr == "block_until_ready":
+                    add(fn, node, ".block_until_ready()")
+                    continue
+            gdot = resolve_call(ctx, fn, node)
+            if gdot in _JAX_SYNC:
+                add(fn, node, gdot)
+            elif gdot in _NP_MATERIALIZE:
+                if node.args and not _is_literal(node.args[0]):
+                    add(fn, node, gdot)
+            elif isinstance(func, ast.Name) and \
+                    func.id in _CAST_BUILTINS and len(node.args) == 1:
+                if _mentions_tracer(node.args[0], tracers):
+                    add(fn, node, f"{func.id}() on tracer")
+    return findings

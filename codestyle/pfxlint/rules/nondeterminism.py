@@ -1,0 +1,54 @@
+"""PFX102 — wall-clock / ambient-randomness reads in traced code.
+
+A traced function runs ONCE per compiled shape; whatever
+``time.time()`` or ``np.random.normal()`` returned during that trace
+is baked into the program as a constant and silently reused every
+step — and two hosts tracing the same SPMD program bake DIFFERENT
+constants, which is how multi-process runs deadlock or diverge.
+Randomness belongs to explicit ``jax.random`` keys (which the rule
+never flags: ``from jax import random`` resolves to ``jax.random.*``
+through the alias table, not to the stdlib module).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..engine import Finding
+from . import own_nodes, resolve_call
+
+CODES = ("PFX102",)
+
+#: exact callables, resolved through imports
+_EXACT = {
+    "os.urandom", "uuid.uuid1", "uuid.uuid4",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+}
+
+#: resolved-name prefixes that are nondeterministic wholesale
+_PREFIXES = (
+    "time.", "numpy.random.", "random.", "secrets.",
+)
+
+
+def check(ctx) -> List[Finding]:
+    """Scan every jit-reachable function for ambient nondeterminism."""
+    findings: List[Finding] = []
+    for fn in ctx.callgraph.reachable_functions():
+        for node in own_nodes(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            gdot = resolve_call(ctx, fn, node)
+            if gdot is None:
+                continue
+            if gdot in _EXACT or gdot.startswith(_PREFIXES):
+                findings.append(Finding(
+                    fn.path, node.lineno, "PFX102",
+                    f"nondeterministic `{gdot}` inside jit-reachable "
+                    f"`{fn.qualname.split(':', 1)[1]}` — its value is "
+                    f"baked in at trace time "
+                    f"(traced via: {fn.traced_via})",
+                    key=f"{fn.qualname}:{gdot}"))
+    return findings

@@ -1,0 +1,97 @@
+"""PFX103 — Python control flow branching on a tracer-typed value.
+
+``if x > 0:`` on a tracer raises ``TracerBoolConversionError`` at
+trace time — IF the branch is ever traced. The ones that hide are in
+rarely-exercised config corners, then detonate in production the
+first time a new shape routes through them. The call graph makes this
+checkable statically: for a function rooted DIRECTLY in ``jax.jit``
+(or another tracing wrapper), every parameter not claimed by
+``static_argnames`` / ``static_argnums`` / a ``partial`` binding IS a
+tracer, so a bare comparison on it in an ``if`` / ``while`` /
+``assert`` test is a real bug, not a style nit. For transitively
+reachable helpers only array-annotated parameters are held to this
+(unannotated helper params are usually static config threaded
+through — flagging those would bury the signal).
+
+Exemptions: ``x is None`` / ``x is not None`` guards, ``isinstance``
+checks, and any use through an attribute (``x.shape[0] > 1`` is
+static; ``x.sum() > 0`` sneaks past — a documented blind spot, the
+dynamic error still catches it).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..engine import Finding
+from . import own_nodes
+
+CODES = ("PFX103",)
+
+
+def _excluded_names(test: ast.AST) -> Set[int]:
+    """ids of Name nodes used via attributes / len / isinstance /
+    getattr — never treated as direct tracer reads."""
+    out: Set[int] = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Attribute):
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name):
+                    out.add(id(sub))
+        elif isinstance(node, ast.Call):
+            fname = node.func.id if isinstance(node.func, ast.Name) \
+                else None
+            if fname in ("len", "isinstance", "getattr", "hasattr",
+                         "callable"):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name):
+                        out.add(id(sub))
+    return out
+
+
+def _compare_hits(test: ast.AST, tracers: Set[str]) -> List[str]:
+    """Tracer params compared (non-``is None``) inside a test expr."""
+    excluded = _excluded_names(test)
+    hits: List[str] = []
+    for node in ast.walk(test):
+        if not isinstance(node, ast.Compare):
+            continue
+        if all(isinstance(op, (ast.Is, ast.IsNot))
+               for op in node.ops):
+            continue
+        for operand in [node.left] + list(node.comparators):
+            for sub in ast.walk(operand):
+                if isinstance(sub, ast.Name) and \
+                        sub.id in tracers and id(sub) not in excluded:
+                    hits.append(sub.id)
+    return hits
+
+
+def check(ctx) -> List[Finding]:
+    """Scan reachable functions for Python branches on tracer params."""
+    findings: List[Finding] = []
+    for fn in ctx.callgraph.reachable_functions():
+        tracers = fn.tracer_params
+        if not tracers:
+            continue
+        for node in own_nodes(fn.node):
+            if isinstance(node, (ast.If, ast.While)):
+                test = node.test
+            elif isinstance(node, ast.Assert):
+                test = node.test
+            elif isinstance(node, ast.IfExp):
+                test = node.test
+            else:
+                continue
+            for name in sorted(set(_compare_hits(test, tracers))):
+                kind = type(node).__name__.lower()
+                findings.append(Finding(
+                    fn.path, node.lineno, "PFX103",
+                    f"Python `{kind}` compares tracer-typed param "
+                    f"`{name}` in jit-reachable "
+                    f"`{fn.qualname.split(':', 1)[1]}` — use "
+                    f"`jnp.where`/`lax.cond`, or mark the argument "
+                    f"static (traced via: {fn.traced_via})",
+                    key=f"{fn.qualname}:{kind}:{name}"))
+    return findings

@@ -181,6 +181,8 @@ def _toggle_layer_stack_template(abstract):
                                     sharding=host_sharding)
 
     def walk_template(node):
+        """Mirror the tree into ShapeDtypeStructs, unrolling any
+        stacked-layer ``decoder`` block into per-layer leaves."""
         if _is_mapping(node):
             layer_keys = sorted(
                 (k for k in node if _LAYER_KEY.match(k)),

@@ -47,6 +47,9 @@ class NgramDraftSource:
         self.max_ngram = max_ngram
 
     def propose(self, history: Sequence[int], k: int) -> list[int]:
+        """Draft up to ``k`` tokens by replaying the continuation of
+        the most recent n-gram match in ``history`` (longest n
+        first); empty when nothing matches."""
         hist = list(history)
         L = len(hist)
         for n in range(min(self.max_ngram, L - 1), 0, -1):

@@ -63,6 +63,8 @@ def _populate():
 
 
 def build_dataset(config, mode: str):
+    """Instantiate the dataset named in ``config[mode]["dataset"]``
+    from the registry; None when the mode has no config section."""
     if mode not in ("Train", "Eval", "Test"):
         raise ValueError("mode must be Train, Eval or Test")
     if mode not in config:

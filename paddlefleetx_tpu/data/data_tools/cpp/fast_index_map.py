@@ -90,6 +90,8 @@ def build_sample_idx(sizes, doc_idx, seq_length, num_epochs,
 
 def build_blending_indices(num_datasets: int, weights,
                            size: int) -> tuple:
+    """Weighted round-robin over datasets: per-sample (dataset index,
+    sample-within-dataset index) arrays of length ``size``."""
     if num_datasets > 256:
         raise ValueError(
             f"num_datasets {num_datasets} > 256 (uint8 dataset index)")
@@ -105,6 +107,8 @@ def build_blending_indices(num_datasets: int, weights,
 def build_mapping(docs, sizes, num_epochs, max_num_samples,
                   max_seq_length, short_seq_prob, seed,
                   min_num_sent: int = 2) -> np.ndarray:
+    """BERT-style [start, end, target-length] sample map (two-pass:
+    count with a null pointer, then fill)."""
     docs = np.ascontiguousarray(docs, np.int64)
     sizes = np.ascontiguousarray(sizes, np.int32)
     n_docs = len(docs) - 1
@@ -121,6 +125,8 @@ def build_mapping(docs, sizes, num_epochs, max_num_samples,
 def build_blocks_mapping(docs, sizes, titles_sizes, num_epochs,
                          max_num_samples, max_seq_length, seed,
                          use_one_sent_blocks: bool = False) -> np.ndarray:
+    """ICT/retrieval block map: [start, end, doc, block] rows, same
+    two-pass count-then-fill protocol as :func:`build_mapping`."""
     docs = np.ascontiguousarray(docs, np.int64)
     sizes = np.ascontiguousarray(sizes, np.int32)
     titles_sizes = np.ascontiguousarray(titles_sizes, np.int32)

@@ -31,6 +31,7 @@ import numpy as np
 
 
 def get_args(argv=None):
+    """Parse the preprocessing CLI (input/tokenizer/worker groups)."""
     parser = argparse.ArgumentParser()
     group = parser.add_argument_group(title="data input/output")
     group.add_argument("--input_path", type=str, required=True,
@@ -53,11 +54,15 @@ def get_args(argv=None):
 
 
 class IdentitySplitter:
+    """Whole document as one "sentence" (the default splitter)."""
+
     def tokenize(self, text):
         return [text]
 
 
 class NewlineSplitter:
+    """One sentence per line (``--split_sentences``)."""
+
     def tokenize(self, text):
         return text.split("\n")
 
@@ -97,6 +102,8 @@ class Converter:
 
 
 def main(argv=None):
+    """Tokenize jsonl shards in a worker pool and write the packed
+    ``.npy``/``.npz`` ids + lens pair."""
     args = get_args(argv)
     file_paths = []
     if os.path.isfile(args.input_path):

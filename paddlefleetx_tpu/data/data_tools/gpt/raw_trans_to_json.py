@@ -26,6 +26,7 @@ from functools import partial
 
 
 def get_args(argv=None):
+    """Parse the raw-text -> jsonl conversion CLI."""
     parser = argparse.ArgumentParser()
     parser.add_argument("--input_path", type=str, required=True,
                         help="raw files; folder or file path")
@@ -74,6 +75,7 @@ def raw_text_to_json(path, doc_spliter="", json_key="text",
 
 
 def merge_file(file_paths, output_path):
+    """Concatenate per-worker jsonl shards into one output file."""
     if not output_path.endswith(".jsonl"):
         output_path = output_path + ".jsonl"
     print(f"Merging files into {output_path}")
@@ -100,6 +102,8 @@ def shuffle_file(output_path, seed=1234):
 
 
 def main(argv=None):
+    """Convert raw text files to jsonl in a worker pool, then merge
+    (and optionally shuffle) the shards."""
     args = get_args(argv)
     start = time.time()
 

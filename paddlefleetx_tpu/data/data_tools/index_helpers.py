@@ -151,6 +151,8 @@ def build_mapping(docs, sizes, num_epochs, max_num_samples,
     rows = []
 
     def run(emit):
+        """One pass over the epoch loop; ``emit`` collects rows (the
+        C++ two-pass count/fill protocol)."""
         gen = _MT19937(seed)
 
         def next_target(_doc):

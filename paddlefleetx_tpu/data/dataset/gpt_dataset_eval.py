@@ -28,6 +28,8 @@ from ..tokenizers.gpt_tokenizer import GPTTokenizer
 
 
 def wikitext_detokenizer(string: str) -> str:
+    """Invert the WikiText tokenization quirks (`` @-@ ``, spaced
+    punctuation) so perplexity is scored on natural text."""
     string = string.replace("s '", "s'")
     string = re.sub(r"/' [0-9]/", r"/'[0-9]/", string)
     string = string.replace(" @-@ ", "-")
@@ -68,6 +70,9 @@ def _construct_sample(tokens: List[int], pad_idx: int):
 
 
 class LM_Eval_Dataset:
+    """Sliding-window LM perplexity eval over a raw text file
+    (WikiText-style; ``overlapping_eval`` sets the window stride)."""
+
     def __init__(self, input_dir: str, max_seq_len: int,
                  overlapping_eval: Optional[int] = None,
                  tokenizer: Optional[GPTTokenizer] = None, **_):
@@ -101,6 +106,9 @@ class LM_Eval_Dataset:
 
 
 class Lambada_Eval_Dataset:
+    """LAMBADA last-word cloze eval from the jsonl release; the loss
+    mask covers only the target word's tokens."""
+
     def __init__(self, input_dir: str, max_seq_len: int,
                  tokenizer: Optional[GPTTokenizer] = None, **_):
         tokenizer = tokenizer or GPTTokenizer.from_pretrained("gpt2")

@@ -40,6 +40,9 @@ def data_augmentation_for_imagen(img, resolution: int) -> np.ndarray:
 
 
 class ImagenDataset:
+    """Image + tokenized-caption pairs for Imagen training from a
+    directory of images with sidecar captions."""
+
     def __init__(self, input_path: str, input_resolution: int = 64,
                  max_seq_len: int = 128, split: str = "train",
                  input_resolusion: Optional[int] = None, **_):

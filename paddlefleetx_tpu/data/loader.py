@@ -70,6 +70,10 @@ def _worker_fetch(seed, indices):
 
 
 class DataLoader:
+    """Minimal process-pool loader: batch indices from
+    ``batch_sampler``, collated in workers, prefetched
+    ``prefetch_depth`` batches ahead."""
+
     def __init__(self, dataset, batch_sampler,
                  collate_fn: Optional[Callable] = None,
                  num_workers: int = 1, prefetch_depth: int = 2,

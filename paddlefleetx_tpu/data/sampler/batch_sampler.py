@@ -15,6 +15,9 @@ import numpy as np
 
 
 class GPTBatchSampler:
+    """Rank-sharded batch sampler resumable from
+    ``consumed_samples`` (the checkpointed data position)."""
+
     def __init__(self, dataset, batch_size: int, num_replicas: int = 1,
                  rank: int = 0, shuffle: bool = False,
                  drop_last: bool = True, consumed_samples: int = 0,

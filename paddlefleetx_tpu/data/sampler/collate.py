@@ -12,6 +12,8 @@ import numpy as np
 
 
 class Stack:
+    """Stack equal-shaped field values along ``axis``."""
+
     def __init__(self, dtype: Optional[str] = None, axis: int = 0):
         self._dtype = dtype
         self._axis = axis
@@ -22,6 +24,9 @@ class Stack:
 
 
 class Pad:
+    """Pad ragged field values to the batch max along ``axis``, then
+    stack."""
+
     def __init__(self, pad_val: float = 0, axis: int = 0,
                  dtype: Optional[str] = None, pad_right: bool = True):
         self._pad_val = pad_val
@@ -61,6 +66,8 @@ class Tuple:
 
 
 class Dict:
+    """Apply a per-key combinator to dict-shaped samples."""
+
     def __init__(self, fns: dict):
         self._fns = fns
 

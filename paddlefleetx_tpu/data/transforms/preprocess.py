@@ -88,6 +88,8 @@ class ResizeImage:
 
 
 class CenterCropImage:
+    """Crop the center ``size`` window of an image."""
+
     def __init__(self, size):
         self.size = (size, size) if isinstance(size, int) else size
 
@@ -179,6 +181,8 @@ class NormalizeImage:
 
 
 class ToCHWImage:
+    """HWC -> CHW layout for the model input."""
+
     def __call__(self, img):
         return np.ascontiguousarray(np.asarray(img).transpose((2, 0, 1)))
 

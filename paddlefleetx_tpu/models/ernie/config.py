@@ -15,6 +15,8 @@ from typing import Optional
 
 @dataclasses.dataclass(frozen=True)
 class ErnieConfig:
+    """Static (hashable) ERNIE architecture hyperparameters."""
+
     vocab_size: int = 50304
     hidden_size: int = 768
     num_hidden_layers: int = 12

@@ -45,6 +45,8 @@ def apply_mlm_masking(rng: jax.Array, tokens: jax.Array,
 
 @register_module("ErnieModule")
 class ErnieModule(LanguageModule):
+    """ERNIE masked-LM pretraining module (MLM + SOP heads)."""
+
     def __init__(self, configs):
         from ..language_utils import process_data_configs
         process_data_configs(configs)

@@ -21,6 +21,9 @@ from .model import GPTForPretraining, cross_entropy_loss
 
 @register_module("GPTModule")
 class GPTModule(LanguageModule):
+    """GPT causal-LM training module: loss, generation and the
+    flash-dropout admission gate."""
+
     #: loss_fn microbatches internally when pp>1 (engine then skips its
     #: own accumulation scan)
     supports_pipeline = True
@@ -159,6 +162,8 @@ class GPTModule(LanguageModule):
             lambda p: self.loss_fn(p, batch, rng, train=True))(params)
 
     def loss_fn(self, params, batch, rng, train: bool = True):
+        """Masked-mean LM loss; routes through the pipelined loss
+        when pp > 1."""
         tokens, position_ids, labels, loss_mask = batch
         pp, m, deterministic = self._pp_setup(tokens, train)
         if pp > 1:
@@ -284,6 +289,8 @@ class GPTGenerationModule(GPTModule):
         return fn, spec, metadata
 
     def generate(self, params, texts, rng=None):
+        """Tokenize ``texts``, left-pad to a batch, decode with the
+        configured generation strategy and return the strings."""
         import jax
         import numpy as np
         from .generation import generate, left_pad_batch
@@ -366,6 +373,7 @@ class GPTEvalModule(GPTModule):
         return batch
 
     def validation_step_end(self, log_dict):
+        """Accumulate the eval score (loss or cloze correct count)."""
         from ...utils.log import logger
         if not self.cloze_eval:
             self.total_score += log_dict["loss"] / (
@@ -379,6 +387,7 @@ class GPTEvalModule(GPTModule):
                     self.total_score)
 
     def validation_epoch_end(self, log_dict):
+        """Report final perplexity (LM eval) or accuracy (cloze)."""
         import math
         from ...utils.log import logger
         if not self.cloze_eval:

@@ -43,6 +43,9 @@ def _resize(x: jax.Array, size: int) -> jax.Array:
 
 @dataclasses.dataclass(frozen=True)
 class ImagenConfig:
+    """Static config for the Imagen cascade (one entry per U-Net
+    stage where a field is a tuple)."""
+
     unets: Tuple[str, ...] = ("Unet64_397M",)
     image_sizes: Tuple[int, ...] = (64,)
     text_embed_dim: int = 1024
@@ -87,6 +90,7 @@ class ImagenModel(nn.Module):
     config: ImagenConfig
 
     def setup(self):
+        """Instantiate the per-stage U-Nets and noise schedulers."""
         cfg = self.config
         n = len(cfg.unets)
         schedules = list(_per_unet(cfg.noise_schedules, n))
@@ -265,6 +269,7 @@ class ImagenModel(nn.Module):
             time_pairs = time_pairs[skip_steps:]
 
         def step(mdl, carry, tp):
+            """One DDPM sampling step (t -> t_next)."""
             x, k = carry
             t, t_next = tp[0], tp[1]
             pred = mdl._pred_with_cond_scale(

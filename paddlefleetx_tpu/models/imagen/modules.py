@@ -18,6 +18,9 @@ from .modeling import build_imagen_model, imagen_criterion
 
 @register_module("ImagenModule")
 class ImagenModule(BasicModule):
+    """Imagen diffusion training module (one cascade stage per
+    run)."""
+
     #: forward draws times/noise/cond-drop from this rng collection
     init_rng_collections = ("diffusion",)
 
@@ -52,6 +55,7 @@ class ImagenModule(BasicModule):
         return model.init(rngs, *samples, unet_number=self.unet_number)
 
     def loss_fn(self, params, batch, rng, train: bool = True):
+        """Denoising regression loss for the configured stage."""
         images, text_embeds, text_masks = batch
         if self.bf16_compute:
             # bf16 master->compute cast of params ONLY: images stay

@@ -80,6 +80,8 @@ def _t(v, n: int) -> Tuple:
 
 @dataclasses.dataclass(frozen=True)
 class UnetConfig:
+    """Static architecture hyperparameters for one U-Net stage."""
+
     dim: int = 128
     dim_mults: Sequence[int] = (1, 2, 4, 8)
     num_resnet_blocks: Union[int, Sequence[int]] = 2
@@ -150,6 +152,8 @@ class PerceiverResampler(nn.Module):
 
 
 class PerceiverAttention(nn.Module):
+    """Latents-attend-to-tokens block of the Perceiver resampler."""
+
     config: UnetConfig
 
     @nn.compact

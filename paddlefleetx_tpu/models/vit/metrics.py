@@ -13,6 +13,8 @@ import jax.numpy as jnp
 
 
 class TopkAcc:
+    """Top-k classification accuracy (``top1``/``top5`` keys)."""
+
     def __init__(self, topk: Union[int, Sequence[int]] = (1, 5)):
         self.topk = [topk] if isinstance(topk, int) else list(topk)
 

@@ -20,6 +20,9 @@ from .vit import build_vision_model
 
 @register_module("GeneralClsModule")
 class GeneralClsModule(BasicModule):
+    """Image-classification training module (ViT et al.): configured
+    loss heads plus top-k eval metrics."""
+
     def __init__(self, configs):
         model_cfg = configs.Model
         if "train" not in model_cfg.get("loss", {}):

@@ -35,6 +35,8 @@ from ...parallel.sharding import with_logical_constraint
 
 @dataclasses.dataclass(frozen=True)
 class ViTConfig:
+    """Static ViT architecture hyperparameters."""
+
     img_size: int = 224
     patch_size: int = 16
     in_chans: int = 3
@@ -115,6 +117,8 @@ class ViTAttention(nn.Module):
 
 
 class ViTMLP(nn.Module):
+    """Transformer MLP block (GELU, ``mlp_ratio`` expansion)."""
+
     config: ViTConfig
 
     @nn.compact

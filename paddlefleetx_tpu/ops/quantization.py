@@ -28,6 +28,8 @@ import jax.numpy as jnp
 
 @dataclasses.dataclass(frozen=True)
 class QuantizationConfig:
+    """QAT settings mirroring the reference's quantization surface."""
+
     enable: bool = False
     weight_quantize_type: str = "abs_max"
     activation_quantize_type: str = "moving_average_abs_max"

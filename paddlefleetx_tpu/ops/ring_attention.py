@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..observability import metrics
+
 try:                                    # jax >= 0.5 re-exports it
     _shard_map = jax.shard_map
 except AttributeError:                  # 0.4.x spelling
@@ -84,13 +86,16 @@ def _ring_flash(q, k, v, axis_name, causal, scale):
     b, sq, h, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
 
+    # admission is hoisted: ring_attention only selects this path after
+    # _flash_block_ok proved the block shape admissible, and the merge
+    # needs the kernel's raw lse — no per-block try/fallback possible
     def blk_diag(kv):
-        return flash_attention_with_lse(q, kv[0], kv[1], causal=True,
-                                        sm_scale=scale)
+        return flash_attention_with_lse(  # pfxlint: disable=PFX205
+            q, kv[0], kv[1], causal=True, sm_scale=scale)
 
     def blk_full(kv):
-        return flash_attention_with_lse(q, kv[0], kv[1], causal=False,
-                                        sm_scale=scale)
+        return flash_attention_with_lse(  # pfxlint: disable=PFX205
+            q, kv[0], kv[1], causal=False, sm_scale=scale)
 
     def blk_dead(kv):
         # constants must carry q's device-varying type or the cond
@@ -100,6 +105,8 @@ def _ring_flash(q, k, v, axis_name, causal, scale):
                 jnp.full((b, h, sq), NEG_INF, jnp.float32) + zq)
 
     def step(carry, i):
+        """One ring hop: flash the resident KV block (diag/full/dead
+        by ring position), merge via logsumexp, rotate KV."""
         k_blk, v_blk, out, lse = carry
         src = (idx - i) % n
         if causal:
@@ -158,6 +165,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if use_flash is None:
         use_flash = (jax.default_backend() == "tpu"
                      and _flash_block_ok(sq, d))
+    metrics.inc("attention/ring/flash" if use_flash
+                else "attention/ring/dense")
     if use_flash:
         return _ring_flash(q, k, v, axis_name, causal, scale)
 
@@ -168,6 +177,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     perm = [(i, (i + 1) % n) for i in range(n)]  # send KV to the right
 
     def step(carry, i):
+        """One ring hop of the dense path: streaming-softmax merge of
+        the resident KV block, then rotate KV."""
         k_blk, v_blk, out, m, l = carry  # noqa: E741
         # after i rotations, this device holds the KV block that
         # originated at ring position idx - i

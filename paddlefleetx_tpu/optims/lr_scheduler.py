@@ -48,6 +48,7 @@ def vit_lr_scheduler(learning_rate: float, step_each_epoch: int, epochs: int,
         warmup_steps = t_max - 1
 
     def schedule(step):
+        """LR at ``step``: linear warmup then the decay curve."""
         step = jnp.asarray(step, jnp.float32)
         progress = (step - warmup_steps) / max(float(t_max - warmup_steps),
                                                1.0)

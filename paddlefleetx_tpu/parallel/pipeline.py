@@ -199,6 +199,8 @@ def pipeline_forward(
     slot_stage = jax.vmap(jax.vmap(stage_fn))
 
     def tick(carry, t):
+        """One pipeline clock: every virtual stage computes, then
+        activations rotate one hop."""
         state, acc = carry
         # virtual stage 0 ingests microbatch t (clamped past the fill
         # phase — drain ticks feed it a stale microbatch whose output
@@ -349,6 +351,8 @@ def pipeline_value_and_grad(
     k_arr = jnp.arange(K)
 
     def tick(carry, t):
+        """One 1F1B clock: forward wave + backward wave + grad
+        accumulation in a single step."""
         fstate, b_out, dy_prev, stash, loss_sum, dparams, dhead, dx = \
             carry
 

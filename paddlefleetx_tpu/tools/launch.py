@@ -128,6 +128,7 @@ def launch(cmd: List[str], nprocs: int = 1, nnodes: int = 1,
 
 
 def main(argv=None) -> None:
+    """Parse the launcher CLI and spawn the per-process workers."""
     ap = argparse.ArgumentParser(
         prog="pfx-launch",
         description="launch distributed training "

@@ -31,6 +31,7 @@ def read_command(shell_cmd_list_filename):
 
 
 def parallel_process(cmd_list, nproc: int = 20):
+    """Run shell commands split across ``nproc`` worker processes."""
     if nproc > multiprocessing.cpu_count():
         warnings.warn(
             "The set number of processes exceeds the number of cpu "

@@ -237,6 +237,8 @@ def process_global_configs(config: AttrDict) -> None:
 
 
 def process_engine_config(config: AttrDict) -> None:
+    """Fill Engine-section defaults (save/load, logging, run limits)
+    in place, mirroring the reference's config normalization."""
     engine = config.setdefault("Engine", AttrDict())
     save_load = engine.setdefault("save_load", AttrDict())
     if save_load.get("save_steps") in (None, -1):

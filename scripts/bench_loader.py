@@ -56,6 +56,7 @@ def collate(batch):
 
 
 def main():
+    """Time the loader at several worker counts on synthetic JPEGs."""
     ds = JpegDataset()
     batches = [list(range(i, i + BATCH))
                for i in range(0, N_IMAGES, BATCH)]

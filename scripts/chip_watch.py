@@ -24,6 +24,8 @@ from bench import probe_once  # noqa: E402
 
 
 def main():
+    """Poll the TPU backend probe until it answers or the budget
+    runs out; exit 0 only on a live chip."""
     p = argparse.ArgumentParser()
     p.add_argument("--budget", type=float, default=540.0,
                    help="total seconds to watch before giving up")

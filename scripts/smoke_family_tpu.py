@@ -35,6 +35,8 @@ def _step_time(step, state, *batch, n=10):
 
 
 def smoke_vit(batch=128):
+    """One jitted ViT train step on chip; returns the images/s
+    record."""
     from paddlefleetx_tpu.models.vit.vit import VISION_MODELS
     from paddlefleetx_tpu.models.vit.loss import ViTCELoss
 
@@ -68,6 +70,8 @@ def smoke_vit(batch=128):
 
 
 def smoke_imagen(batch=16):
+    """One jitted Imagen train step on chip; returns the images/s
+    record."""
     from paddlefleetx_tpu.models.imagen.modeling import (
         build_imagen_model, imagen_criterion,
     )

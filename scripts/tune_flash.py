@@ -101,8 +101,11 @@ def sweep(point: str, b: int, h: int, s: int, d: int):
             if s % bq or s % bkv:
                 continue
             try:
-                fwd = _time(jax.jit(functools.partial(
-                    fa.flash_attention, causal=True, block_q=bq,
+                # close over the config instead of jit(partial(...)):
+                # the jit boundary then carries exactly q/k/v and no
+                # unbound kernel param can ever arrive as a tracer
+                fwd = _time(jax.jit(lambda q, k, v: fa.flash_attention(
+                    q, k, v, causal=True, block_q=bq,
                     block_kv=bkv)), q, k, v)
                 vag = _time(jax.jit(jax.grad(functools.partial(
                     flash_loss, bq=bq, bkv=bkv), argnums=(0, 1, 2))),

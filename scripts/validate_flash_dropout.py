@@ -36,6 +36,8 @@ import numpy as np
 
 
 def main():
+    """On-chip statistical validation of in-kernel flash dropout
+    (keep-rate and scaling against the XLA path)."""
     if jax.devices()[0].platform != "tpu":
         print("SKIP: needs a real TPU")
         return 2

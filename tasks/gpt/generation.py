@@ -20,6 +20,7 @@ from paddlefleetx_tpu.utils.log import logger  # noqa: E402
 
 
 def main():
+    """Decode ``--text`` with the configured GPT checkpoint."""
     parser = argparse.ArgumentParser()
     parser.add_argument("-c", "--config", required=True)
     parser.add_argument("-o", "--override", action="append", default=[])

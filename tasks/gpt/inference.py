@@ -24,6 +24,7 @@ from paddlefleetx_tpu.utils.config import get_config, parse_args  # noqa: E402
 
 
 def main():
+    """Run the exported-artifact inference demo from a config."""
     args = parse_args()
     env.init_dist_env()
     cfg = get_config(args.config, overrides=args.override, show=False)

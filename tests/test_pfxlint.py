@@ -1,0 +1,456 @@
+"""pfxlint: call-graph reachability, rule fixtures, suppression and
+baseline round-trips, and the tier-1 gate over the real tree.
+
+Every fixture runs through ``LintContext.from_sources`` (in-memory,
+no tmp files) and targets one rule family via ``run_rules(select=)``
+so docstring findings never leak into hazard assertions. The final
+tests run the real engine over the real repository — the acceptance
+criterion that ``python -m codestyle.pfxlint`` exits 0 — and pin the
+docs/counter/knob contract by deleting one row and watching the gate
+trip.
+"""
+
+import os
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from codestyle.pfxlint import engine  # noqa: E402
+from codestyle.pfxlint.engine import (Finding, LintContext,  # noqa: E402
+                                      run_lint, run_rules)
+
+MOD = '"""Fixture module."""\n'
+
+
+def _ctx(sources, docs=None):
+    return LintContext.from_sources(sources, docs)
+
+
+def _codes(sources, select, docs=None):
+    findings = run_rules(_ctx(sources, docs), select=set(select))
+    return [f.code for f in findings]
+
+
+# -- call graph --------------------------------------------------------
+
+def test_decorated_jit_function_is_direct_root():
+    src = MOD + (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x.item()\n")
+    ctx = _ctx({"paddlefleetx_tpu/a.py": src})
+    fn = ctx.callgraph.functions["paddlefleetx_tpu.a:f"]
+    assert fn.direct_traced and fn.jit_reachable
+    assert "x" in fn.tracer_params
+
+
+def test_wrapped_assignment_marks_root():
+    src = MOD + (
+        "import jax\n"
+        "def f(x):\n"
+        "    return x\n"
+        "g = jax.jit(f)\n")
+    ctx = _ctx({"paddlefleetx_tpu/a.py": src})
+    assert ctx.callgraph.functions["paddlefleetx_tpu.a:f"].direct_traced
+
+
+def test_static_argnames_are_not_tracers():
+    src = MOD + (
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnames=('mode',))\n"
+        "def f(x, mode):\n"
+        "    return x\n")
+    ctx = _ctx({"paddlefleetx_tpu/a.py": src})
+    fn = ctx.callgraph.functions["paddlefleetx_tpu.a:f"]
+    assert "mode" not in fn.tracer_params
+    assert "x" in fn.tracer_params
+
+
+def test_transitive_reachability_via_call_and_import_alias():
+    kernel = MOD + (
+        "def helper(x, y):\n"
+        "    return x + y\n")
+    entry = MOD + (
+        "import jax\n"
+        "from paddlefleetx_tpu.b import helper\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return helper(x, 1)\n")
+    ctx = _ctx({"paddlefleetx_tpu/a.py": entry,
+                "paddlefleetx_tpu/b.py": kernel})
+    h = ctx.callgraph.functions["paddlefleetx_tpu.b:helper"]
+    assert h.jit_reachable and not h.direct_traced
+    # transitively reachable + unannotated params -> NOT assumed tracers
+    assert h.tracer_params == set()
+
+
+def test_transitive_array_annotation_is_tracer():
+    helper = MOD + (
+        "import jax\n"
+        "def helper(x: jax.Array, n: int):\n"
+        "    return x\n")
+    entry = MOD + (
+        "import jax\n"
+        "from paddlefleetx_tpu.b import helper\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return helper(x, 1)\n")
+    ctx = _ctx({"paddlefleetx_tpu/a.py": entry,
+                "paddlefleetx_tpu/b.py": helper})
+    h = ctx.callgraph.functions["paddlefleetx_tpu.b:helper"]
+    assert h.tracer_params == {"x"}
+
+
+def test_flax_compact_method_is_root():
+    src = MOD + (
+        "import flax.linen as nn\n"
+        "class Block(nn.Module):\n"
+        '    """Doc."""\n'
+        "    @nn.compact\n"
+        "    def __call__(self, x):\n"
+        "        return x\n")
+    ctx = _ctx({"paddlefleetx_tpu/a.py": src})
+    fn = ctx.callgraph.functions["paddlefleetx_tpu.a:Block.__call__"]
+    assert fn.jit_reachable
+
+
+# -- hazard rules ------------------------------------------------------
+
+def test_pfx101_item_in_traced_function():
+    src = MOD + (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x.item()\n")
+    assert _codes({"paddlefleetx_tpu/a.py": src},
+                  ["PFX101"]) == ["PFX101"]
+
+
+def test_pfx101_clean_outside_traced_context():
+    src = MOD + (
+        "def f(x):\n"
+        "    return x.item()\n")
+    assert _codes({"paddlefleetx_tpu/a.py": src}, ["PFX101"]) == []
+
+
+def test_pfx101_shape_access_is_exempt():
+    src = MOD + (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x.shape[0])\n")
+    assert _codes({"paddlefleetx_tpu/a.py": src}, ["PFX101"]) == []
+
+
+def test_pfx102_wall_clock_in_traced_function():
+    src = MOD + (
+        "import jax\n"
+        "import time\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    t = time.time()\n"
+        "    return x + t\n")
+    assert _codes({"paddlefleetx_tpu/a.py": src},
+                  ["PFX102"]) == ["PFX102"]
+
+
+def test_pfx102_jax_random_is_clean():
+    src = MOD + (
+        "import jax\n"
+        "from jax import random\n"
+        "@jax.jit\n"
+        "def f(key, x):\n"
+        "    return x + random.normal(key, x.shape)\n")
+    assert _codes({"paddlefleetx_tpu/a.py": src}, ["PFX102"]) == []
+
+
+def test_pfx103_branch_on_tracer():
+    src = MOD + (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        return x\n"
+        "    return -x\n")
+    assert _codes({"paddlefleetx_tpu/a.py": src},
+                  ["PFX103"]) == ["PFX103"]
+
+
+def test_pfx103_branch_on_static_is_clean():
+    src = MOD + (
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnames=('n',))\n"
+        "def f(x, n):\n"
+        "    if n > 0:\n"
+        "        return x\n"
+        "    return -x\n")
+    assert _codes({"paddlefleetx_tpu/a.py": src}, ["PFX103"]) == []
+
+
+# -- contract rules ----------------------------------------------------
+
+_COUNTER_SRC = MOD + (
+    "from paddlefleetx_tpu.observability import metrics\n"
+    "def f(flag):\n"
+    "    metrics.inc('testns/a' if flag else 'testns/b')\n"
+    "    metrics.inc('testns/undocumented')\n")
+
+
+def test_pfx201_undocumented_counter_fires():
+    docs = {"docs/observability.md": "- `testns/{a,b}` — the pair\n"}
+    findings = run_rules(
+        _ctx({"paddlefleetx_tpu/m.py": _COUNTER_SRC}, docs),
+        select={"PFX201"})
+    assert [f.key for f in findings] == ["testns/undocumented"]
+
+
+def test_pfx202_stale_docs_row_fires():
+    docs = {"docs/observability.md":
+            "- `testns/{a,b,gone}` and `testns/undocumented` — rows\n"}
+    findings = run_rules(
+        _ctx({"paddlefleetx_tpu/m.py": _COUNTER_SRC}, docs),
+        select={"PFX202"})
+    assert [f.key for f in findings] == ["testns/gone"]
+
+
+def test_counter_glob_counts_for_neither_direction():
+    # a surviving glob row must NOT satisfy the deleted concrete row
+    docs = {"docs/observability.md":
+            "- `testns/*` series plus `testns/undocumented`\n"}
+    findings = run_rules(
+        _ctx({"paddlefleetx_tpu/m.py": _COUNTER_SRC}, docs),
+        select={"PFX201", "PFX202"})
+    assert sorted(f.key for f in findings) == ["testns/a", "testns/b"]
+
+
+def test_timer_synthesizes_docs_optional_calls_row():
+    src = MOD + (
+        "from paddlefleetx_tpu.observability import metrics\n"
+        "def f():\n"
+        "    with metrics.get_registry().timer('testns/t'):\n"
+        "        pass\n")
+    docs = {"docs/observability.md":
+            "- `testns/t` timer + `testns/t/calls`\n"}
+    findings = run_rules(_ctx({"paddlefleetx_tpu/m.py": src}, docs),
+                         select={"PFX201", "PFX202"})
+    assert findings == []
+
+
+def test_pfx203_undocumented_knob_and_glob_does_not_satisfy():
+    src = MOD + (
+        "import os\n"
+        "V = os.environ.get('PFX_TESTONLY_KNOB', '0')\n")
+    docs = {"docs/observability.md": "see the `PFX_TESTONLY_*` knobs\n"}
+    findings = run_rules(_ctx({"paddlefleetx_tpu/m.py": src}, docs),
+                         select={"PFX203"})
+    assert [f.key for f in findings] == ["PFX_TESTONLY_KNOB"]
+
+
+def test_pfx204_stale_documented_knob():
+    src = MOD + "X = 1\n"
+    docs = {"docs/observability.md": "set `PFX_TESTONLY_GONE` to 1\n"}
+    findings = run_rules(_ctx({"paddlefleetx_tpu/m.py": src}, docs),
+                         select={"PFX204"})
+    assert [f.key for f in findings] == ["PFX_TESTONLY_GONE"]
+
+
+_KERNEL_SRC = MOD + (
+    "from jax.experimental import pallas as pl\n"
+    "def kern(ref):\n"
+    "    pass\n"
+    "def launch(x):\n"
+    "    return pl.pallas_call(kern)(x)\n"
+    "def probe(s):\n"
+    "    if s % 8:\n"
+    "        raise NotImplementedError('bad shape')\n"
+    "    return s\n")
+
+
+def test_pfx205_unguarded_kernel_launch_fires_twice():
+    caller = MOD + (
+        "from paddlefleetx_tpu.ops.pallas.kern import launch\n"
+        "def f(x):\n"
+        "    return launch(x)\n")
+    findings = run_rules(
+        _ctx({"paddlefleetx_tpu/ops/pallas/kern.py": _KERNEL_SRC,
+              "paddlefleetx_tpu/models/m.py": caller}),
+        select={"PFX205"})
+    assert sorted(f.key.rsplit(":", 1)[1] for f in findings) == \
+        ["counter", "try"]
+
+
+def test_pfx205_guarded_and_counted_is_clean():
+    caller = MOD + (
+        "from paddlefleetx_tpu.observability import metrics\n"
+        "from paddlefleetx_tpu.ops.pallas.kern import launch\n"
+        "def f(x):\n"
+        "    try:\n"
+        "        out = launch(x)\n"
+        "        metrics.inc('attention/flash')\n"
+        "        return out\n"
+        "    except (ImportError, NotImplementedError):\n"
+        "        metrics.inc('attention/dense')\n"
+        "        return x\n")
+    findings = run_rules(
+        _ctx({"paddlefleetx_tpu/ops/pallas/kern.py": _KERNEL_SRC,
+              "paddlefleetx_tpu/models/m.py": caller}),
+        select={"PFX205"})
+    assert findings == []
+
+
+def test_pfx205_admission_probe_is_exempt():
+    caller = MOD + (
+        "from paddlefleetx_tpu.ops.pallas.kern import probe\n"
+        "def ok(s):\n"
+        "    try:\n"
+        "        probe(s)\n"
+        "        return True\n"
+        "    except NotImplementedError:\n"
+        "        return False\n"
+        "def bare(s):\n"
+        "    return probe(s)\n")
+    findings = run_rules(
+        _ctx({"paddlefleetx_tpu/ops/pallas/kern.py": _KERNEL_SRC,
+              "paddlefleetx_tpu/models/m.py": caller}),
+        select={"PFX205"})
+    assert findings == []   # probe never reaches pallas_call
+
+
+def test_docstring_rule_matches_standalone_checker():
+    src = "def f():\n    pass\n"   # no module docstring
+    codes = _codes({"paddlefleetx_tpu/a.py": src},
+                   ["D001", "D002", "D003", "D004", "D005", "D006"])
+    assert codes == ["D001"]
+    sys.path.insert(0, os.path.join(REPO, "codestyle"))
+    from docstring_checker import check_source
+    assert [f.code for f in check_source(src)
+            if f.code.startswith("D00") and f.code <= "D006"] == codes
+
+
+# -- suppression and baseline ------------------------------------------
+
+def test_inline_suppression_and_file_suppression():
+    src = MOD + (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x.item()  # pfxlint: disable=PFX101\n")
+    ctx = _ctx({"paddlefleetx_tpu/a.py": src})
+    raw = run_rules(ctx, select={"PFX101"})
+    kept, suppressed = engine.apply_suppressions(ctx, raw)
+    assert kept == [] and [f.code for f in suppressed] == ["PFX101"]
+
+    src2 = MOD.rstrip("\n") + "  # pfxlint: disable-file=PFX101\n" + (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x.item()\n")
+    ctx2 = _ctx({"paddlefleetx_tpu/a.py": src2})
+    kept2, sup2 = engine.apply_suppressions(
+        ctx2, run_rules(ctx2, select={"PFX101"}))
+    assert kept2 == [] and len(sup2) == 1
+
+
+def test_baseline_round_trip(tmp_path):
+    f = Finding("paddlefleetx_tpu/a.py", 4, "PFX101",
+                "host sync", key="a.py:f:item")
+    path = str(tmp_path / "baseline.txt")
+    engine.write_baseline(path, [f], header="why: legacy")
+    entries = engine.load_baseline(path)
+    assert entries == [f.fingerprint()]
+    # fingerprints are line-independent
+    f2 = Finding("paddlefleetx_tpu/a.py", 99, "PFX101",
+                 "host sync", key="a.py:f:item")
+    assert f2.fingerprint() in set(entries)
+
+
+def test_run_lint_baseline_carries_and_reports_stale(tmp_path):
+    root = tmp_path / "repo"
+    (root / "paddlefleetx_tpu").mkdir(parents=True)
+    (root / "paddlefleetx_tpu" / "a.py").write_text(
+        MOD + "import jax\n@jax.jit\ndef f(x):\n    return x.item()\n")
+    res = run_lint(str(root), select={"PFX101"}, use_baseline=False)
+    assert [f.code for f in res.findings] == ["PFX101"]
+
+    bl = root / "baseline.txt"
+    engine.write_baseline(str(bl), res.findings)
+    res2 = run_lint(str(root), select={"PFX101"},
+                    baseline_path=str(bl))
+    assert res2.findings == [] and len(res2.baselined) == 1
+    assert res2.exit_code == 0
+
+    # stale entries are reported once the finding is fixed
+    (root / "paddlefleetx_tpu" / "a.py").write_text(
+        MOD + "import jax\n@jax.jit\ndef f(x):\n    return x\n")
+    res3 = run_lint(str(root), select={"PFX101"},
+                    baseline_path=str(bl))
+    assert res3.findings == [] and len(res3.unused_baseline) == 1
+
+
+# -- the real tree (tier-1 acceptance) ---------------------------------
+
+def test_real_tree_is_clean():
+    res = run_lint(REPO)
+    msgs = "\n".join(str(f) for f in res.findings)
+    assert res.findings == [], f"unbaselined pfxlint findings:\n{msgs}"
+
+
+def test_real_tree_counter_contract_trips_on_deleted_row():
+    # deleting any one concrete docs row must fail the gate (PFX201)
+    obs = open(os.path.join(REPO, "docs", "observability.md"),
+               encoding="utf-8").read()
+    assert "`attention/ring/{flash,dense}`" in obs
+    pruned = obs.replace("`attention/ring/{flash,dense}`", "`x`")
+    ring = open(os.path.join(
+        REPO, "paddlefleetx_tpu", "ops", "ring_attention.py"),
+        encoding="utf-8").read()
+    findings = run_rules(
+        _ctx({"paddlefleetx_tpu/ops/ring_attention.py": ring},
+             {"docs/observability.md": pruned}),
+        select={"PFX201"})
+    assert {f.key for f in findings} >= {"attention/ring/flash",
+                                         "attention/ring/dense"}
+
+
+def test_real_tree_knob_contract_trips_on_deleted_line():
+    obs = open(os.path.join(REPO, "docs", "observability.md"),
+               encoding="utf-8").read()
+    pruned = "\n".join(ln for ln in obs.splitlines()
+                       if "PFX_VOCAB_DIR" not in ln)
+    tok = open(os.path.join(
+        REPO, "paddlefleetx_tpu", "data", "tokenizers",
+        "gpt_tokenizer.py"), encoding="utf-8").read()
+    findings = run_rules(
+        _ctx({"paddlefleetx_tpu/data/tokenizers/gpt_tokenizer.py": tok},
+             {"docs/observability.md": pruned}),
+        select={"PFX203"})
+    assert [f.key for f in findings] == ["PFX_VOCAB_DIR"]
+
+
+def test_inference_counter_names_reconciled():
+    """Pin the singular/plural pairing between code and docs."""
+    code = open(os.path.join(
+        REPO, "paddlefleetx_tpu", "core", "inference_engine.py"),
+        encoding="utf-8").read()
+    docs = open(os.path.join(REPO, "docs", "observability.md"),
+                encoding="utf-8").read()
+    for name in ("inference/loads", "inference/load",
+                 "inference/predict_calls", "inference/predict",
+                 "inference/output_tokens"):
+        assert f'"{name}"' in code, name
+        assert f"`{name}`" in docs, name
+    # and the wrong spellings stay dead in code
+    assert '"inference/predicts"' not in code
+    assert '"inference/load_calls"' not in code
+
+
+def test_cli_list_rules_and_clean_exit():
+    from codestyle.pfxlint.__main__ import main
+    assert main(["--list-rules"]) == 0
+    assert main(["--root", REPO]) == 0
+    assert main(["--root", REPO, "--select", "NOPE"]) == 2
