@@ -814,6 +814,12 @@ def _sub_bench(mode: str, timeout: float = 2400.0):
     env["PFX_BENCH_MAX_WAIT"] = str(min(
         600.0, float(env.get("PFX_BENCH_MAX_WAIT", "600"))))
     env.pop("PFX_BENCH_DECOMP", None)
+    # chaos knobs must never leak into a measurement child: an
+    # injected kill/hang (docs/robustness.md) would read as a probe
+    # outage, and a watchdog abort would tear down mid-measurement
+    for knob in ("PFX_FAULTS", "PFX_FAULTS_MODE", "PFX_FAULTS_SEED",
+                 "PFX_WATCHDOG", "PFX_WATCHDOG_ACTION"):
+        env.pop(knob, None)
     global _child_proc
     try:
         _child_proc = subprocess.Popen(

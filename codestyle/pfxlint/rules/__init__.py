@@ -42,12 +42,12 @@ def resolve_call(ctx, fn, call: ast.Call):
 
 
 from . import (counters, docstrings, fallbacks, host_sync,   # noqa: E402
-               knobs, nondeterminism, tracer_branch)
+               knobs, nondeterminism, silent_except, tracer_branch)
 
 #: ordered registry; docs/static_analysis.md mirrors this table
 ALL_RULES = [
     host_sync, nondeterminism, tracer_branch,
-    counters, knobs, fallbacks, docstrings,
+    counters, knobs, fallbacks, silent_except, docstrings,
 ]
 
 

@@ -1,0 +1,113 @@
+"""PFX206 — no silently swallowed exceptions in ``core/``.
+
+The resilience contract (docs/robustness.md): a failure either
+propagates or leaves a trace. An ``except ...: pass`` in the training
+engine, checkpoint layer, or serving loop turns a real fault into
+silence — the exact failure mode the crash-surviving flight recorder
+exists to prevent — and a bare ``except:`` additionally eats
+``KeyboardInterrupt``/``SystemExit``.
+
+The rule, scoped to ``paddlefleetx_tpu/core/``:
+
+- an ``except`` handler whose body is only ``pass``/``...`` is flagged
+  unless the try sits in dead-obviously-intentional company: the
+  handler carries a logger/recorder call (impossible for a pass-only
+  body) — i.e. pass-only handlers always need an explanatory
+  suppression (``# pfxlint: disable=PFX206`` with a justification
+  comment);
+- a bare ``except:`` (no exception type) is flagged unless its body
+  re-``raise``s or makes a logging/recorder call (``logger.*``,
+  ``warnings.warn``, ``.emit``).
+
+Handlers that RETURN a sentinel (``except X: return None``) or raise
+a translated error are the legitimate narrow-except idiom and are not
+flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..engine import Finding
+
+CODES = ("PFX206",)
+
+_SCOPE_PREFIX = "paddlefleetx_tpu/core/"
+
+#: attribute/function names whose call inside a handler counts as
+#: leaving a trace (logging, flight-recorder emit, warnings.warn)
+_TRACE_CALLS = {"debug", "info", "warning", "error", "exception",
+                "critical", "log", "emit", "warn", "print"}
+
+
+def _leaves_trace(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Call):
+            f = node.func
+            attr = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if attr in _TRACE_CALLS:
+                return True
+    return False
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def _body_is_noop(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body does nothing: only ``pass`` and/or
+    bare constant expressions (``...``, docstrings)."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Constant):
+            continue
+        return False
+    return True
+
+
+def _type_label(handler: ast.ExceptHandler) -> str:
+    if handler.type is None:
+        return "bare"
+    return ast.unparse(handler.type)
+
+
+def check(ctx) -> List[Finding]:
+    """Flag silent exception swallowing under ``core/``."""
+    findings: List[Finding] = []
+    for src in ctx.py_files:
+        if not src.path.startswith(_SCOPE_PREFIX):
+            continue
+        seen: dict = {}   # (qual-ish key) -> ordinal, for stable keys
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            label = _type_label(node)
+            noop = _body_is_noop(node)
+            bare_silent = node.type is None and \
+                not (_leaves_trace(node) or _reraises(node))
+            if not (noop or bare_silent):
+                continue
+            ordinal = seen.get(label, 0)
+            seen[label] = ordinal + 1
+            key = f"{label}:{ordinal}"
+            if noop:
+                msg = (f"`except {label}: pass` silently swallows the "
+                       f"exception — log it, emit a recorder event, "
+                       f"or suppress with a justification "
+                       f"(docs/robustness.md)")
+                if label == "bare":
+                    msg = ("bare `except:` with an empty body swallows "
+                           "EVERYTHING, KeyboardInterrupt included — "
+                           "narrow the type and leave a trace")
+            else:
+                msg = (f"bare `except:` without a log/recorder call or "
+                       f"re-raise — it hides the failure AND catches "
+                       f"KeyboardInterrupt/SystemExit; narrow the "
+                       f"type or leave a trace")
+            findings.append(Finding(
+                src.path, node.lineno, "PFX206", msg, key=key))
+    return findings

@@ -1,4 +1,9 @@
 """Core subpackage."""
 from .engine import BasicEngine, Engine  # noqa: F401
 from .module import BasicModule, LanguageModule  # noqa: F401
-from .serving import Completion, GenerationServer  # noqa: F401
+from .resilience import (  # noqa: F401
+    FaultInjector, InjectedKill, StepWatchdog,
+)
+from .serving import (  # noqa: F401
+    Completion, GenerationServer, RequestShed,
+)

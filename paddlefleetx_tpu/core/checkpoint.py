@@ -9,14 +9,28 @@ that GSPMD checkpointing removes), plus a JSON meta payload carrying
 ``{epoch, step, consumed_samples, rng_seed}``.
 
 Layout: ``<output>/epoch_{E}_step_{S}/{state,meta}``.
+
+Crash consistency (docs/robustness.md): every completed save commits a
+``pfx_manifest.json`` inside the step dir LAST — file list + sizes,
+with content hashes for the small metadata files. A dir without a
+committed manifest is a torn write (the process died mid-save) and is
+never selected by :func:`latest_checkpoint`; a dir whose contents
+disagree with its manifest is corruption and :func:`load_checkpoint`
+falls back to the newest older verified checkpoint, recording a
+``ckpt_fallback`` event. The manifest is also the deletion gate for
+:func:`gc_checkpoints` — an uncommitted dir might be an in-flight
+async save, so GC never touches it.
 """
 
 from __future__ import annotations
 
 import atexit
+import hashlib
+import json
 import os
 import re
-from typing import Any, Dict, Optional, Tuple
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
@@ -24,6 +38,21 @@ import orbax.checkpoint as ocp
 from ..utils.log import logger
 
 _STEP_DIR = re.compile(r"epoch_(\d+)_step_(\d+)$")
+
+#: commit marker written last; its presence == "this save completed"
+MANIFEST_NAME = "pfx_manifest.json"
+
+#: files at or under this size get a content hash in the manifest
+#: (Orbax metadata / zarray descriptors / the JSON meta payload —
+#: the files whose silent corruption a size check cannot catch);
+#: hashing multi-GB array shards on every resolve would make
+#: latest_checkpoint O(checkpoint bytes)
+_HASH_MAX_BYTES = 1 << 20
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint failed manifest verification (or restore) and no
+    verified fallback existed."""
 
 
 def _checkpointer() -> ocp.Checkpointer:
@@ -45,10 +74,91 @@ def _async_checkpointer() -> ocp.AsyncCheckpointer:
     return _ASYNC_CKPTR
 
 
+#: (path, meta) of the async save whose manifest is not committed yet
+_PENDING_MANIFEST: Optional[Tuple[str, Dict[str, Any]]] = None
+
+
 def wait_for_pending_save() -> None:
-    """Block until an in-flight async save (if any) is durable."""
+    """Block until an in-flight async save (if any) is durable, then
+    commit its manifest — the marker must postdate every byte it
+    attests to."""
+    global _PENDING_MANIFEST
     if _ASYNC_CKPTR is not None:
         _ASYNC_CKPTR.wait_until_finished()
+    if _PENDING_MANIFEST is not None:
+        path, meta = _PENDING_MANIFEST
+        _PENDING_MANIFEST = None
+        write_manifest(path, meta)
+
+
+def write_manifest(path: str, meta: Optional[Dict[str, Any]] = None
+                   ) -> str:
+    """Walk a completed step dir and commit its manifest: relative
+    file list + byte sizes, content hashes for small files, written
+    to a temp name and renamed into place (the rename IS the commit),
+    then the directory fsynced so the marker survives power loss."""
+    files: Dict[str, int] = {}
+    hashes: Dict[str, str] = {}
+    for root, _dirs, names in os.walk(path):
+        for name in names:
+            if name == MANIFEST_NAME or name.endswith(".tmp"):
+                continue
+            full = os.path.join(root, name)
+            rel = os.path.relpath(full, path)
+            size = os.path.getsize(full)
+            files[rel] = size
+            if size <= _HASH_MAX_BYTES:
+                with open(full, "rb") as f:
+                    hashes[rel] = hashlib.sha256(f.read()).hexdigest()
+    payload = {"format": 1, "meta": meta or {}, "files": files,
+               "sha256": hashes}
+    final = os.path.join(path, MANIFEST_NAME)
+    tmp = final + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, final)
+    dirfd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(dirfd)
+    finally:
+        os.close(dirfd)
+    return final
+
+
+def verify_checkpoint(path: str) -> Optional[str]:
+    """None when ``path`` holds a committed, intact checkpoint;
+    otherwise the human-readable reason it must not be restored
+    (missing manifest == torn write, disagreeing contents ==
+    corruption)."""
+    mpath = os.path.join(path, MANIFEST_NAME)
+    try:
+        with open(mpath) as f:
+            payload = json.load(f)
+    except FileNotFoundError:
+        return "no committed manifest (save did not complete)"
+    except (OSError, ValueError) as err:
+        return f"unreadable manifest: {err}"
+    for rel, size in payload.get("files", {}).items():
+        full = os.path.join(path, rel)
+        try:
+            actual = os.path.getsize(full)
+        except OSError:
+            return f"missing file {rel}"
+        if actual != int(size):
+            return (f"size mismatch on {rel}: manifest says {size}, "
+                    f"found {actual}")
+    for rel, digest in payload.get("sha256", {}).items():
+        full = os.path.join(path, rel)
+        try:
+            with open(full, "rb") as f:
+                actual = hashlib.sha256(f.read()).hexdigest()
+        except OSError:
+            return f"missing file {rel}"
+        if actual != digest:
+            return f"content hash mismatch on {rel}"
+    return None
 
 
 def save_checkpoint(output_dir: str, epoch: int, step: int, state,
@@ -58,27 +168,61 @@ def save_checkpoint(output_dir: str, epoch: int, step: int, state,
     device arrays are snapshotted and the TensorStore write proceeds
     on background threads while training continues (the reference
     serializes training behind ``paddle.save``); the next save — or
-    process exit — waits for the previous one."""
+    process exit — waits for the previous one. Either way the dir's
+    manifest commits only after every byte is durable — synchronously
+    here, or from :func:`wait_for_pending_save` for async saves."""
+    global _PENDING_MANIFEST
     path = os.path.abspath(
         os.path.join(output_dir, f"epoch_{epoch}_step_{step}"))
+    # at most one save (and manifest) in flight — and the previous
+    # save's manifest must commit before this save may start
+    # overwriting the very bytes it attests to
+    wait_for_pending_save()
+    # re-saving the same step (repeated preemption saves) overwrites
+    # in place: decommit the old manifest FIRST so a crash mid-rewrite
+    # cannot leave a stale marker attesting to half-new bytes
+    stale = os.path.join(path, MANIFEST_NAME)
+    if os.path.exists(stale):
+        os.remove(stale)
+        logger.info("decommitted %s before re-save", stale)
     args = ocp.args.Composite(
         state=ocp.args.StandardSave(state),
         meta=ocp.args.JsonSave(meta))
     if async_save:
         ckptr = _async_checkpointer()
-        ckptr.wait_until_finished()   # at most one save in flight
         ckptr.save(path, args=args, force=True)
+        _PENDING_MANIFEST = (path, dict(meta))
         logger.info("async checkpoint save started to %s", path)
     else:
         with _checkpointer() as ckptr:
             ckptr.save(path, args=args, force=True)
+        write_manifest(path, meta)
         logger.info("saved checkpoint to %s", path)
     return path
 
 
-def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+def _step_dirs(ckpt_dir: str) -> List[Tuple[Tuple[int, int], str]]:
+    """``((epoch, step), path)`` for every name-matching step dir
+    below ``ckpt_dir``, newest first."""
+    out = []
+    for name in os.listdir(ckpt_dir):
+        m = _STEP_DIR.match(name)
+        if m and os.path.isdir(os.path.join(ckpt_dir, name)):
+            key = (int(m.group(1)), int(m.group(2)))
+            out.append((key, os.path.join(ckpt_dir, name)))
+    out.sort(reverse=True)
+    return out
+
+
+def latest_checkpoint(ckpt_dir: str, recorder=None) -> Optional[str]:
     """Resolve a checkpoint path: either a step dir itself or the
-    newest ``epoch_*_step_*`` below ``ckpt_dir``."""
+    newest VERIFIED ``epoch_*_step_*`` below ``ckpt_dir``.
+
+    The name regex alone is not trusted: a dir left by a mid-write
+    kill matches it but holds torn bytes. Unverified dirs are skipped;
+    when that demotes the resolution past newer-named dirs, a
+    ``ckpt_fallback`` event records which artifacts were distrusted
+    and why (``recorder`` optional — skipping is always logged)."""
     # an in-flight async save only gets its final (regex-matching)
     # name at commit; resolving before that would miss it or silently
     # pick the previous step
@@ -86,21 +230,37 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
     if ckpt_dir is None or not os.path.isdir(ckpt_dir):
         return None
     if _STEP_DIR.search(ckpt_dir):
-        return ckpt_dir
-    best: Tuple[int, int] = (-1, -1)
-    best_path = None
-    for name in os.listdir(ckpt_dir):
-        m = _STEP_DIR.match(name)
-        if m:
-            key = (int(m.group(1)), int(m.group(2)))
-            if key > best:
-                best, best_path = key, os.path.join(ckpt_dir, name)
-    return best_path
+        return ckpt_dir   # explicit step dir: load_checkpoint verifies
+    skipped: List[Dict[str, str]] = []
+    for _key, path in _step_dirs(ckpt_dir):
+        reason = verify_checkpoint(path)
+        if reason is None:
+            if skipped and recorder is not None:
+                recorder.emit("ckpt_fallback", to=path,
+                              skipped=skipped, stage="resolve")
+            return path
+        logger.warning("skipping unverified checkpoint %s: %s",
+                       path, reason)
+        skipped.append({"path": path, "reason": reason})
+    if skipped and recorder is not None:
+        recorder.emit("ckpt_fallback", to=None, skipped=skipped,
+                      stage="resolve")
+    return None
 
 
-def load_checkpoint(path: str, abstract_state):
+def load_checkpoint(path: str, abstract_state, fallback_dir=None,
+                    recorder=None):
     """Restore (state, meta); ``abstract_state`` carries target
     shardings so arrays land directly on the current mesh.
+
+    Verified restore with fallback: the manifest is checked before any
+    byte is read, and with ``fallback_dir`` set a corrupt (or
+    restore-failing) checkpoint demotes to the newest OLDER verified
+    step dir under it, each demotion emitting a ``ckpt_fallback``
+    event to ``recorder`` (and always logging). Without
+    ``fallback_dir`` a verification failure raises
+    :class:`CheckpointCorrupt` — resuming from torn bytes must never
+    be silent.
 
     Layer-layout portability: ``Model.scan_layers`` changes the param
     pytree — scanned models stack the decoder under one ``decoder``
@@ -115,6 +275,51 @@ def load_checkpoint(path: str, abstract_state):
     """
     wait_for_pending_save()   # same-process restore-after-async-save
     path = os.path.abspath(path)
+    candidates = [path]
+    if fallback_dir is not None and os.path.isdir(fallback_dir):
+        mine = _STEP_DIR.search(path)
+        my_key = (int(mine.group(1)), int(mine.group(2))) if mine \
+            else None
+        for key, p in _step_dirs(fallback_dir):
+            if os.path.abspath(p) == path:
+                continue
+            if my_key is not None and key >= my_key:
+                continue   # fall BACK, never forward past the target
+            candidates.append(os.path.abspath(p))
+    last_reason = None
+    for i, cand in enumerate(candidates):
+        reason = verify_checkpoint(cand)
+        if reason is None:
+            try:
+                state, meta = _restore(cand, abstract_state)
+            except Exception as err:   # intact manifest, failed read
+                reason = f"restore failed: {err!r}"
+                if fallback_dir is None or i == len(candidates) - 1:
+                    raise
+            else:
+                if i > 0:
+                    logger.warning(
+                        "restored FALLBACK checkpoint %s (newest was "
+                        "%s: %s)", cand, candidates[0], last_reason)
+                return state, meta
+        last_reason = reason
+        logger.error("checkpoint %s failed verification: %s", cand,
+                     reason)
+        if recorder is not None:
+            recorder.emit("ckpt_fallback", rejected=cand,
+                          reason=reason, stage="load",
+                          remaining=len(candidates) - 1 - i)
+        if fallback_dir is None:
+            raise CheckpointCorrupt(f"{cand}: {reason}")
+    raise CheckpointCorrupt(
+        f"no verified checkpoint among {len(candidates)} candidates "
+        f"(newest: {candidates[0]}: {last_reason})")
+
+
+def _restore(path: str, abstract_state):
+    """One verified step dir -> (state, meta), including the
+    scan_layers layout-toggle retry documented on
+    :func:`load_checkpoint`."""
     with _checkpointer() as ckptr:
         try:
             restored = ckptr.restore(
@@ -146,6 +351,52 @@ def load_checkpoint(path: str, abstract_state):
             state = convert(restored.state)
     logger.info("restored checkpoint from %s", path)
     return state, restored.meta
+
+
+def gc_checkpoints(output_dir: str, keep_last_k: int,
+                   recorder=None) -> List[str]:
+    """Delete all but the newest ``keep_last_k`` VERIFIED step dirs
+    under ``output_dir``; returns the deleted paths.
+
+    The manifest is the deletion gate: an unverified dir is either an
+    in-flight async save (its manifest commits later) or torn garbage
+    that :func:`latest_checkpoint` already refuses — GC leaves both
+    alone rather than racing a background writer. Because only dirs
+    OLDER than the ``keep_last_k`` newest verified ones are deleted,
+    every checkpoint a live fallback could demote to survives (with
+    ``keep_last_k >= 2``, even a post-commit corruption of the newest
+    still finds its predecessor). ``keep_last_k < 1`` means unlimited
+    retention and deletes nothing.
+
+    Deliberately does NOT wait for an in-flight async save: the
+    pending dir has no manifest yet, so it is not a candidate either
+    way, and blocking here would serialize training behind the
+    TensorStore write the async path exists to hide."""
+    if keep_last_k is None or keep_last_k < 1:
+        return []
+    if not os.path.isdir(output_dir):
+        return []
+    verified = [p for _key, p in _step_dirs(output_dir)
+                if verify_checkpoint(p) is None]
+    deleted = []
+    for path in verified[keep_last_k:]:
+        # decommit first: a kill mid-rmtree leaves an unverifiable
+        # stub, not a manifest over missing files
+        try:
+            os.remove(os.path.join(path, MANIFEST_NAME))
+        except OSError as err:
+            logger.warning("ckpt gc: cannot decommit %s (%s); "
+                           "leaving it", path, err)
+            continue
+        shutil.rmtree(path, ignore_errors=True)
+        deleted.append(path)
+        logger.info("ckpt gc: deleted %s (keep_last_k=%d)", path,
+                    keep_last_k)
+    if deleted and recorder is not None:
+        recorder.emit("ckpt_gc", deleted=deleted,
+                      keep_last_k=keep_last_k,
+                      kept=verified[:keep_last_k])
+    return deleted
 
 
 # -- scan_layers layout adapter ----------------------------------------

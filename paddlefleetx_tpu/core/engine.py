@@ -48,6 +48,7 @@ from ..parallel.mesh import (
 from ..parallel.sharding import make_sharding_rules
 from ..utils.log import logger
 from . import checkpoint as ckpt
+from . import resilience
 
 
 class BasicEngine:
@@ -112,6 +113,11 @@ class Engine(BasicEngine):
         # periodic checkpoint, SURVEY.md §5.3)
         self.save_on_preemption = bool(
             save_load.get("save_on_preemption", True))
+        # TPU-native extra: retention. 0/unset = unlimited (the
+        # reference's behavior); k >= 1 keeps the newest k VERIFIED
+        # checkpoints — the manifest gates deletion, so an in-flight
+        # async save or a torn dir is never GC'd (core/checkpoint.py)
+        self.keep_last_k = int(save_load.get("keep_last_k", 0) or 0)
         # TPU-native extra: batches staged ahead of the consuming step
         # (host->device transfer overlapped with compute; 2 = classic
         # double buffering, 0 = synchronous _put_batch between steps).
@@ -169,6 +175,14 @@ class Engine(BasicEngine):
             self._recorder = FlightRecorder(
                 tele.get("events_path") or
                 os.path.join(self.output_dir, "events.jsonl"))
+        # resilience (docs/robustness.md): chaos faults only exist
+        # when PFX_FAULTS is set; the stall watchdog only when
+        # PFX_WATCHDOG is on — both None on the production default
+        self._faults = resilience.FaultInjector.from_env(
+            recorder=self._recorder)
+        self._watchdog = resilience.StepWatchdog.from_env(
+            name="train_step", recorder=self._recorder)
+        self._save_count = 0
         # host-time summary gate: explicit Engine.print_summary wins;
         # by default the summary prints whenever profiling OR
         # telemetry asked for it (unprofiled telemetry runs must not
@@ -611,13 +625,21 @@ class Engine(BasicEngine):
                                              self._on_sigterm)
                 installed = True
             except ValueError:
-                pass   # not the main thread; no handler possible
+                # not the main thread: Python only installs signal
+                # handlers there, so preemption saves are unavailable
+                # in this fit() — worth a line in the log, not a crash
+                logger.warning(
+                    "save_on_preemption: cannot install SIGTERM "
+                    "handler outside the main thread; preemption "
+                    "will not checkpoint")
         try:
             self._fit_epochs(epoch, train_data_loader,
                              valid_data_loader)
         finally:
             if installed:   # prev_handler may legitimately be None
                 signal.signal(signal.SIGTERM, prev_handler)
+            if self._watchdog is not None:
+                self._watchdog.disarm()
 
     def _fit_epochs(self, epoch, train_data_loader, valid_data_loader):
         start_epoch = self._load_recovery["epoch"]
@@ -691,6 +713,12 @@ class Engine(BasicEngine):
                 if step >= self.max_steps:
                     return
                 self._profiler_step(step)
+                if self._watchdog is not None:
+                    # armed across the whole host-side body: the jitted
+                    # step dispatches async, so a device hang surfaces
+                    # at the logging sync / next donation — still
+                    # inside this window
+                    self._watchdog.arm(tag=f"step {step + 1}")
                 t_call = time.time()
                 with annotate("train_step"):
                     self.state, metrics = self._train_step(
@@ -756,6 +784,13 @@ class Engine(BasicEngine):
                     self.save(epoch)
                     step_start = time.time()
                     window_clean = False
+                if self._faults is not None:
+                    # after the save cadence: kill@step=N dies with
+                    # every save <= N durable, the shape chaos tests
+                    # assert resume-determinism against
+                    self._faults.fire("step", step)
+                if self._watchdog is not None:
+                    self._watchdog.disarm()
                 if self._preempt_signum is not None:
                     return   # _fit_epochs saves, then stops
 
@@ -1110,9 +1145,9 @@ class Engine(BasicEngine):
         }
         t0 = time.time()
         with annotate("save"):
-            ckpt.save_checkpoint(self.output_dir, epoch, step,
-                                 self.state, meta,
-                                 async_save=self.async_save)
+            path = ckpt.save_checkpoint(self.output_dir, epoch, step,
+                                        self.state, meta,
+                                        async_save=self.async_save)
         save_s = time.time() - t0
         self._time_buckets["save"] += save_s
         self._metrics.add_time("save", save_s)
@@ -1120,10 +1155,22 @@ class Engine(BasicEngine):
             self._recorder.emit("save", step=step, epoch=epoch,
                                 save_s=round(save_s, 4),
                                 async_save=bool(self.async_save))
+        self._save_count += 1
+        if self._faults is not None:
+            # kill@save=N dies mid-async-save (manifest uncommitted —
+            # resolve must skip the torn dir); corrupt_ckpt@save=N
+            # garbles the committed artifact (restore must fall back)
+            self._faults.fire("save", self._save_count, path=path)
+        if self.keep_last_k:
+            ckpt.gc_checkpoints(self.output_dir, self.keep_last_k,
+                                recorder=self._recorder)
 
     def load(self):
-        """Restore the latest checkpoint under ``ckpt_dir``, if any."""
-        path = ckpt.latest_checkpoint(self.ckpt_dir)
+        """Restore the latest VERIFIED checkpoint under ``ckpt_dir``,
+        if any; a corrupt newest falls back to its predecessor with a
+        ``ckpt_fallback`` event (docs/robustness.md)."""
+        path = ckpt.latest_checkpoint(self.ckpt_dir,
+                                      recorder=self._recorder)
         if path is None:
             logger.warning("no checkpoint found under %s; starting fresh",
                            self.ckpt_dir)
@@ -1132,7 +1179,12 @@ class Engine(BasicEngine):
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
                                            sharding=x.sharding),
             self.state)
-        self.state, meta = ckpt.load_checkpoint(path, abstract)
+        fallback = self.ckpt_dir if os.path.isdir(self.ckpt_dir) and \
+            not ckpt._STEP_DIR.search(self.ckpt_dir) else \
+            os.path.dirname(path)
+        self.state, meta = ckpt.load_checkpoint(
+            path, abstract, fallback_dir=fallback,
+            recorder=self._recorder)
         self._load_recovery = {
             "epoch": meta.get("epoch", 0),
             "step": meta.get("step", 0),

@@ -159,9 +159,11 @@ class InferenceEngine:
         cache + ragged flash decode) — the serving counterpart of the
         artifact-driven ``predict`` path. Extra ``kwargs`` pass through
         to the server (``prefill_buckets``, ``rng``, ``events_path``,
-        and the paged-KV knobs ``page_size`` / ``pool_pages`` /
+        the paged-KV knobs ``page_size`` / ``pool_pages`` /
         ``prefill_chunk_pages`` / ``prefix_sharing`` —
-        docs/inference.md, "Paged KV cache")."""
+        docs/inference.md, "Paged KV cache" — and the graceful-
+        degradation knobs ``request_ttl_s`` / ``max_queue_depth`` /
+        ``drain_on_sigterm`` — docs/robustness.md)."""
         from .serving import GenerationServer
         return GenerationServer(model, params, gen_cfg,
                                 num_slots=num_slots, **kwargs)

@@ -50,6 +50,17 @@ page tables above are exactly the substrate this needs; pages past a
 slot's accepted point are handed straight back to the pool). Greedy
 speculative output is token-exact vs the non-speculative server.
 
+Graceful degradation (docs/robustness.md): per-request deadlines/TTL
+(``submit(deadline_s=...)`` or a server-wide ``request_ttl_s``) evict
+expired requests with a ``deadline_exceeded`` result; a bounded queue
+(``max_queue_depth``) sheds excess submits with :class:`RequestShed`
+and the ``serving/shed`` counter; :meth:`GenerationServer.drain` (or a
+SIGTERM under ``drain_on_sigterm=True``) stops admitting, finishes or
+preempts in-flight slots, and returns partials — committed tokens are
+never lost, and ``submit(resume_tokens=...)`` re-enters a partial on a
+restarted paged server token-exactly (the same prompt+tokens re-prefill
+contract slot preemption uses).
+
 Telemetry (docs/observability.md): ``serving/slot_occupancy`` and
 ``serving/pages_in_use`` gauges, ``serving/admitted`` /
 ``serving/evicted`` / ``serving/preempted`` / ``serving/prefix_hits``
@@ -66,6 +77,7 @@ an optional flight recorder mirrors admissions/evictions to an
 from __future__ import annotations
 
 import dataclasses as _dc
+import signal
 import time
 from collections import deque
 from dataclasses import dataclass
@@ -87,7 +99,15 @@ from .paging import (
     NULL_PAGE, PageAllocator, PagePoolExhausted, page_prefix_keys,
     prompt_key,
 )
+from .resilience import FaultInjector, StepWatchdog
 from .spec import make_draft_source
+
+
+class RequestShed(RuntimeError):
+    """Admission refused: the queue is at ``max_queue_depth``, the
+    server is draining, or an ``admit_fail`` fault fired. The caller
+    should back off and retry elsewhere — everything already admitted
+    is unaffected."""
 
 
 def default_prefill_buckets(max_prompt_len: int) -> Tuple[int, ...]:
@@ -111,7 +131,8 @@ class Completion:
     #: emitted tokens in order, EOS included when hit (identical to the
     #: lockstep ``generate()`` row before its pad tail)
     tokens: List[int]
-    #: "eos" | "length" (hit max_dec_len) | "preempted"
+    #: "eos" | "length" (hit max_dec_len) | "preempted" |
+    #: "deadline_exceeded" (TTL expired; ``tokens`` holds the partial)
     finish_reason: str
 
 
@@ -133,7 +154,11 @@ class GenerationServer:
                  page_size: Optional[int] = None,
                  pool_pages: Optional[int] = None,
                  prefill_chunk_pages: int = 2,
-                 prefix_sharing: bool = True):
+                 prefix_sharing: bool = True,
+                 request_ttl_s: Optional[float] = None,
+                 max_queue_depth: Optional[int] = None,
+                 drain_on_sigterm: bool = False,
+                 fault_injector: Optional[FaultInjector] = None):
         if gen_cfg.decode_strategy == "beam_search":
             raise ValueError(
                 "GenerationServer serves sampling/greedy_search; beam "
@@ -227,13 +252,34 @@ class GenerationServer:
         self._slots: List[Optional[dict]] = [None] * num_slots
         self._next_id = 0
         self._nonce = 0
-        self._counts = {"admitted": 0, "evicted": 0, "preempted": 0}
+        self._counts = {"admitted": 0, "evicted": 0, "preempted": 0,
+                        "shed": 0, "deadline_exceeded": 0}
         self._ticks = 0
+        # graceful degradation (docs/robustness.md)
+        self.request_ttl_s = request_ttl_s
+        self.max_queue_depth = max_queue_depth
+        self._draining = False
+        self._submits = 0
+        self._prev_sigterm = None
+        self._sigterm_installed = False
+        if drain_on_sigterm:
+            try:
+                self._prev_sigterm = signal.signal(
+                    signal.SIGTERM, self._on_sigterm)
+                self._sigterm_installed = True
+            except ValueError:
+                logger.warning(
+                    "drain_on_sigterm: cannot install SIGTERM handler "
+                    "outside the main thread; call drain() explicitly")
         self._decode_tokens = 0
         self._tick_time = 0.0
         self._ttfts: List[float] = []
         self._recorder = FlightRecorder(events_path) if events_path \
             else None
+        self._faults = fault_injector if fault_injector is not None \
+            else FaultInjector.from_env(recorder=self._recorder)
+        self._watchdog = StepWatchdog.from_env(name="decode_tick",
+                                               recorder=self._recorder)
         self._emit("serving_start", slots=num_slots,
                    buckets=list(buckets),
                    max_dec_len=gen_cfg.max_dec_len,
@@ -273,11 +319,26 @@ class GenerationServer:
         """Number of submitted requests still waiting for a slot."""
         return len(self._queue)
 
-    def submit(self, prompt: Sequence[int]) -> int:
-        """Queue a request; returns its id. Raises when the prompt can
-        never fit (``prompt + max_dec_len > max_position_embeddings``)
-        — an oversized request must fail loudly at the door, not stall
-        the queue."""
+    def submit(self, prompt: Sequence[int],
+               deadline_s: Optional[float] = None,
+               resume_tokens: Optional[Sequence[int]] = None) -> int:
+        """Queue a request; returns its id. Raises ``ValueError`` when
+        the prompt can never fit (``prompt + max_dec_len >
+        max_position_embeddings``) — an oversized request must fail
+        loudly at the door, not stall the queue — and
+        :class:`RequestShed` when admission is refused (queue at
+        ``max_queue_depth``, server draining, or an injected
+        ``admit_fail`` fault).
+
+        ``deadline_s`` bounds THIS request's wall-clock lifetime
+        (queued time included), overriding the server-wide
+        ``request_ttl_s``; on expiry it completes as
+        ``deadline_exceeded`` with whatever tokens it earned.
+        ``resume_tokens`` (paged servers only) re-enters a partial
+        from a drained/preempted completion: admission re-prefills
+        prompt+tokens and the sampling stream resumes at the preserved
+        decode count, so a greedy resume is token-exact with the
+        uninterrupted run."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -287,11 +348,85 @@ class GenerationServer:
                 f"({self.gen_cfg.max_dec_len}) exceeds "
                 f"max_position_embeddings "
                 f"{self.model.config.max_position_embeddings}")
+        tokens = [int(t) for t in resume_tokens or []]
+        if tokens and not self.paged:
+            raise ValueError(
+                "resume_tokens requires a paged server (contiguous "
+                "admission prefills the prompt only)")
+        if tokens and len(tokens) >= self.gen_cfg.max_dec_len:
+            raise ValueError(
+                f"resume_tokens ({len(tokens)}) already meets "
+                f"max_dec_len ({self.gen_cfg.max_dec_len})")
+        self._submits += 1
+        if self._draining:
+            return self._shed("draining")
+        if self._faults is not None and \
+                self._faults.fire("req", self._submits) == "admit_fail":
+            return self._shed("fault")
+        if self.max_queue_depth is not None and \
+                len(self._queue) >= self.max_queue_depth:
+            return self._shed("queue_depth")
         rid = self._next_id
         self._next_id += 1
-        self._queue.append({"id": rid, "prompt": prompt, "tokens": [],
-                            "submit_t": time.time()})
+        ttl = deadline_s if deadline_s is not None else \
+            self.request_ttl_s
+        self._queue.append({"id": rid, "prompt": prompt,
+                            "tokens": tokens,
+                            "submit_t": time.time(),
+                            "deadline": time.time() + ttl
+                            if ttl is not None else None})
         return rid
+
+    def _shed(self, reason: str) -> int:
+        """Refuse admission: count it, record it, raise."""
+        self._counts["shed"] += 1
+        metrics.inc("serving/shed")
+        self._emit("serving_shed", reason=reason,
+                   pending=self.pending, occupancy=self.occupancy)
+        raise RequestShed(
+            f"request shed ({reason}): {self.pending} queued, "
+            f"{self.occupancy}/{self.num_slots} slots busy")
+
+    def _on_sigterm(self, signum, frame) -> None:
+        """Preemption notice: flip into drain mode — the in-progress
+        :meth:`run`/:meth:`step` driver stops admitting and returns
+        partials (mirroring the Engine's save-on-preemption
+        contract)."""
+        self._draining = True
+        self._emit("serving_drain_start", signum=signum,
+                   pending=self.pending, occupancy=self.occupancy)
+
+    def _expire_deadlines(self) -> List[Completion]:
+        """Evict every queued/running request whose deadline passed;
+        the partial completes as ``deadline_exceeded`` — expiry is a
+        RESULT the client sees, not a silent drop."""
+        now = time.time()
+        out: List[Completion] = []
+        if any(r.get("deadline") is not None and now > r["deadline"]
+               for r in self._queue):
+            keep: deque = deque()
+            for req in self._queue:
+                dl = req.get("deadline")
+                if dl is not None and now > dl:
+                    self._counts["deadline_exceeded"] += 1
+                    metrics.inc("serving/deadline_exceeded")
+                    self._emit("serving_evict", request=req["id"],
+                               slot=-1, reason="deadline_exceeded",
+                               tokens=len(req["tokens"]))
+                    out.append(Completion(
+                        request_id=req["id"], prompt=req["prompt"],
+                        tokens=req["tokens"],
+                        finish_reason="deadline_exceeded"))
+                else:
+                    keep.append(req)
+            self._queue = keep
+        for slot, req in enumerate(self._slots):
+            if req is not None and req.get("deadline") is not None \
+                    and now > req["deadline"]:
+                self._counts["deadline_exceeded"] += 1
+                metrics.inc("serving/deadline_exceeded")
+                out.append(self._evict(slot, "deadline_exceeded"))
+        return out
 
     def _bucket_for(self, n: int) -> int:
         for b in self._buckets:
@@ -648,9 +783,14 @@ class GenerationServer:
     def step(self) -> List[Completion]:
         """Admit what fits, advance at most one prefill chunk (paged),
         tick every ACTIVE slot — one token plain, 1..k+1 committed
-        tokens speculative — then evict and return whatever
-        finished."""
-        self._admit()
+        tokens speculative — then evict and return whatever finished
+        (deadline-expired requests included, as ``deadline_exceeded``
+        partials). While draining, admission is skipped."""
+        expired = self._expire_deadlines()
+        if self._faults is not None:
+            self._faults.fire("tick", self._ticks + 1)
+        if not self._draining:
+            self._admit()
         reg = metrics.get_registry()
         if self.paged:
             self._prefill_pump()
@@ -662,7 +802,9 @@ class GenerationServer:
             # nothing decodable yet (empty, or every occupant is still
             # mid-chunked-prefill) — the pump above still made progress
             reg.set_gauge("serving/slot_occupancy", self.occupancy)
-            return []
+            return expired
+        if self._watchdog is not None:
+            self._watchdog.arm(tag=f"tick {self._ticks + 1}")
         t0 = time.time()
         with reg.timer("serving/decode_tick"):
             if self.spec:
@@ -711,6 +853,8 @@ class GenerationServer:
                 window = tok[:, None]
                 counts = np.ones((self.num_slots,), np.int32)
         self._tick_time += time.time() - t0
+        if self._watchdog is not None:
+            self._watchdog.disarm()
         self._ticks += 1
         finished = np.asarray(self._state.finished)
         dec_count = np.asarray(self._state.dec_count)
@@ -764,15 +908,76 @@ class GenerationServer:
             self._emit("serving_spec", drafted=drafted,
                        accepted=accepted, committed=committed)
         reg.set_gauge("serving/slot_occupancy", self.occupancy)
-        return done
+        return expired + done
+
+    def drain(self, max_ticks: Optional[int] = None
+              ) -> List[Completion]:
+        """Graceful shutdown: stop admitting, return every QUEUED
+        request immediately as a ``preempted`` partial (committed
+        tokens intact), tick in-flight slots to completion — bounded
+        by ``max_ticks``, past which survivors are preempted too — and
+        return all resulting completions. ``max_ticks=0`` preempts
+        everything at once. Partials re-enter a restarted paged server
+        via ``submit(resume_tokens=...)`` with no committed token
+        lost."""
+        if not self._draining:
+            self._draining = True
+            self._emit("serving_drain_start", signum=None,
+                       pending=self.pending, occupancy=self.occupancy)
+        out: List[Completion] = self._flush_queue()
+        ticks = 0
+        while self.occupancy and (max_ticks is None
+                                  or ticks < max_ticks):
+            out.extend(self.step())
+            ticks += 1
+        for slot in range(self.num_slots):
+            if self._slots[slot] is not None:
+                out.append(self._evict(slot, "preempted"))
+        # a pool-exhaustion preempt during the tick loop requeues to
+        # the (no longer admitting) queue — hand those back too
+        out.extend(self._flush_queue())
+        self._emit("serving_drain_end", completions=len(out),
+                   ticks=ticks)
+        return out
+
+    def _flush_queue(self) -> List[Completion]:
+        """Every queued request back to its client as a ``preempted``
+        partial (committed tokens kept)."""
+        out: List[Completion] = []
+        while self._queue:
+            req = self._queue.popleft()
+            self._counts["preempted"] += 1
+            metrics.inc("serving/preempted")
+            self._emit("serving_evict", request=req["id"], slot=-1,
+                       reason="preempted", tokens=len(req["tokens"]))
+            out.append(Completion(request_id=req["id"],
+                                  prompt=req["prompt"],
+                                  tokens=req["tokens"],
+                                  finish_reason="preempted"))
+        return out
+
+    def close(self) -> None:
+        """Detach OS-level hooks: stop the watchdog thread and restore
+        a ``drain_on_sigterm`` handler. Idempotent."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        if self._sigterm_installed:
+            signal.signal(signal.SIGTERM, self._prev_sigterm)
+            self._sigterm_installed = False
 
     def run(self, prompts: Sequence[Sequence[int]]) -> List[Completion]:
         """Serve a batch of prompts to completion; completions return
         in SUBMISSION order (slot/finish order is an implementation
-        detail the caller should not see)."""
+        detail the caller should not see). A drain — SIGTERM under
+        ``drain_on_sigterm``, or a concurrent :meth:`drain` — ends the
+        loop early with partials in place of unfinished requests."""
         ids = [self.submit(p) for p in prompts]
         done: Dict[int, Completion] = {}
         while self._queue or self.occupancy:
+            if self._draining:
+                for c in self.drain():
+                    done[c.request_id] = c
+                break
             for c in self.step():
                 done[c.request_id] = c
         return [done[i] for i in ids]

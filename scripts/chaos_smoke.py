@@ -1,0 +1,266 @@
+"""End-to-end chaos drill: SIGKILL a real training run, resume, prove it.
+
+The in-process resilience tests (tests/test_resilience.py) drill the
+save -> die -> restore loop with ``PFX_FAULTS_MODE=raise``; this
+script is the full-fidelity version the CI ``chaos-smoke`` job runs
+(docs/robustness.md): three ``tools/train.py`` subprocesses on a tiny
+CPU config with per-step telemetry —
+
+1. **baseline** — runs to ``--steps``, recording every step's loss
+   from the flight recorder's ``step_window`` events;
+2. **chaos** — the same run with ``PFX_FAULTS=kill@step=K``: a real
+   ``SIGKILL`` mid-training, after the checkpoint cadence has
+   committed at least one manifest;
+3. **resume** — the same command pointed back at the chaos output
+   dir, no fault spec.
+
+Asserted: the killed run durably recorded ``fault_injected``; resume
+restores the last committed checkpoint (step continuity, no gap and
+no replayed step windows); the resumed loss curve is IDENTICAL to the
+baseline from the restore point on; the resumed event log contains no
+``ckpt_fallback`` (the kill landed between saves, so the newest
+checkpoint must verify). Exit 0 on success, 1 with a diagnosis on any
+violation. Run from the repo root:
+
+  python scripts/chaos_smoke.py [--workdir DIR] [--steps 12]
+                                [--kill-step 7] [--save-steps 4]
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+_CONFIG = """\
+Global:
+  device: cpu
+  seed: 1024
+  global_batch_size: null
+  local_batch_size: 8
+  micro_batch_size: 8
+Engine:
+  max_steps: {steps}
+  num_train_epochs: 1
+  logging_freq: 1
+  eval_freq: 1000
+  eval_iters: 1
+  mix_precision:
+    use_pure_fp16: False
+  save_load:
+    save_steps: {save_steps}
+    output_dir: {out}
+Model:
+  module: GPTModule
+  name: GPT
+  vocab_size: 128
+  hidden_size: 32
+  num_layers: 2
+  num_attention_heads: 4
+  ffn_hidden_size: 64
+  max_position_embeddings: 64
+  hidden_dropout_prob: 0.0
+  attention_probs_dropout_prob: 0.0
+Distributed:
+  dp_degree: 1
+  mp_degree: 1
+  pp_degree: 1
+  sharding:
+    sharding_degree: 1
+    sharding_stage: 1
+Optimizer:
+  name: FusedAdamW
+  weight_decay: 0.01
+  beta1: 0.9
+  beta2: 0.999
+  epsilon: 1.0e-8
+  lr:
+    name: CosineAnnealingWithWarmupDecay
+    decay_steps: 100
+    warmup_rate: 0.1
+    max_lr: 1.0e-2
+    min_lr: 1.0e-3
+  grad_clip:
+    name: ClipGradByGlobalNorm
+    clip_norm: 1.0
+Data:
+  Train:
+    dataset:
+      name: GPTDataset
+      input_dir: {data}
+      split: [1, 0, 0]
+      max_seq_len: 32
+      num_samples: 400
+      mode: Train
+      eos_id: 127
+      build_data_file: True
+    sampler:
+      name: GPTBatchSampler
+      batch_size: 8
+      shuffle: False
+      drop_last: True
+    loader:
+      collate_fn: gpt_collate_fn
+Telemetry:
+  enable: True
+"""
+
+
+def make_corpus(data_dir):
+    """Synthetic corpus_ids.npy + corpus_idx.npz (quick_start shape)."""
+    rng = np.random.default_rng(0)
+    lens = rng.integers(20, 60, 80).astype(np.int32)
+    ids = rng.integers(0, 128, int(lens.sum())).astype(np.int32)
+    ids[np.cumsum(lens) - 1] = 127
+    os.makedirs(data_dir, exist_ok=True)
+    np.save(os.path.join(data_dir, "corpus_ids.npy"), ids)
+    np.savez(os.path.join(data_dir, "corpus_idx.npz"), lens=lens)
+
+
+def run_train(cfg_path, out_dir, faults=None, resume=False, timeout=600):
+    """One tools/train.py subprocess; returns its returncode."""
+    cmd = [sys.executable, os.path.join(REPO, "tools", "train.py"),
+           "-c", cfg_path,
+           "-o", f"Engine.save_load.output_dir={out_dir}"]
+    if resume:
+        cmd += ["-o", f"Engine.save_load.ckpt_dir={out_dir}"]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("PFX_FAULTS", None)
+    if faults:
+        env["PFX_FAULTS"] = faults
+    proc = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout,
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True)
+    tag = "chaos" if faults else ("resume" if resume else "baseline")
+    sys.stdout.write(f"--- {tag} run: rc={proc.returncode} ---\n")
+    if proc.returncode not in (0, -signal.SIGKILL):
+        sys.stdout.write(proc.stdout[-4000:] + "\n")
+    return proc.returncode
+
+
+def read_events(out_dir, skip_lines=0):
+    """Parsed events.jsonl records, optionally past a line offset."""
+    path = os.path.join(out_dir, "events.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        lines = f.readlines()
+    out = []
+    for line in lines[skip_lines:]:
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            pass  # torn tail line of a killed run
+    return out
+
+
+def count_lines(out_dir):
+    """Line count of events.jsonl (0 when absent)."""
+    path = os.path.join(out_dir, "events.jsonl")
+    if not os.path.exists(path):
+        return 0
+    with open(path) as f:
+        return sum(1 for _ in f)
+
+
+def losses_by_step(events):
+    """Map step -> loss from the step_window events."""
+    return {e["step"]: e["loss"] for e in events
+            if e.get("event") == "step_window"}
+
+
+def fail(msg):
+    """Print the diagnosis and exit nonzero."""
+    sys.stdout.write(f"CHAOS SMOKE FAILED: {msg}\n")
+    sys.exit(1)
+
+
+def main():
+    """Run the baseline/chaos/resume triple and assert continuity."""
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--kill-step", type=int, default=7)
+    ap.add_argument("--save-steps", type=int, default=4)
+    args = ap.parse_args()
+
+    work = args.workdir or tempfile.mkdtemp(prefix="pfx_chaos_")
+    os.makedirs(work, exist_ok=True)
+    data = os.path.join(work, "data")
+    base_out = os.path.join(work, "base_out")
+    chaos_out = os.path.join(work, "chaos_out")
+    make_corpus(data)
+    cfg_path = os.path.join(work, "chaos_smoke.yaml")
+    with open(cfg_path, "w") as f:
+        f.write(_CONFIG.format(steps=args.steps,
+                               save_steps=args.save_steps,
+                               out=base_out, data=data))
+    last_save = (args.kill_step // args.save_steps) * args.save_steps
+    if not 0 < last_save < args.kill_step:
+        fail(f"bad drill geometry: kill step {args.kill_step} must "
+             f"land strictly between save-cadence multiples of "
+             f"{args.save_steps}")
+
+    # 1. baseline
+    rc = run_train(cfg_path, base_out)
+    if rc != 0:
+        fail(f"baseline run exited {rc}")
+    base_losses = losses_by_step(read_events(base_out))
+    missing = [s for s in range(1, args.steps + 1)
+               if s not in base_losses]
+    if missing:
+        fail(f"baseline missing step_window for steps {missing}")
+
+    # 2. chaos: a real SIGKILL at --kill-step
+    rc = run_train(cfg_path, chaos_out,
+                   faults=f"kill@step={args.kill_step}")
+    if rc != -signal.SIGKILL:
+        fail(f"chaos run expected SIGKILL exit, got rc={rc}")
+    chaos_events = read_events(chaos_out)
+    injected = [e for e in chaos_events
+                if e.get("event") == "fault_injected"]
+    if not injected:
+        fail("killed run did not durably record fault_injected")
+    chaos_losses = losses_by_step(chaos_events)
+    for s in range(1, args.kill_step + 1):
+        if chaos_losses.get(s) != base_losses[s]:
+            fail(f"pre-kill divergence at step {s}: "
+                 f"{chaos_losses.get(s)} != {base_losses[s]}")
+    mark = count_lines(chaos_out)
+
+    # 3. resume from the chaos output dir
+    rc = run_train(cfg_path, chaos_out, resume=True)
+    if rc != 0:
+        fail(f"resume run exited {rc}")
+    resumed = read_events(chaos_out, skip_lines=mark)
+    fallbacks = [e for e in resumed if e.get("event") == "ckpt_fallback"]
+    if fallbacks:
+        fail(f"resume fell back past the newest checkpoint (the kill "
+             f"landed between saves, so step {last_save} must "
+             f"verify): {fallbacks}")
+    res_losses = losses_by_step(resumed)
+    expect = list(range(last_save + 1, args.steps + 1))
+    if sorted(res_losses) != expect:
+        fail(f"resume step continuity broken: trained steps "
+             f"{sorted(res_losses)}, expected {expect} (restore at "
+             f"step {last_save})")
+    diverged = {s: (res_losses[s], base_losses[s]) for s in expect
+                if res_losses[s] != base_losses[s]}
+    if diverged:
+        fail(f"resumed loss curve diverged from baseline: {diverged}")
+
+    sys.stdout.write(
+        f"CHAOS SMOKE OK: killed at step {args.kill_step}, restored "
+        f"step {last_save}, steps {expect[0]}..{expect[-1]} "
+        f"loss-identical to baseline ({work})\n")
+
+
+if __name__ == "__main__":
+    main()

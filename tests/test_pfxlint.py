@@ -321,6 +321,47 @@ def test_pfx205_admission_probe_is_exempt():
     assert findings == []   # probe never reaches pallas_call
 
 
+def test_pfx206_silent_handlers_fire_in_core_only():
+    src = MOD + (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except ValueError:\n"
+        "        pass\n"
+        "    try:\n"
+        "        g()\n"
+        "    except:\n"
+        "        x = 1\n")
+    findings = run_rules(
+        _ctx({"paddlefleetx_tpu/core/m.py": src,
+              "paddlefleetx_tpu/models/m.py": src}),   # out of scope
+        select={"PFX206"})
+    assert [(f.path, f.key) for f in findings] == [
+        ("paddlefleetx_tpu/core/m.py", "ValueError:0"),
+        ("paddlefleetx_tpu/core/m.py", "bare:0"),
+    ]
+
+
+def test_pfx206_trace_reraise_and_sentinel_are_clean():
+    src = MOD + (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except ValueError:\n"
+        "        logger.warning('g failed')\n"
+        "    try:\n"
+        "        g()\n"
+        "    except:\n"
+        "        raise RuntimeError('translated')\n"
+        "    try:\n"
+        "        return g()\n"
+        "    except OSError:\n"
+        "        return None\n")
+    findings = run_rules(_ctx({"paddlefleetx_tpu/core/m.py": src}),
+                         select={"PFX206"})
+    assert findings == []
+
+
 def test_docstring_rule_matches_standalone_checker():
     src = "def f():\n    pass\n"   # no module docstring
     codes = _codes({"paddlefleetx_tpu/a.py": src},

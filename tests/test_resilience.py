@@ -1,0 +1,456 @@
+"""Resilience subsystem: crash-consistent checkpoints, fault
+injection, the step watchdog, and kill -> resume determinism
+(docs/robustness.md).
+
+The checkpoint tests build real Orbax step dirs and then attack them
+the way a crash would — delete the manifest (torn write), truncate a
+payload file (at-rest corruption) — and assert the resolve/load path
+refuses, falls back, and records ``ckpt_fallback``. The engine tests
+drill the full save -> die -> restore loop in-process with
+``PFX_FAULTS_MODE=raise`` (the subprocess version with a real SIGKILL
+is scripts/chaos_smoke.py) and pin loss-identical resume.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlefleetx_tpu.core import checkpoint as ckpt
+from paddlefleetx_tpu.core.resilience import (
+    FaultInjector, InjectedKill, StepWatchdog, dump_all_stacks,
+)
+
+from test_engine import _build
+
+
+class Recorder:
+    """Event-collecting stand-in for the flight recorder."""
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event, **fields):
+        self.events.append({"event": event, **fields})
+
+    def of(self, event):
+        return [e for e in self.events if e["event"] == event]
+
+
+def _fake_step_dir(root, epoch, step, commit=True, payload=b"x" * 64):
+    """A step dir with one payload file, optionally committed."""
+    path = os.path.join(root, f"epoch_{epoch}_step_{step}")
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "state.bin"), "wb") as f:
+        f.write(payload)
+    if commit:
+        ckpt.write_manifest(path, {"epoch": epoch, "step": step})
+    return path
+
+
+# -- manifest write/verify ----------------------------------------------
+
+
+def test_manifest_roundtrip_and_verify(tmp_path):
+    path = _fake_step_dir(str(tmp_path), 1, 2)
+    mpath = os.path.join(path, ckpt.MANIFEST_NAME)
+    payload = json.load(open(mpath))
+    assert payload["format"] == 1
+    assert payload["meta"]["step"] == 2
+    assert payload["files"]["state.bin"] == 64
+    assert "state.bin" in payload["sha256"]   # small file gets a hash
+    # the manifest never lists itself or temp files
+    assert ckpt.MANIFEST_NAME not in payload["files"]
+    assert ckpt.verify_checkpoint(path) is None
+
+    # truncation = size mismatch
+    with open(os.path.join(path, "state.bin"), "ab") as f:
+        f.truncate(63)
+    assert "size mismatch" in ckpt.verify_checkpoint(path)
+
+    # same-size bit flip = hash mismatch
+    with open(os.path.join(path, "state.bin"), "wb") as f:
+        f.write(b"y" * 63 + b"x")
+    with open(os.path.join(path, "state.bin"), "ab") as f:
+        f.truncate(64)
+    assert "hash mismatch" in ckpt.verify_checkpoint(path)
+
+    os.remove(os.path.join(path, "state.bin"))
+    assert "missing file" in ckpt.verify_checkpoint(path)
+
+    os.remove(mpath)
+    assert "no committed manifest" in ckpt.verify_checkpoint(path)
+
+
+def test_large_files_are_size_checked_not_hashed(tmp_path):
+    big = b"z" * (ckpt._HASH_MAX_BYTES + 1)
+    path = _fake_step_dir(str(tmp_path), 1, 1, payload=big)
+    payload = json.load(open(os.path.join(path, ckpt.MANIFEST_NAME)))
+    assert "state.bin" not in payload["sha256"]
+    assert payload["files"]["state.bin"] == len(big)
+    assert ckpt.verify_checkpoint(path) is None
+
+
+# -- latest_checkpoint resolution ---------------------------------------
+
+
+def test_latest_checkpoint_skips_uncommitted_dir(tmp_path):
+    """The satellite pin: a dir matching the name regex but left by a
+    mid-write kill (no manifest) must NOT be selected."""
+    rec = Recorder()
+    old = _fake_step_dir(str(tmp_path), 1, 2, commit=True)
+    _fake_step_dir(str(tmp_path), 1, 4, commit=False)   # torn write
+    assert ckpt.latest_checkpoint(str(tmp_path), recorder=rec) == old
+    (ev,) = rec.of("ckpt_fallback")
+    assert ev["stage"] == "resolve" and ev["to"] == old
+    assert "step_4" in ev["skipped"][0]["path"]
+    assert "manifest" in ev["skipped"][0]["reason"]
+
+
+def test_latest_checkpoint_none_when_nothing_verified(tmp_path):
+    rec = Recorder()
+    _fake_step_dir(str(tmp_path), 1, 4, commit=False)
+    assert ckpt.latest_checkpoint(str(tmp_path), recorder=rec) is None
+    (ev,) = rec.of("ckpt_fallback")
+    assert ev["to"] is None and ev["stage"] == "resolve"
+
+
+def test_latest_checkpoint_explicit_step_dir_passthrough(tmp_path):
+    path = _fake_step_dir(str(tmp_path), 1, 4, commit=False)
+    # an explicitly named step dir is returned as-is: load_checkpoint
+    # owns verification (and raising) for explicit targets
+    assert ckpt.latest_checkpoint(path) == path
+
+
+# -- keep-last-k GC -----------------------------------------------------
+
+
+def test_gc_keeps_k_newest_verified_and_spares_uncommitted(tmp_path):
+    rec = Recorder()
+    root = str(tmp_path)
+    p2 = _fake_step_dir(root, 1, 2)
+    p4 = _fake_step_dir(root, 1, 4)
+    p6 = _fake_step_dir(root, 1, 6)
+    torn = _fake_step_dir(root, 1, 8, commit=False)   # in-flight/torn
+    deleted = ckpt.gc_checkpoints(root, keep_last_k=2, recorder=rec)
+    assert deleted == [p2]
+    assert not os.path.exists(p2)
+    assert os.path.isdir(p4) and os.path.isdir(p6)
+    assert os.path.isdir(torn)   # never a GC candidate
+    (ev,) = rec.of("ckpt_gc")
+    assert ev["keep_last_k"] == 2 and ev["kept"] == [p6, p4]
+
+
+def test_gc_disabled_and_missing_dir(tmp_path):
+    p2 = _fake_step_dir(str(tmp_path), 1, 2)
+    assert ckpt.gc_checkpoints(str(tmp_path), keep_last_k=0) == []
+    assert ckpt.gc_checkpoints(str(tmp_path), keep_last_k=-1) == []
+    assert os.path.isdir(p2)
+    assert ckpt.gc_checkpoints(str(tmp_path / "nope"), 1) == []
+
+
+# -- fault injector -----------------------------------------------------
+
+
+def test_fault_spec_parsing_and_validation():
+    inj = FaultInjector(
+        "kill@step=7,hang@tick=p0.5:2s,corrupt_ckpt@save=2,"
+        "admit_fail@req=3", kill_mode="raise")
+    kinds = [(f.kind, f.site) for f in inj._faults]
+    assert kinds == [("kill", "step"), ("hang", "tick"),
+                     ("corrupt_ckpt", "save"), ("admit_fail", "req")]
+    assert inj._faults[1].prob == 0.5
+    assert inj._faults[1].duration == 2.0
+    assert inj._faults[0].at == 7
+    for bad in ("kill@step", "nuke@step=1", "kill@lunch=1", "kill",
+                "kill@step=x"):
+        with pytest.raises(ValueError):
+            FaultInjector(bad)
+    with pytest.raises(ValueError, match="PFX_FAULTS_MODE"):
+        FaultInjector("kill@step=1", kill_mode="maybe")
+
+
+def test_fault_fire_is_one_shot_and_recorded():
+    rec = Recorder()
+    inj = FaultInjector("admit_fail@req=3", recorder=rec,
+                        kill_mode="raise")
+    assert inj.fire("req", 1) is None
+    assert inj.fire("step", 3) is None      # wrong site
+    assert inj.fire("req", 3) == "admit_fail"
+    assert inj.fire("req", 3) is None       # one-shot
+    (ev,) = rec.of("fault_injected")
+    assert ev["kind"] == "admit_fail" and ev["count"] == 3
+
+
+def test_fault_kill_raise_mode_emits_before_raising():
+    rec = Recorder()
+    inj = FaultInjector("kill@step=2", recorder=rec, kill_mode="raise")
+    with pytest.raises(InjectedKill):
+        inj.fire("step", 2)
+    assert rec.of("fault_injected")   # durable before the act
+
+
+def test_fault_probabilistic_is_seed_deterministic():
+    fires = []
+    for _ in range(2):
+        inj = FaultInjector("admit_fail@req=p0.3", seed=7,
+                            kill_mode="raise")
+        fires.append([inj.fire("req", i) for i in range(1, 20)])
+    assert fires[0] == fires[1]
+    assert "admit_fail" in fires[0]
+
+
+def test_fault_corrupt_ckpt_truncates_largest_file(tmp_path):
+    path = _fake_step_dir(str(tmp_path), 1, 2)
+    inj = FaultInjector("corrupt_ckpt@save=1", kill_mode="raise")
+    assert inj.fire("save", 1, path=path) == "corrupt_ckpt"
+    assert "size mismatch" in ckpt.verify_checkpoint(path)
+
+
+def test_fault_injector_from_env(monkeypatch):
+    monkeypatch.delenv("PFX_FAULTS", raising=False)
+    assert FaultInjector.from_env() is None
+    monkeypatch.setenv("PFX_FAULTS", "  ")
+    assert FaultInjector.from_env() is None
+    monkeypatch.setenv("PFX_FAULTS", "kill@step=9")
+    monkeypatch.setenv("PFX_FAULTS_MODE", "raise")
+    inj = FaultInjector.from_env()
+    assert inj is not None and inj.kill_mode == "raise"
+
+
+# -- step watchdog ------------------------------------------------------
+
+
+def _wait_for(predicate, timeout=5.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_watchdog_detects_stall_once_per_phase():
+    rec = Recorder()
+    dog = StepWatchdog(name="t", factor=2.0, min_interval_s=0.05,
+                       action="log", recorder=rec)
+    dog.start()
+    try:
+        dog.arm(tag="step 1")
+        assert _wait_for(lambda: dog.stalls == 1)
+        time.sleep(0.2)            # still armed: must not re-fire
+        assert dog.stalls == 1
+        dog.disarm()
+        (ev,) = rec.of("watchdog_stall")
+        assert ev["name"] == "t" and ev["tag"] == "step 1"
+        assert ev["waited_s"] > ev["deadline_s"]
+        assert "watchdog" in ev["stacks"]   # the monitor's own frame
+    finally:
+        dog.stop()
+
+
+def test_watchdog_adaptive_deadline_and_disarm_feeds_history():
+    dog = StepWatchdog(name="t", factor=4.0, min_interval_s=0.01,
+                       action="log")
+    assert dog.deadline_s() == 0.01     # floor before any history
+    for d in (0.5, 1.0, 1.5):
+        dog._durations.append(d)
+    assert dog.deadline_s() == pytest.approx(4.0)   # 4 x median 1.0
+    dog.arm()
+    dog.disarm()
+    assert len(dog._durations) == 4     # completed phase recorded
+
+
+def test_watchdog_abort_action_calls_abort_fn():
+    aborted = threading.Event()
+    dog = StepWatchdog(name="t", factor=2.0, min_interval_s=0.05,
+                       action="abort")
+    dog._abort_fn = aborted.set         # never os._exit in a test
+    dog.start()
+    try:
+        dog.arm()
+        assert _wait_for(aborted.is_set)
+    finally:
+        dog.disarm()
+        dog.stop()
+    with pytest.raises(ValueError, match="PFX_WATCHDOG_ACTION"):
+        StepWatchdog(action="sometimes")
+
+
+def test_watchdog_from_env(monkeypatch):
+    monkeypatch.delenv("PFX_WATCHDOG", raising=False)
+    assert StepWatchdog.from_env() is None
+    monkeypatch.setenv("PFX_WATCHDOG", "1")
+    monkeypatch.setenv("PFX_WATCHDOG_MIN_S", "30")
+    dog = StepWatchdog.from_env(name="decode_tick")
+    try:
+        assert dog is not None and dog.name == "decode_tick"
+        assert dog.min_interval_s == 30.0
+        assert dog._thread is not None and dog._thread.daemon
+    finally:
+        dog.stop()
+
+
+def test_dump_all_stacks_includes_current_thread():
+    out = dump_all_stacks()
+    assert "test_dump_all_stacks_includes_current_thread" in out
+    assert "MainThread" in out
+
+
+# -- engine integration: save -> die -> resume --------------------------
+
+
+def test_resume_determinism_after_injected_kill(tmp_path, monkeypatch):
+    """The tentpole drill, in-process: per-step losses after a
+    kill -> restore are identical to the uninterrupted run, and the
+    dataloader fast-forward matches the restored step."""
+    monkeypatch.delenv("PFX_FAULTS", raising=False)
+
+    def run(tag, max_steps, **over):
+        losses = {}
+        cfg, engine, loader = _build(
+            tmp_path, **{"Engine.max_steps": max_steps,
+                         "Engine.logging_freq": 1, **over})
+        orig = engine.module.training_step_end
+
+        def capture(log):
+            losses[log["batch"]] = log["loss"]
+            orig(log)
+
+        engine.module.training_step_end = capture
+        return cfg, engine, loader, losses
+
+    cfg, engine, loader, base = run("base", 6)
+    engine.fit(epoch=1, train_data_loader=loader)
+    assert sorted(base) == [1, 2, 3, 4, 5, 6]
+
+    out2 = str(tmp_path / "out_chaos")
+    monkeypatch.setenv("PFX_FAULTS", "kill@step=5")
+    monkeypatch.setenv("PFX_FAULTS_MODE", "raise")
+    _, chaos_engine, loader, chaos = run(
+        "chaos", 6, **{"Engine.save_load.output_dir": out2,
+                       "Engine.save_load.save_steps": 2})
+    with pytest.raises(InjectedKill):
+        chaos_engine.fit(epoch=1, train_data_loader=loader)
+    assert sorted(chaos) == [1, 2, 3, 4, 5]
+    for s in chaos:   # same trajectory up to the kill
+        assert chaos[s] == base[s]
+
+    monkeypatch.delenv("PFX_FAULTS")
+    cfg3, resumed, loader, res = run(
+        "resume", 6, **{"Engine.save_load.output_dir": out2,
+                        "Engine.save_load.ckpt_dir": out2,
+                        "Engine.save_load.save_steps": 2})
+    assert int(resumed.state["step"]) == 4   # newest durable save
+    assert resumed._load_recovery["consumed_samples"] == \
+        4 * cfg3.Global.global_batch_size
+    resumed.fit(epoch=1, train_data_loader=loader)
+    assert sorted(res) == [5, 6]
+    assert res[5] == base[5] and res[6] == base[6]
+
+
+def test_corrupted_newest_checkpoint_falls_back(tmp_path, monkeypatch):
+    """corrupt_ckpt chaos case: the newest checkpoint fails
+    verification, the engine restores its predecessor, and the
+    demotion is recorded."""
+    monkeypatch.delenv("PFX_FAULTS", raising=False)
+    cfg, engine, loader = _build(
+        tmp_path, **{"Engine.max_steps": 4,
+                     "Engine.save_load.save_steps": 2})
+    engine.fit(epoch=1, train_data_loader=loader)
+    out = str(tmp_path / "out")
+    newest = ckpt.latest_checkpoint(out)
+    assert newest.endswith("step_4")
+    FaultInjector("corrupt_ckpt@save=1",
+                  kill_mode="raise").fire("save", 1, path=newest)
+
+    # resolve-stage: a fresh engine skips the corrupt dir entirely
+    cfg2, engine2, _ = _build(
+        tmp_path, **{"Engine.max_steps": 4,
+                     "Engine.save_load.ckpt_dir": out})
+    assert int(engine2.state["step"]) == 2
+
+    # load-stage: an explicit path demotes through load_checkpoint
+    rec = Recorder()
+    abstract = __import__("jax").tree.map(
+        lambda x: x, engine2.state)   # concrete state as template
+    state, meta = ckpt.load_checkpoint(newest, abstract,
+                                       fallback_dir=out, recorder=rec)
+    assert meta["step"] == 2
+    (ev,) = rec.of("ckpt_fallback")
+    assert ev["stage"] == "load" and ev["rejected"] == newest
+    with pytest.raises(ckpt.CheckpointCorrupt):
+        ckpt.load_checkpoint(newest, abstract, fallback_dir=None)
+
+
+def test_kill_mid_async_save_resumes_from_previous(tmp_path,
+                                                   monkeypatch):
+    """Kill-mid-async-save chaos case: dying after the TensorStore
+    write started but before the manifest committed leaves a torn
+    (manifest-less) dir that resume must skip in favor of the previous
+    committed checkpoint."""
+    monkeypatch.delenv("PFX_FAULTS", raising=False)
+    monkeypatch.setenv("PFX_FAULTS", "kill@step=5")
+    monkeypatch.setenv("PFX_FAULTS_MODE", "raise")
+    out = str(tmp_path / "out_async")
+    cfg, engine, loader = _build(
+        tmp_path, **{"Engine.max_steps": 6,
+                     "Engine.save_load.output_dir": out,
+                     "Engine.save_load.save_steps": 2,
+                     "Engine.save_load.async_save": True})
+    with pytest.raises(InjectedKill):
+        engine.fit(epoch=1, train_data_loader=loader)
+    # the step-4 save is still pending its manifest commit; simulate
+    # the kill landing before that commit: let the bytes finish but
+    # DROP the pending manifest instead of writing it
+    assert ckpt._PENDING_MANIFEST is not None
+    ckpt._ASYNC_CKPTR.wait_until_finished()
+    ckpt._PENDING_MANIFEST = None
+    torn = os.path.join(out, "epoch_0_step_4")
+    assert os.path.isdir(torn)
+    assert "manifest" in ckpt.verify_checkpoint(torn)
+
+    monkeypatch.delenv("PFX_FAULTS")
+    cfg2, resumed, _ = _build(
+        tmp_path, **{"Engine.max_steps": 6,
+                     "Engine.save_load.output_dir": out,
+                     "Engine.save_load.ckpt_dir": out})
+    assert int(resumed.state["step"]) == 2   # step-4 dir distrusted
+    assert os.path.isdir(torn)               # skipped, not deleted
+
+
+def test_engine_wires_watchdog_and_injector_from_env(tmp_path,
+                                                     monkeypatch):
+    """PFX_WATCHDOG/PFX_FAULTS reach the Engine: a hang fault sleeps
+    inside the armed window, exactly the shape the watchdog times."""
+    monkeypatch.setenv("PFX_WATCHDOG", "1")
+    monkeypatch.setenv("PFX_FAULTS", "hang@step=1:0.01s")
+    cfg, engine, loader = _build(tmp_path,
+                                 **{"Engine.max_steps": 1})
+    try:
+        assert engine._watchdog is not None
+        assert engine._watchdog.name == "train_step"
+        assert engine._faults is not None
+        engine.fit(epoch=1, train_data_loader=loader)
+        assert engine._faults._faults[0].fired   # hang slept in-loop
+    finally:
+        engine._watchdog.stop()
+
+
+def test_engine_keep_last_k_gc(tmp_path, monkeypatch):
+    """save_load.keep_last_k bounds on-disk checkpoints through the
+    engine's save path (default: unlimited retention)."""
+    monkeypatch.delenv("PFX_FAULTS", raising=False)
+    cfg, engine, loader = _build(
+        tmp_path, **{"Engine.max_steps": 3,
+                     "Engine.save_load.save_steps": 1,
+                     "Engine.save_load.keep_last_k": 1})
+    assert engine.keep_last_k == 1
+    engine.fit(epoch=1, train_data_loader=loader)
+    out = str(tmp_path / "out")
+    steps = sorted(d for d in os.listdir(out)
+                   if ckpt._STEP_DIR.match(d))
+    assert steps == ["epoch_0_step_3"]

@@ -21,7 +21,7 @@ import numpy as np
 import pytest
 
 from paddlefleetx_tpu.core.serving import (
-    GenerationServer, default_prefill_buckets,
+    GenerationServer, RequestShed, default_prefill_buckets,
 )
 from paddlefleetx_tpu.models.gpt import GPTConfig, GPTForPretraining
 from paddlefleetx_tpu.models.gpt.generation import (
@@ -986,3 +986,166 @@ def test_paged_spec_pool_exhaustion_preempts_mid_tick(
     srv._alloc.check()
     assert srv._alloc.pages_in_use == 0
     assert srv._alloc.stats["allocs"] == srv._alloc.stats["frees"]
+
+
+# -- graceful degradation: deadlines, shedding, drain -------------------
+#
+# docs/robustness.md: expiry/shedding/drain are RESULTS the client
+# sees (deadline_exceeded / RequestShed / preempted partials), never
+# silent drops — and a drained paged server's partials re-enter a
+# fresh server via submit(resume_tokens=...) with no committed token
+# lost.
+
+
+def test_deadline_exceeded_in_queue(model_and_params):
+    """A queued request whose deadline passes completes as
+    deadline_exceeded with no tokens; its neighbors are unaffected."""
+    import time as _time
+    model, params = model_and_params
+    gen_cfg = _greedy_cfg()
+    ref = _lockstep(model, params, [PROMPTS[0]], gen_cfg)
+    srv = GenerationServer(model, params, gen_cfg, num_slots=1)
+    a = srv.submit(PROMPTS[0])
+    b = srv.submit(PROMPTS[1], deadline_s=0.01)  # stuck behind a
+    _time.sleep(0.05)
+    done = {}
+    _drain(srv, done)
+    assert done[b].finish_reason == "deadline_exceeded"
+    assert done[b].tokens == []
+    assert done[a].tokens == ref[0]
+    assert srv.summary()["deadline_exceeded"] == 1
+
+
+def test_deadline_exceeded_mid_decode_returns_partial(model_and_params):
+    """An in-flight request past its deadline is evicted with its
+    committed tokens — the deadline is checked against wall time, so
+    the test rewinds the slot's deadline instead of sleeping."""
+    model, params = model_and_params
+    srv = GenerationServer(model, params, _greedy_cfg(),
+                           num_slots=1, request_ttl_s=3600.0)
+    a = srv.submit(PROMPTS[0])
+    srv.step()
+    srv.step()
+    (slot,) = [i for i, r in enumerate(srv._slots) if r is not None]
+    srv._slots[slot]["deadline"] = 1.0          # long expired
+    (c,) = srv.step()
+    assert c.request_id == a
+    assert c.finish_reason == "deadline_exceeded"
+    assert len(c.tokens) == 2                   # partial kept
+    assert srv.occupancy == 0                   # slot freed
+
+
+def test_queue_depth_shedding(model_and_params):
+    model, params = model_and_params
+    srv = GenerationServer(model, params, _greedy_cfg(),
+                           num_slots=1, max_queue_depth=2)
+    srv.submit(PROMPTS[0])
+    srv.submit(PROMPTS[1])
+    with pytest.raises(RequestShed, match="queue_depth"):
+        srv.submit(PROMPTS[2])
+    assert srv.summary()["shed"] == 1
+    assert srv.pending == 2                     # shed never queued
+
+
+def test_injected_admit_fail_sheds(model_and_params):
+    from paddlefleetx_tpu.core.resilience import FaultInjector
+    model, params = model_and_params
+    srv = GenerationServer(
+        model, params, _greedy_cfg(), num_slots=1,
+        fault_injector=FaultInjector("admit_fail@req=2",
+                                     kill_mode="raise"))
+    srv.submit(PROMPTS[0])
+    with pytest.raises(RequestShed, match="fault"):
+        srv.submit(PROMPTS[1])
+    srv.submit(PROMPTS[2])                      # one-shot fault
+    assert srv.summary()["shed"] == 1
+
+
+def test_resume_tokens_validation(model_and_params,
+                                  paged_model_and_params):
+    model, params = model_and_params
+    srv = GenerationServer(model, params, _greedy_cfg(), num_slots=1)
+    with pytest.raises(ValueError, match="paged"):
+        srv.submit(PROMPTS[0], resume_tokens=[1, 2])
+    pmodel, pparams = paged_model_and_params
+    psrv = GenerationServer(pmodel, pparams, _greedy_cfg(max_dec=4),
+                            num_slots=1, page_size=128, pool_pages=2,
+                            prefill_chunk_pages=1)
+    with pytest.raises(ValueError, match="max_dec_len"):
+        psrv.submit(PROMPTS[0], resume_tokens=[1, 2, 3, 4])
+
+
+def test_drain_returns_queued_and_inflight_partials(model_and_params):
+    model, params = model_and_params
+    srv = GenerationServer(model, params, _greedy_cfg(), num_slots=1)
+    a = srv.submit(PROMPTS[0])
+    b = srv.submit(PROMPTS[1])
+    srv.step()
+    srv.step()
+    out = {c.request_id: c for c in srv.drain(max_ticks=0)}
+    assert out[a].finish_reason == "preempted"
+    assert len(out[a].tokens) == 2              # committed kept
+    assert out[b].finish_reason == "preempted"
+    assert out[b].tokens == []                  # never admitted
+    with pytest.raises(RequestShed, match="draining"):
+        srv.submit(PROMPTS[2])
+
+
+def test_sigterm_flips_drain_mode_and_close_restores(model_and_params):
+    import os as _os
+    import signal as _signal
+    model, params = model_and_params
+    prev = _signal.getsignal(_signal.SIGTERM)
+    srv = GenerationServer(model, params, _greedy_cfg(),
+                           num_slots=1, drain_on_sigterm=True)
+    ids = [srv.submit(p) for p in PROMPTS[:3]]
+    srv.step()
+    _os.kill(_os.getpid(), _signal.SIGTERM)
+    assert srv._draining
+    done = {c.request_id: c for c in srv.drain(max_ticks=0)}
+    assert set(done) == set(ids)
+    assert all(c.finish_reason == "preempted" for c in done.values())
+    srv.close()
+    assert _signal.getsignal(_signal.SIGTERM) is prev
+    srv.close()                                 # idempotent
+
+
+def test_paged_drain_restart_token_exactness(paged512_model_and_params):
+    """The satellite pin: drain a paged server mid-flight, feed every
+    preempted partial into a FRESH server via resume_tokens, and the
+    stitched completions equal the uninterrupted lockstep rows — no
+    committed token lost, none replayed."""
+    model, params = paged512_model_and_params
+    gen_cfg = _greedy_cfg()
+    ref = _lockstep(model, params, PROMPTS, gen_cfg)
+    srv = GenerationServer(model, params, gen_cfg, num_slots=2,
+                           page_size=128, pool_pages=24)
+    ids = [srv.submit(p) for p in PROMPTS]
+    done = {}
+    for _ in range(3):                          # mid-flight drain
+        for c in srv.step():
+            done[c.request_id] = c
+    for c in srv.drain(max_ticks=0):
+        done[c.request_id] = c
+    assert set(done) == set(ids)
+    partials = [c for c in done.values()
+                if c.finish_reason == "preempted"]
+    assert partials
+    assert any(c.tokens for c in partials)      # real mid-decode state
+
+    srv2 = GenerationServer(model, params, gen_cfg, num_slots=2,
+                            page_size=128, pool_pages=24)
+    remap = {}
+    for c in partials:
+        remap[srv2.submit(c.prompt, resume_tokens=c.tokens)] = \
+            c.request_id
+    done2 = {}
+    _drain(srv2, done2)
+    final = {rid: done[rid] for rid in ids}
+    for nid, rid in remap.items():
+        final[rid] = done2[nid]
+    assert [final[i].tokens for i in ids] == ref
+    assert all(final[i].finish_reason in ("eos", "length")
+               for i in ids)
+    srv2._alloc.check()
+    assert srv2._alloc.pages_in_use == 0
