@@ -1228,6 +1228,7 @@ def bench_serving():
         "decode_ticks": ticks,
         "ttft_p50_ms": total.get("ttft_p50_ms", 0.0),
         "ttft_p99_ms": total.get("ttft_p99_ms", 0.0),
+        "tick_p99_ms": total.get("tick_p99_ms", 0.0),
     }
     _log_success(result)
     print(json.dumps(result))
@@ -1247,6 +1248,7 @@ def bench_serving():
                                                0.0),
             "ttft_p50_ms": spec_total.get("ttft_p50_ms", 0.0),
             "ttft_p99_ms": spec_total.get("ttft_p99_ms", 0.0),
+            "tick_p99_ms": spec_total.get("tick_p99_ms", 0.0),
         }
         _log_success(spec_result)
         print(json.dumps(spec_result))

@@ -9,8 +9,10 @@ code. Review kept this honest for five PRs; this rule makes it
 mechanical in both directions:
 
 - **PFX201** — a series name ``inc``'d / ``set_gauge``'d /
-  ``timer``'d / ``add_time``'d in code but absent from every docs
-  file. Anchored at the first code site.
+  ``timer``'d / ``add_time``'d / ``observe``'d in code — or a SPAN
+  name opened via ``start_trace`` / ``start_span`` / ``span_point`` /
+  ``complete_span`` — but absent from every docs file. Anchored at
+  the first code site.
 - **PFX202** — a docs-promised name (in a namespace code actually
   uses) with no code site: stale docs. Anchored at the docs line.
 
@@ -39,10 +41,19 @@ CODES = ("PFX201", "PFX202")
 
 #: code files whose registrations feed the contract
 _CODE_PREFIX = "paddlefleetx_tpu/"
-#: the registry implementation itself registers nothing
-_EXEMPT_FILES = {"paddlefleetx_tpu/observability/metrics.py"}
+#: the registry/tracer implementations themselves register nothing
+_EXEMPT_FILES = {"paddlefleetx_tpu/observability/metrics.py",
+                 "paddlefleetx_tpu/observability/spans.py"}
 
-_REGISTER_ATTRS = {"inc", "set_gauge", "add_time", "timer"}
+#: histogram observe() joined in PR 10 — same exact-name contract
+_REGISTER_ATTRS = {"inc", "set_gauge", "add_time", "timer", "observe"}
+#: span-name call sites (observability/spans.py) hold the same
+#: docs contract: every span/trace/point name is a docs matrix row;
+#: `_phase` is the serving loop's phase-transition wrapper (its name
+#: argument is positional arg 1, so span attrs scan EVERY positional
+#: arg, not just the first)
+_SPAN_ATTRS = {"start_trace", "start_span", "span_point",
+               "complete_span", "_phase"}
 _NAME_RE = re.compile(r"^[a-z0-9_]+(/[a-z0-9_]+)+$")
 _PREFIX_RE = re.compile(r"^[a-z0-9_]+(/[a-z0-9_]+)*/$")
 _BACKTICK_RE = re.compile(r"`([^`]+)`")
@@ -88,19 +99,24 @@ def _code_registrations(ctx) -> Tuple[
             func = node.func
             attr = func.attr if isinstance(func, ast.Attribute) \
                 else (func.id if isinstance(func, ast.Name) else None)
-            if attr not in _REGISTER_ATTRS:
+            if attr not in _REGISTER_ATTRS and \
+                    attr not in _SPAN_ATTRS:
                 continue
-            arg0 = node.args[0]
-            for c in ast.walk(arg0):
-                if not (isinstance(c, ast.Constant)
-                        and isinstance(c.value, str)):
-                    continue
-                if _NAME_RE.match(c.value):
-                    record(exact, c.value, sf, node)
-                    if attr == "timer":
-                        record(synthetic, c.value + "/calls", sf, node)
-                elif _PREFIX_RE.match(c.value) and "/" in c.value[:-1]:
-                    record(prefixes, c.value, sf, node)
+            scan = node.args if attr in _SPAN_ATTRS \
+                else node.args[:1]
+            for arg in scan:
+                for c in ast.walk(arg):
+                    if not (isinstance(c, ast.Constant)
+                            and isinstance(c.value, str)):
+                        continue
+                    if _NAME_RE.match(c.value):
+                        record(exact, c.value, sf, node)
+                        if attr == "timer":
+                            record(synthetic, c.value + "/calls",
+                                   sf, node)
+                    elif _PREFIX_RE.match(c.value) \
+                            and "/" in c.value[:-1]:
+                        record(prefixes, c.value, sf, node)
     return exact, prefixes, synthetic
 
 

@@ -38,8 +38,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..observability import flops as obs_flops
 from ..observability import metrics as obs_metrics
+from ..observability import server as obs_server
 from ..observability.memory import device_memory_stats, format_bytes
 from ..observability.recorder import FlightRecorder
+from ..observability.spans import NULL_SPAN, Tracer
 from ..observability.trace import annotate
 from ..optims import build_lr_scheduler, build_optimizer
 from ..parallel.mesh import (
@@ -170,11 +172,21 @@ class Engine(BasicEngine):
         self._tele_enabled = bool(tele.get("enable", False))
         self._metrics = obs_metrics.MetricsRegistry(enabled=True)
         self._recorder = None
+        events_path = None
         if self._tele_enabled:
             obs_metrics.set_enabled(True)
-            self._recorder = FlightRecorder(
-                tele.get("events_path") or
-                os.path.join(self.output_dir, "events.jsonl"))
+            events_path = tele.get("events_path") or \
+                os.path.join(self.output_dir, "events.jsonl")
+            self._recorder = FlightRecorder(events_path)
+        # span tracing rides the same recorder: engine/fit owns
+        # per-step engine/step spans with compile/h2d/save children
+        # (docs/observability.md); a recorder-less tracer hands out
+        # NULL_SPAN and costs nothing
+        self._tracer = Tracer(self._recorder)
+        self._fit_span = NULL_SPAN
+        # live /metrics when PFX_METRICS_PORT is set (no-op otherwise)
+        obs_server.start_from_env(registry=self._metrics,
+                                  events_path=events_path)
         # resilience (docs/robustness.md): chaos faults only exist
         # when PFX_FAULTS is set; the stall watchdog only when
         # PFX_WATCHDOG is on — both None on the production default
@@ -618,6 +630,8 @@ class Engine(BasicEngine):
                 global_batch_size=self.global_batch_size,
                 mesh={str(k): int(v)
                       for k, v in dict(self.mesh.shape).items()})
+        self._fit_span = self._tracer.start_trace(
+            "engine/fit", start_step=self._host_step, epochs=epoch)
         prev_handler, installed = None, False
         if self.save_on_preemption:
             try:
@@ -640,6 +654,7 @@ class Engine(BasicEngine):
                 signal.signal(signal.SIGTERM, prev_handler)
             if self._watchdog is not None:
                 self._watchdog.disarm()
+            self._fit_span.end()   # idempotent: no-op on clean exit
 
     def _fit_epochs(self, epoch, train_data_loader, valid_data_loader):
         start_epoch = self._load_recovery["epoch"]
@@ -693,6 +708,9 @@ class Engine(BasicEngine):
         stats = self._summary_stats()
         if self._summary_enabled():
             self._print_summary(stats)
+        # the fit trace closes BEFORE fit_end: the recorder contract
+        # pins fit_end as the stream's last fit-scoped record
+        self._fit_span.end(step=self._host_step)
         if self._recorder is not None:
             self._recorder.emit(
                 "fit_end", step=self._host_step,
@@ -719,6 +737,8 @@ class Engine(BasicEngine):
                     # at the logging sync / next donation — still
                     # inside this window
                     self._watchdog.arm(tag=f"step {step + 1}")
+                step_span = self._fit_span.start_span(
+                    "engine/step", step=step + 1)
                 t_call = time.time()
                 with annotate("train_step"):
                     self.state, metrics = self._train_step(
@@ -731,12 +751,15 @@ class Engine(BasicEngine):
                     self._compile_pending = False
                     compile_s = time.time() - t_call
                     self._time_buckets["compile"] += compile_s
+                    step_span.complete_span("engine/compile",
+                                            compile_s)
                     if self._recorder is not None:
                         self._recorder.emit(
                             "compile", step=step,
                             seconds=round(compile_s, 4),
                             hbm=self._sample_memory())
                 self._h2d_waits.append(h2d_wait)
+                step_span.complete_span("engine/h2d", h2d_wait)
                 step += 1
                 self._host_step = step
                 if step % self.logging_freq == 0:
@@ -761,6 +784,8 @@ class Engine(BasicEngine):
                     # per-step quotient)
                     if window_clean:
                         self._step_costs.append(cost)
+                        self._metrics.observe("engine/step_time_ms",
+                                              cost * 1000.0)
                     if self._recorder is not None:
                         w = self._h2d_waits[-self.logging_freq:]
                         self._recorder.emit(
@@ -773,6 +798,7 @@ class Engine(BasicEngine):
                             hbm=mem)
                     window_clean = True
                     step_start = time.time()
+                step_span.end()
                 if self.run_mode == "step" and \
                         step % self.eval_freq == 0 and \
                         valid_data_loader is not None:
@@ -1151,6 +1177,7 @@ class Engine(BasicEngine):
         save_s = time.time() - t0
         self._time_buckets["save"] += save_s
         self._metrics.add_time("save", save_s)
+        self._fit_span.complete_span("engine/save", save_s, step=step)
         if self._recorder is not None:
             self._recorder.emit("save", step=step, epoch=epoch,
                                 save_s=round(save_s, 4),

@@ -128,10 +128,12 @@ class InferenceEngine:
         return outputs keyed by position (the reference returns the
         predictor's named output handles; positions are the stable
         equivalent here). Each call accumulates wall time under the
-        ``inference/predict`` timer and bumps ``inference/predict_calls``
-        and ``inference/output_tokens`` (total output elements) —
-        docs/observability.md."""
+        ``inference/predict`` timer, the ``inference/predict_ms``
+        latency histogram (p50/p99 on ``/metrics``), and bumps
+        ``inference/predict_calls`` and ``inference/output_tokens``
+        (total output elements) — docs/observability.md."""
         metrics.inc("inference/predict_calls")
+        t_call = time.time()
         pads = self.pad_values or [0] * len(data)
         inputs = pad_to_spec([np.asarray(d) for d in data], self.spec,
                              pads, self.pad_sides)
@@ -147,6 +149,8 @@ class InferenceEngine:
             # lands inside the per-call latency timer
             result = {str(i): np.asarray(o)
                       for i, o in enumerate(outputs)}
+        metrics.observe("inference/predict_ms",
+                        (time.time() - t_call) * 1000.0)
         metrics.inc("inference/output_tokens",
                     sum(o.size for o in result.values()))
         return result
@@ -163,7 +167,11 @@ class InferenceEngine:
         ``prefill_chunk_pages`` / ``prefix_sharing`` —
         docs/inference.md, "Paged KV cache" — and the graceful-
         degradation knobs ``request_ttl_s`` / ``max_queue_depth`` /
-        ``drain_on_sigterm`` — docs/robustness.md)."""
+        ``drain_on_sigterm`` — docs/robustness.md). With
+        ``events_path`` the server traces every request
+        (docs/observability.md, "Request tracing"); with
+        ``PFX_METRICS_PORT`` set it serves live ``/metrics`` +
+        ``/healthz``."""
         from .serving import GenerationServer
         return GenerationServer(model, params, gen_cfg,
                                 num_slots=num_slots, **kwargs)

@@ -72,6 +72,20 @@ gauge, a ``serving/decode_tick`` timer, and a tokens/s + TTFT p50/p99
 summary;
 an optional flight recorder mirrors admissions/evictions to an
 ``events.jsonl`` stream CI's failure-diagnostics artifact collects.
+
+Latency percentiles ride fixed-memory log-bucketed histograms in a
+server-local registry (``serving/ttft_ms``, ``serving/queue_wait_ms``,
+``serving/tpot_ms``, ``serving/tick_ms`` — O(buckets) forever, no
+unbounded sample lists), and with ``events_path`` set every request
+gets a TRACE: a ``serving/request`` root span with
+``serving/queue`` → ``serving/prefill`` → ``serving/decode`` phase
+children and a ``serving/first_token`` point, preemption ending the
+decode phase and re-opening a queue phase UNDER THE SAME trace id —
+so one grep of events.jsonl (or the live ``/trace`` endpoint)
+reconstructs a request's whole life, submit through evict. With
+``PFX_METRICS_PORT`` set the server also exposes live ``/metrics``,
+``/vars``, ``/healthz`` (drain-aware: 503 while draining) and
+``/trace`` endpoints (``observability/server.py``).
 """
 
 from __future__ import annotations
@@ -93,7 +107,9 @@ from ..models.gpt.generation import (
     prefill_chunk_paged, prefill_into_slots, verify_step,
 )
 from ..observability import metrics
+from ..observability import server as obs_server
 from ..observability.recorder import FlightRecorder
+from ..observability.spans import Tracer
 from ..utils.log import logger
 from .paging import (
     NULL_PAGE, PageAllocator, PagePoolExhausted, page_prefix_keys,
@@ -134,6 +150,10 @@ class Completion:
     #: "eos" | "length" (hit max_dec_len) | "preempted" |
     #: "deadline_exceeded" (TTL expired; ``tokens`` holds the partial)
     finish_reason: str
+    #: the request's trace id (None without an event stream); pass it
+    #: back to ``submit(resume_tokens=..., trace_id=...)`` so the
+    #: resumed request's spans link to the original timeline
+    trace_id: Optional[str] = None
 
 
 class GenerationServer:
@@ -273,9 +293,18 @@ class GenerationServer:
                     "outside the main thread; call drain() explicitly")
         self._decode_tokens = 0
         self._tick_time = 0.0
-        self._ttfts: List[float] = []
+        # latency histograms live in a server-local always-on registry
+        # (summary percentiles must work with global telemetry off);
+        # fixed-memory log buckets replace the old unbounded TTFT list
+        self._metrics = metrics.MetricsRegistry(enabled=True)
         self._recorder = FlightRecorder(events_path) if events_path \
             else None
+        self._tracer = Tracer(self._recorder)
+        # live /metrics + drain-aware /healthz when PFX_METRICS_PORT
+        # is set; a no-op otherwise (docs/observability.md)
+        self._metrics_server = obs_server.start_from_env(
+            registry=self._metrics, health=self._health_state,
+            events_path=events_path)
         self._faults = fault_injector if fault_injector is not None \
             else FaultInjector.from_env(recorder=self._recorder)
         self._watchdog = StepWatchdog.from_env(name="decode_tick",
@@ -309,6 +338,59 @@ class GenerationServer:
         if self._recorder is not None:
             self._recorder.emit(event, **fields)
 
+    def _health_state(self) -> dict:
+        """The ``/healthz`` payload: ``status`` flips to ``draining``
+        the moment drain mode is entered (SIGTERM or :meth:`drain`),
+        which answers HTTP 503 — the load balancer's stop-routing
+        signal."""
+        return {"status": "draining" if self._draining else "ok",
+                "slots": self.num_slots, "occupancy": self.occupancy,
+                "pending": self.pending, "ticks": self._ticks}
+
+    # -- per-request tracing (docs/observability.md) ------------------
+    #
+    # Every request owns a root span (req["span"]) plus ONE open phase
+    # child (req["phase"]): queue -> prefill -> decode, looping back
+    # to queue on preemption under the SAME trace id. With no event
+    # stream the tracer hands out NULL_SPAN and all of this is no-op
+    # attribute calls.
+
+    def _begin_trace(self, req: dict,
+                     trace_id: Optional[str] = None) -> None:
+        req["span"] = self._tracer.start_trace(
+            "serving/request", trace_id=trace_id, request=req["id"],
+            prompt_len=len(req["prompt"]),
+            resumed=bool(req["tokens"]) or None)
+        req["phase"] = req["span"].start_span("serving/queue")
+        req["queue_t0"] = time.time()
+
+    def _phase(self, req: dict, name: str, **attrs) -> None:
+        """End the open phase child and begin the next one."""
+        req["phase"].end()
+        req["phase"] = req["span"].start_span(name, **attrs)
+
+    def _trace_id(self, req: dict) -> Optional[str]:
+        span = req.get("span")
+        return span.trace_id if span is not None else None
+
+    def _observe_queue_wait(self, req: dict) -> None:
+        """This queue EPISODE's wait (re-queues reset the clock)."""
+        self._metrics.observe(
+            "serving/queue_wait_ms",
+            (time.time() - req.get("queue_t0", req["submit_t"]))
+            * 1000.0)
+
+    def _end_request_spans(self, req: dict, reason: str) -> None:
+        """Close the open phase and the root span (idempotent; safe on
+        requests that never had spans)."""
+        phase = req.pop("phase", None)
+        if phase is not None:
+            phase.end(reason=reason)
+        span = req.pop("span", None)
+        if span is not None:
+            span.end(reason=reason, tokens=len(req["tokens"]))
+            req["span"] = span   # keep for _trace_id after eviction
+
     @property
     def occupancy(self) -> int:
         """Number of slots currently holding a live request."""
@@ -321,7 +403,8 @@ class GenerationServer:
 
     def submit(self, prompt: Sequence[int],
                deadline_s: Optional[float] = None,
-               resume_tokens: Optional[Sequence[int]] = None) -> int:
+               resume_tokens: Optional[Sequence[int]] = None,
+               trace_id: Optional[str] = None) -> int:
         """Queue a request; returns its id. Raises ``ValueError`` when
         the prompt can never fit (``prompt + max_dec_len >
         max_position_embeddings``) — an oversized request must fail
@@ -338,7 +421,10 @@ class GenerationServer:
         from a drained/preempted completion: admission re-prefills
         prompt+tokens and the sampling stream resumes at the preserved
         decode count, so a greedy resume is token-exact with the
-        uninterrupted run."""
+        uninterrupted run. ``trace_id`` (with an event stream) links
+        the new request's spans to an earlier timeline — pass
+        ``Completion.trace_id`` back with ``resume_tokens`` so a
+        drained-then-resumed request reads as ONE trace."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -370,11 +456,12 @@ class GenerationServer:
         self._next_id += 1
         ttl = deadline_s if deadline_s is not None else \
             self.request_ttl_s
-        self._queue.append({"id": rid, "prompt": prompt,
-                            "tokens": tokens,
-                            "submit_t": time.time(),
-                            "deadline": time.time() + ttl
-                            if ttl is not None else None})
+        req = {"id": rid, "prompt": prompt, "tokens": tokens,
+               "submit_t": time.time(),
+               "deadline": time.time() + ttl
+               if ttl is not None else None}
+        self._begin_trace(req, trace_id)
+        self._queue.append(req)
         return rid
 
     def _shed(self, reason: str) -> int:
@@ -410,13 +497,16 @@ class GenerationServer:
                 if dl is not None and now > dl:
                     self._counts["deadline_exceeded"] += 1
                     metrics.inc("serving/deadline_exceeded")
+                    self._end_request_spans(req, "deadline_exceeded")
                     self._emit("serving_evict", request=req["id"],
                                slot=-1, reason="deadline_exceeded",
-                               tokens=len(req["tokens"]))
+                               tokens=len(req["tokens"]),
+                               trace=self._trace_id(req))
                     out.append(Completion(
                         request_id=req["id"], prompt=req["prompt"],
                         tokens=req["tokens"],
-                        finish_reason="deadline_exceeded"))
+                        finish_reason="deadline_exceeded",
+                        trace_id=self._trace_id(req)))
                 else:
                     keep.append(req)
             self._queue = keep
@@ -443,6 +533,8 @@ class GenerationServer:
             req = self._queue.popleft()
             slot = self._slots.index(None)
             bucket = self._bucket_for(len(req["prompt"]))
+            self._observe_queue_wait(req)
+            self._phase(req, "serving/prefill", slot=slot)
             row = np.full((1, bucket), self.gen_cfg.pad_token_id,
                           np.int32)
             row[0, :len(req["prompt"])] = req["prompt"]
@@ -457,7 +549,9 @@ class GenerationServer:
             self._counts["admitted"] += 1
             metrics.inc("serving/admitted")
             self._emit("serving_admit", request=req["id"], slot=slot,
-                       prompt_len=len(req["prompt"]), bucket=bucket)
+                       prompt_len=len(req["prompt"]), bucket=bucket,
+                       trace=self._trace_id(req))
+            self._phase(req, "serving/decode", slot=slot)
 
     # -- paged scheduling ---------------------------------------------
     #
@@ -499,6 +593,8 @@ class GenerationServer:
         self._slots[slot] = req
         self._counts["admitted"] += 1
         metrics.inc("serving/admitted")
+        self._observe_queue_wait(req)
+        self._phase(req, "serving/prefill", slot=slot)
 
     def _activate(self, slot: int, last_logits_row) -> None:
         """Flip a placed slot live: per-slot SlotState from the host's
@@ -517,6 +613,7 @@ class GenerationServer:
         req["active"] = True
         req["cur_len"] = len(seq)
         self._pt_dirty = True   # decode view must unhide this row
+        self._phase(req, "serving/decode", slot=slot)
 
     def _admit_paged(self) -> None:
         """Paged admission: whole-prompt registry hit -> share every
@@ -546,7 +643,8 @@ class GenerationServer:
                 self._activate(slot, last)
                 self._emit("serving_admit", request=req["id"],
                            slot=slot, prompt_len=L, mode="prompt_hit",
-                           shared_pages=len(pages))
+                           shared_pages=len(pages),
+                           trace=self._trace_id(req))
                 continue
             shared_pids: List[int] = []
             if self._prefix_sharing:
@@ -588,7 +686,8 @@ class GenerationServer:
             self._prefilling.append(slot)
             self._emit("serving_admit", request=req["id"], slot=slot,
                        prompt_len=L, mode="chunked",
-                       shared_pages=len(shared_pids), chunks=n_chunks)
+                       shared_pages=len(shared_pids), chunks=n_chunks,
+                       trace=self._trace_id(req))
 
     def _prefill_pump(self) -> None:
         """Run at most ONE page-aligned prefill chunk per step — the
@@ -614,7 +713,8 @@ class GenerationServer:
         metrics.inc("serving/prefill_chunks")
         self._emit("serving_prefill_chunk", request=req["id"],
                    slot=slot, start=c0,
-                   tokens=min(self._chunk, L - c0))
+                   tokens=min(self._chunk, L - c0),
+                   trace=self._trace_id(req))
         if req["prefill_pos"] < L:
             return
         self._prefilling.popleft()
@@ -692,11 +792,17 @@ class GenerationServer:
             finished=self._state.finished.at[victim].set(False))
         req["active"] = False
         req.pop("prefill_pos", None)
+        # the SAME root span survives the round trip: the running
+        # phase ends as preempted and a fresh queue phase opens, so
+        # the whole preempt-resume life is one trace id
+        self._phase(req, "serving/queue", requeued=True)
+        req["queue_t0"] = time.time()
         self._queue.appendleft(req)
         self._counts["preempted"] += 1
         metrics.inc("serving/preempted")
         self._emit("serving_preempt", request=req["id"], slot=victim,
-                   reason="pages", tokens=len(req["tokens"]))
+                   reason="pages", tokens=len(req["tokens"]),
+                   trace=self._trace_id(req))
 
     def _page_maintenance(self, window: int = 1) -> None:
         """Before every decode tick: each active slot's next ``window``
@@ -754,10 +860,21 @@ class GenerationServer:
         if reason == "preempted":
             self._counts["preempted"] += 1
             metrics.inc("serving/preempted")
+        ft = req.get("first_tok_t")
+        if ft is not None and len(req["tokens"]) > 1:
+            # steady-state decode latency: wall time past the first
+            # token over the tokens it bought
+            self._metrics.observe(
+                "serving/tpot_ms",
+                (time.time() - ft) * 1000.0
+                / (len(req["tokens"]) - 1))
+        self._end_request_spans(req, reason)
         self._emit("serving_evict", request=req["id"], slot=slot,
-                   reason=reason, tokens=len(req["tokens"]))
+                   reason=reason, tokens=len(req["tokens"]),
+                   trace=self._trace_id(req))
         return Completion(request_id=req["id"], prompt=req["prompt"],
-                          tokens=req["tokens"], finish_reason=reason)
+                          tokens=req["tokens"], finish_reason=reason,
+                          trace_id=self._trace_id(req))
 
     def preempt(self, request_id: int) -> Optional[Completion]:
         """Cancel a request (client abort / scheduler decision): evict
@@ -771,11 +888,14 @@ class GenerationServer:
                 del self._queue[i]
                 self._counts["preempted"] += 1
                 metrics.inc("serving/preempted")
+                self._end_request_spans(req, "preempted")
                 self._emit("serving_evict", request=request_id,
-                           slot=-1, reason="preempted", tokens=0)
+                           slot=-1, reason="preempted", tokens=0,
+                           trace=self._trace_id(req))
                 return Completion(request_id=request_id,
                                   prompt=req["prompt"], tokens=[],
-                                  finish_reason="preempted")
+                                  finish_reason="preempted",
+                                  trace_id=self._trace_id(req))
         return None
 
     # -- the serving loop ---------------------------------------------
@@ -852,7 +972,9 @@ class GenerationServer:
                 tok = np.asarray(tok)   # device sync inside the timer
                 window = tok[:, None]
                 counts = np.ones((self.num_slots,), np.int32)
-        self._tick_time += time.time() - t0
+        tick_s = time.time() - t0
+        self._tick_time += tick_s
+        self._metrics.observe("serving/tick_ms", tick_s * 1000.0)
         if self._watchdog is not None:
             self._watchdog.disarm()
         self._ticks += 1
@@ -873,7 +995,12 @@ class GenerationServer:
             req["tokens"].extend(int(t) for t in window[slot, :m])
             if "ttft" not in req:
                 req["ttft"] = now - req["submit_t"]
-                self._ttfts.append(req["ttft"])
+                req["first_tok_t"] = now
+                self._metrics.observe("serving/ttft_ms",
+                                      req["ttft"] * 1000.0)
+                req["span"].span_point(
+                    "serving/first_token",
+                    ttft_ms=round(req["ttft"] * 1000.0, 3))
             if self.paged:
                 req["cur_len"] += m
                 if self.spec:
@@ -948,12 +1075,15 @@ class GenerationServer:
             req = self._queue.popleft()
             self._counts["preempted"] += 1
             metrics.inc("serving/preempted")
+            self._end_request_spans(req, "preempted")
             self._emit("serving_evict", request=req["id"], slot=-1,
-                       reason="preempted", tokens=len(req["tokens"]))
+                       reason="preempted", tokens=len(req["tokens"]),
+                       trace=self._trace_id(req))
             out.append(Completion(request_id=req["id"],
                                   prompt=req["prompt"],
                                   tokens=req["tokens"],
-                                  finish_reason="preempted"))
+                                  finish_reason="preempted",
+                                  trace_id=self._trace_id(req)))
         return out
 
     def close(self) -> None:
@@ -994,10 +1124,16 @@ class GenerationServer:
              "decode_tokens": self._decode_tokens,
              "decode_time_sec": round(self._tick_time, 4),
              "tokens_per_sec": round(tps, 2), **self._counts}
-        if self._ttfts:
-            ms = np.asarray(self._ttfts) * 1000.0
-            s["ttft_p50_ms"] = round(float(np.percentile(ms, 50)), 3)
-            s["ttft_p99_ms"] = round(float(np.percentile(ms, 99)), 3)
+        # percentiles from the fixed-memory histograms — field names
+        # ttft_p50_ms/ttft_p99_ms are a pinned contract
+        for prefix, series in (("ttft", "serving/ttft_ms"),
+                               ("queue_wait", "serving/queue_wait_ms"),
+                               ("tpot", "serving/tpot_ms"),
+                               ("tick", "serving/tick_ms")):
+            h = self._metrics.histogram(series)
+            if h is not None and h.count:
+                s[f"{prefix}_p50_ms"] = round(h.percentile(50), 3)
+                s[f"{prefix}_p99_ms"] = round(h.percentile(99), 3)
         if self.spec:
             s["spec_tokens"] = self._spec_k
             s["spec_drafted"] = self._spec_drafted

@@ -10,19 +10,24 @@ grep-able log lines plus out-of-band scripts. See
 names.
 """
 
-from . import metrics
+from . import export, metrics, server
 from .flops import (
     PEAK_FLOPS_BY_KIND, causal_attn_flops, model_flops_per_token,
     peak_flops,
 )
+from .histogram import LogHistogram
 from .memory import device_memory_stats, format_bytes
 from .metrics import MetricsRegistry, get_registry
-from .recorder import FlightRecorder
+from .recorder import FlightRecorder, read_events, read_tail
+from .server import MetricsServer
+from .spans import NULL_SPAN, Span, Tracer
 from .trace import annotate
 
 __all__ = [
-    "FlightRecorder", "MetricsRegistry", "PEAK_FLOPS_BY_KIND",
-    "annotate", "causal_attn_flops", "device_memory_stats",
-    "format_bytes", "get_registry", "metrics", "model_flops_per_token",
-    "peak_flops",
+    "FlightRecorder", "LogHistogram", "MetricsRegistry",
+    "MetricsServer", "NULL_SPAN", "PEAK_FLOPS_BY_KIND", "Span",
+    "Tracer", "annotate", "causal_attn_flops", "device_memory_stats",
+    "export", "format_bytes", "get_registry", "metrics",
+    "model_flops_per_token", "peak_flops", "read_events", "read_tail",
+    "server",
 ]

@@ -1,0 +1,163 @@
+"""Metric and trace exporters: Prometheus text + Perfetto/Chrome JSON.
+
+Two render paths over the in-process telemetry, both stdlib-only:
+
+- :func:`prometheus_text` walks one or more live
+  :class:`~paddlefleetx_tpu.observability.metrics.MetricsRegistry`
+  objects into the Prometheus text exposition format (version 0.0.4):
+  counters as ``pfx_<name>_total``, numeric gauges as ``pfx_<name>``,
+  timers as ``pfx_<name>_seconds_total``, histograms as cumulative
+  ``_bucket{le=...}`` series + ``_sum``/``_count``. Series names have
+  ``/`` mapped to ``_`` (``serving/ttft_ms`` ->
+  ``pfx_serving_ttft_ms``); the grammar is pinned by
+  ``tests/test_tracing.py``.
+- :func:`chrome_trace` converts flight-recorder span records
+  (``observability/spans.py``) into the Chrome trace-event JSON that
+  Perfetto / ``chrome://tracing`` loads directly — each trace id gets
+  its own track, so a request's submit→evict life reads as one row
+  next to the ``jax.profiler`` device trace.
+
+Both are served live by ``observability/server.py`` (``/metrics`` and
+``/trace``).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Iterable, List
+
+_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+#: flight-recorder event kinds the trace exporter understands
+_SPAN_EVENTS = ("span_begin", "span_end", "span", "span_point")
+
+
+def _metric_name(name: str, suffix: str = "") -> str:
+    """``serving/ttft_ms`` -> ``pfx_serving_ttft_ms<suffix>``."""
+    return "pfx_" + _SANITIZE_RE.sub("_", name) + suffix
+
+
+def _fmt(value: Any) -> str:
+    """A Prometheus-grammar sample value (floats in repr precision)."""
+    return repr(float(value))
+
+
+def prometheus_text(registries: Iterable[Any]) -> str:
+    """Text exposition of the given registries, merged.
+
+    Args:
+        registries: live ``MetricsRegistry`` objects (NOT snapshots —
+            histograms export their bucket arrays). Counter/timer
+            values merge by summation, gauges last-wins, histograms
+            first-wins.
+
+    Returns:
+        The exposition body, one ``# TYPE`` comment + samples per
+        metric, trailing newline included.
+    """
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    timers: Dict[str, float] = {}
+    hists: Dict[str, Any] = {}
+    for reg in registries:
+        snap = reg.snapshot()
+        for k, v in snap["counters"].items():
+            counters[k] = counters.get(k, 0) + v
+        for k, v in snap["gauges"].items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                gauges[k] = float(v)
+        for k, v in snap["timers"].items():
+            timers[k] = timers.get(k, 0.0) + v
+        for k, h in reg.histograms().items():
+            hists.setdefault(k, h)
+    lines: List[str] = []
+    for name, val in sorted(counters.items()):
+        m = _metric_name(name, "_total")
+        lines += [f"# TYPE {m} counter", f"{m} {_fmt(val)}"]
+    for name, val in sorted(gauges.items()):
+        m = _metric_name(name)
+        lines += [f"# TYPE {m} gauge", f"{m} {_fmt(val)}"]
+    for name, val in sorted(timers.items()):
+        m = _metric_name(name, "_seconds_total")
+        lines += [f"# TYPE {m} counter", f"{m} {_fmt(val)}"]
+    for name, h in sorted(hists.items()):
+        m = _metric_name(name)
+        lines.append(f"# TYPE {m} histogram")
+        for upper, cum in h.cumulative():
+            lines.append(f'{m}_bucket{{le="{_fmt(upper)}"}} {cum}')
+        lines.append(f'{m}_bucket{{le="+Inf"}} {h.count}')
+        lines.append(f"{m}_sum {_fmt(h.sum)}")
+        lines.append(f"{m}_count {h.count}")
+    return "\n".join(lines) + "\n"
+
+
+def merge_snapshots(snapshots: Iterable[Dict[str, Any]]
+                    ) -> Dict[str, Any]:
+    """Merge registry ``snapshot()`` dicts for the ``/vars`` endpoint
+    (counters/timers sum, gauges/series/histograms last-wins)."""
+    out: Dict[str, Any] = {"counters": {}, "gauges": {}, "timers": {},
+                           "series": {}, "histograms": {}}
+    for snap in snapshots:
+        for k, v in snap.get("counters", {}).items():
+            out["counters"][k] = out["counters"].get(k, 0) + v
+        for k, v in snap.get("timers", {}).items():
+            out["timers"][k] = out["timers"].get(k, 0.0) + v
+        out["gauges"].update(snap.get("gauges", {}))
+        out["series"].update(snap.get("series", {}))
+        out["histograms"].update(snap.get("histograms", {}))
+    return out
+
+
+def _span_args(rec: Dict[str, Any]) -> Dict[str, Any]:
+    """Everything a span record carries beyond the envelope fields."""
+    return {k: v for k, v in rec.items()
+            if k not in ("ts", "event", "name", "trace", "span",
+                         "parent", "dur_ms")}
+
+
+def chrome_trace(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Chrome trace-event JSON from flight-recorder records.
+
+    Args:
+        records: parsed events.jsonl records (non-span events are
+            skipped); ``observability.recorder.read_events`` provides
+            them rotation-aware.
+
+    Returns:
+        ``{"traceEvents": [...], "displayTimeUnit": "ms"}`` — loadable
+        by Perfetto and ``chrome://tracing``. Each trace id becomes a
+        thread (track), named ``trace <id>``; ``span``/``span_begin``/
+        ``span_end`` map to phases ``X``/``B``/``E``, points to ``i``.
+    """
+    tids: Dict[str, int] = {}
+    events: List[Dict[str, Any]] = []
+
+    def tid_for(trace: Any) -> int:
+        key = str(trace)
+        if key not in tids:
+            tids[key] = len(tids) + 1
+            events.append({
+                "ph": "M", "name": "thread_name", "pid": 1,
+                "tid": tids[key],
+                "args": {"name": f"trace {key}"}})
+        return tids[key]
+
+    for rec in records:
+        kind = rec.get("event")
+        if kind not in _SPAN_EVENTS:
+            continue
+        ts_us = float(rec.get("ts", 0.0)) * 1e6
+        base = {"name": rec.get("name", "?"), "pid": 1,
+                "tid": tid_for(rec.get("trace")),
+                "args": _span_args(rec)}
+        if kind == "span_begin":
+            events.append({**base, "ph": "B", "ts": ts_us})
+        elif kind == "span_end":
+            events.append({**base, "ph": "E", "ts": ts_us})
+        elif kind == "span":
+            dur_us = float(rec.get("dur_ms", 0.0)) * 1e3
+            events.append({**base, "ph": "X",
+                           "ts": ts_us - dur_us, "dur": dur_us})
+        else:   # span_point
+            events.append({**base, "ph": "i", "ts": ts_us, "s": "t"})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

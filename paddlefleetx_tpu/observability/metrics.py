@@ -1,4 +1,5 @@
-"""Lightweight metrics registry: counters / gauges / timers / series.
+"""Lightweight metrics registry: counters / gauges / timers / series /
+histograms.
 
 Two registries exist:
 
@@ -12,23 +13,32 @@ Two registries exist:
   (mp-linear lowering). It is DISABLED by default; the engine enables
   it when ``Telemetry.enable`` is on.
 
-Cost discipline: the module-level ``inc`` is the only call that can
-sit on a hot path, and when the global registry is disabled it is a
-single attribute load + boolean test (the bench-harness test pins
-the disabled overhead below 1% of a host step). Dispatch counters
-additionally fire only at TRACE time — once per compilation, never
-per executed step.
+Cost discipline: the module-level ``inc`` and ``observe`` are the
+only calls that can sit on a hot path, and when the global registry
+is disabled each is a single attribute load + boolean test (the
+bench-harness test pins the disabled overhead below 1% of a host
+step). Dispatch counters additionally fire only at TRACE time — once
+per compilation, never per executed step.
+
+Histograms (``observe``) are fixed-memory log-bucketed estimators
+(``observability/histogram.py``) — the latency-percentile series
+(serving TTFT/queue-wait/tick, engine step time) ride them instead of
+unbounded sample lists; names are pinned to the docs matrices by the
+same PFX201/PFX202 contract as the counters.
 """
 
 from __future__ import annotations
 
 import time
 from contextlib import contextmanager
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
+
+from .histogram import LogHistogram
 
 
 class MetricsRegistry:
-    """Counters / gauges / timers / sample series in plain dicts."""
+    """Counters / gauges / timers / series / histograms in plain
+    dicts."""
 
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
@@ -36,6 +46,7 @@ class MetricsRegistry:
         self._gauges: Dict[str, Any] = {}
         self._timers: Dict[str, float] = {}
         self._series: Dict[str, List[float]] = {}
+        self._hists: Dict[str, LogHistogram] = {}
 
     # -- counters ------------------------------------------------------
     def inc(self, name: str, n: float = 1) -> None:
@@ -83,25 +94,52 @@ class MetricsRegistry:
         costs nothing on the appending path."""
         return self._series.setdefault(name, [])
 
+    # -- histograms ----------------------------------------------------
+    def observe(self, name: str, value: float) -> None:
+        """Record one sample into the log-bucketed histogram under
+        ``name`` (created on first use). O(1), O(buckets) memory —
+        the percentile-series counterpart of ``inc``."""
+        if not self.enabled:
+            return
+        h = self._hists.get(name)
+        if h is None:
+            h = self._hists[name] = LogHistogram()
+        h.observe(value)
+
+    def histogram(self, name: str) -> Optional[LogHistogram]:
+        """The live histogram registered under ``name``, or None."""
+        return self._hists.get(name)
+
+    def histograms(self) -> Dict[str, LogHistogram]:
+        """Shallow copy of the name -> histogram table (the Prometheus
+        exporter walks the live bucket arrays through this)."""
+        return dict(self._hists)
+
     # -- lifecycle -----------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
         """Point-in-time copy: ``{"counters", "gauges", "timers",
-        "series"}`` (series copied shallowly)."""
+        "series", "histograms"}`` (series copied shallowly, histograms
+        as summary dicts)."""
         return {
             "counters": dict(self._counters),
             "gauges": dict(self._gauges),
             "timers": dict(self._timers),
             "series": {k: list(v) for k, v in self._series.items()},
+            "histograms": {k: h.snapshot()
+                           for k, h in self._hists.items()},
         }
 
     def reset(self) -> None:
         """Zero everything; registered series are cleared IN PLACE so
-        aliases handed out by ``series()`` stay live."""
+        aliases handed out by ``series()`` stay live (histograms
+        likewise reset in place, not dropped)."""
         self._counters.clear()
         self._gauges.clear()
         self._timers.clear()
         for v in self._series.values():
             del v[:]
+        for h in self._hists.values():
+            h.reset()
 
 
 #: process-global dispatch-counter registry; disabled until the engine
@@ -123,3 +161,11 @@ def inc(name: str, n: float = 1) -> None:
     if not _global.enabled:
         return
     _global._counters[name] = _global._counters.get(name, 0) + n
+
+
+def observe(name: str, value: float) -> None:
+    """Hot-path global histogram sample; a no-op boolean test when
+    telemetry is disabled (same cost discipline as ``inc``)."""
+    if not _global.enabled:
+        return
+    _global.observe(name, value)

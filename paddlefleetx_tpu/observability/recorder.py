@@ -12,6 +12,13 @@ Schema: ``{"ts": <unix seconds>, "event": <name>, ...fields}``; the
 event vocabulary is pinned in ``docs/observability.md``. ``tail``
 re-reads the file so a DIFFERENT process (the bench embedding its
 recorder tail into a failure record) sees everything flushed so far.
+
+Rotation: a long serving run (or the chaos loop) must not grow the
+stream unboundedly, so when the file would exceed
+``PFX_RECORDER_MAX_BYTES`` (default 64 MiB) it rolls once to
+``<path>.1`` — the new file opens with a ``recorder_rotated`` event,
+and ``read_tail``/``read_events`` transparently read the rotated file
+first, so crash diagnostics still see across the roll.
 """
 
 from __future__ import annotations
@@ -21,34 +28,88 @@ import os
 import time
 from typing import Any, Dict, List, Optional
 
+#: rotation threshold when PFX_RECORDER_MAX_BYTES is unset: ~64 MiB
+_DEFAULT_MAX_BYTES = 64 * 1024 * 1024
+
+
+def _max_bytes_from_env() -> int:
+    """The rotation threshold, from ``PFX_RECORDER_MAX_BYTES`` (bytes;
+    unset/unparseable/non-positive falls back to the 64 MiB default)."""
+    raw = os.environ.get("PFX_RECORDER_MAX_BYTES", "").strip()
+    try:
+        n = int(raw)
+    except ValueError:
+        return _DEFAULT_MAX_BYTES
+    return n if n > 0 else _DEFAULT_MAX_BYTES
+
 
 class FlightRecorder:
     """Append-only JSONL event log that survives crashes: every
     ``emit`` is flushed and fsynced, so the last record is on disk
-    even if the process is SIGKILLed right after."""
+    even if the process is SIGKILLed right after. Size-capped: the
+    stream rolls once to ``<path>.1`` at ``max_bytes``."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, max_bytes: Optional[int] = None):
         self.path = path
+        self.max_bytes = int(max_bytes) if max_bytes else \
+            _max_bytes_from_env()
         self._f = None
+        self._size = 0
         try:
             parent = os.path.dirname(os.path.abspath(path))
             os.makedirs(parent, exist_ok=True)
             self._f = open(path, "a")
+            self._size = os.fstat(self._f.fileno()).st_size
         except OSError:
             pass   # telemetry must never kill the run it observes
 
+    def _write(self, record: Dict[str, Any]) -> None:
+        """Serialize + append one record durably, tracking file size."""
+        try:
+            line = json.dumps(record, default=str) + "\n"
+            self._f.write(line)
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._size += len(line)
+        except (OSError, ValueError):
+            pass
+
+    def _rotate(self) -> None:
+        """Roll the stream to ``<path>.1`` (replacing any previous
+        roll) and restart the live file with a ``recorder_rotated``
+        event, so the roll itself is on the record."""
+        old_size = self._size
+        try:
+            self._f.close()
+            os.replace(self.path, self.path + ".1")
+            self._f = open(self.path, "a")
+            self._size = 0
+        except OSError:
+            # re-open best-effort; a failed roll keeps appending to
+            # whatever file handle survives
+            try:
+                self._f = open(self.path, "a")
+                self._size = os.fstat(self._f.fileno()).st_size
+            except OSError:
+                self._f = None
+                return
+        self._write({"ts": round(time.time(), 3),
+                     "event": "recorder_rotated",
+                     "rotated_bytes": old_size,
+                     "rotated_to": self.path + ".1"})
+
     def emit(self, event: str, **fields: Any) -> None:
-        """Append one event line, durably (flush + fsync)."""
+        """Append one event line, durably (flush + fsync), rotating
+        first when the file would exceed ``max_bytes``."""
         if self._f is None:
             return
         record = {"ts": round(time.time(), 3), "event": event}
         record.update(fields)
-        try:
-            self._f.write(json.dumps(record, default=str) + "\n")
-            self._f.flush()
-            os.fsync(self._f.fileno())
-        except (OSError, ValueError):
-            pass
+        if self._size >= self.max_bytes and self._size > 0:
+            self._rotate()
+            if self._f is None:
+                return
+        self._write(record)
 
     def tail(self, n: int = 10) -> List[Dict[str, Any]]:
         return read_tail(self.path, n)
@@ -62,17 +123,17 @@ class FlightRecorder:
             self._f = None
 
 
-def read_tail(path: Optional[str], n: int = 10) -> List[Dict[str, Any]]:
-    """Last ``n`` parseable event records of ``path`` (missing or
-    malformed files yield ``[]`` — the tail decorates diagnostics, it
-    must never raise over them)."""
+def _read_lines(path: Optional[str]) -> List[str]:
     if not path:
         return []
     try:
         with open(path) as f:
-            lines = f.readlines()[-n:]
+            return f.readlines()
     except OSError:
         return []
+
+
+def _parse(lines: List[str]) -> List[Dict[str, Any]]:
     out = []
     for line in lines:
         try:
@@ -82,3 +143,25 @@ def read_tail(path: Optional[str], n: int = 10) -> List[Dict[str, Any]]:
         if isinstance(rec, dict):
             out.append(rec)
     return out
+
+
+def read_tail(path: Optional[str], n: int = 10) -> List[Dict[str, Any]]:
+    """Last ``n`` parseable event records of ``path`` (missing or
+    malformed files yield ``[]`` — the tail decorates diagnostics, it
+    must never raise over them). When the live file holds fewer than
+    ``n`` lines and a rotated ``<path>.1`` exists, the tail continues
+    across the roll."""
+    if not path:
+        return []
+    lines = _read_lines(path)
+    if len(lines) < n:
+        lines = _read_lines(path + ".1")[-(n - len(lines)):] + lines
+    return _parse(lines[-n:])
+
+
+def read_events(path: Optional[str]) -> List[Dict[str, Any]]:
+    """EVERY parseable record of the stream, rotated file first — the
+    full-timeline reader the trace exporter and tests use."""
+    if not path:
+        return []
+    return _parse(_read_lines(path + ".1") + _read_lines(path))

@@ -1,0 +1,222 @@
+"""Live telemetry endpoints: a stdlib HTTP server for /metrics & co.
+
+A serving deployment is scraped, not ssh'd into: this module runs a
+daemon-threaded ``ThreadingHTTPServer`` next to the run so Prometheus
+(or ``curl``) can read the process live. Endpoints:
+
+- ``/metrics`` — Prometheus text exposition of every attached
+  registry (``observability/export.py``);
+- ``/vars`` — the merged registry snapshot as JSON (histograms as
+  summary dicts), for humans and tests;
+- ``/healthz`` — liveness + drain state: HTTP 200 with
+  ``{"status": "ok", ...}`` normally, HTTP 503 with
+  ``{"status": "draining", ...}`` once the generation server enters
+  drain (docs/robustness.md) — the signal a load balancer needs to
+  stop routing to a preempted worker while in-flight requests finish;
+- ``/trace`` — the span records of the attached events.jsonl as
+  Perfetto/Chrome trace-event JSON.
+
+Wiring: ``PFX_METRICS_PORT`` names the port (``0`` = ephemeral, read
+it back from ``get_server().port``); when unset nothing starts and
+nothing costs. One process-wide singleton serves every component —
+the Engine and a GenerationServer in the same process attach their
+registries to the same server via :func:`start_from_env`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional
+
+from . import export
+from . import metrics as metrics_mod
+from .recorder import read_events
+
+
+class MetricsServer:
+    """One live telemetry HTTP server over attached registries.
+
+    Starts serving on construction (daemon thread — never blocks
+    process exit); ``close()`` shuts it down. The process-global
+    registry is always attached; components add their own via
+    :meth:`add_registry`.
+    """
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0",
+                 registries: Optional[List[Any]] = None,
+                 health: Optional[Callable[[], Dict[str, Any]]] = None,
+                 events_path: Optional[str] = None):
+        self._registries: List[Any] = [metrics_mod.get_registry()]
+        for reg in registries or []:
+            self.add_registry(reg)
+        self._health = health
+        self._events_path = events_path
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            """Request handler bound to the owning server."""
+
+            def do_GET(self):          # noqa: N802 (stdlib API name)
+                outer._handle(self)
+
+            def log_message(self, fmt, *args):
+                pass   # scrapes must not spam the serving log
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pfx-metrics",
+            daemon=True)
+        self._thread.start()
+
+    # -- wiring --------------------------------------------------------
+    @property
+    def port(self) -> int:
+        """The bound port (useful when constructed with port=0)."""
+        return self._httpd.server_address[1]
+
+    def url(self, path: str = "/metrics") -> str:
+        """A loopback URL for ``path`` — the curl-equivalent tests
+        and the CI smoke scrape use."""
+        return f"http://127.0.0.1:{self.port}{path}"
+
+    def add_registry(self, reg: Any) -> None:
+        """Attach another live registry to /metrics and /vars."""
+        if reg is not None and reg not in self._registries:
+            self._registries.append(reg)
+
+    def set_health(self, fn: Callable[[], Dict[str, Any]]) -> None:
+        """Install the /healthz payload provider (dict with a
+        ``status`` key; anything but ``"ok"`` answers 503)."""
+        self._health = fn
+
+    def set_events_path(self, path: str) -> None:
+        """Point /trace at an events.jsonl stream."""
+        self._events_path = path
+
+    def close(self) -> None:
+        """Stop serving and release the port. Idempotent."""
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:
+            pass
+
+    # -- request handling ----------------------------------------------
+    def _respond(self, handler, code: int, body: str,
+                 content_type: str) -> None:
+        data = body.encode("utf-8")
+        handler.send_response(code)
+        handler.send_header("Content-Type", content_type)
+        handler.send_header("Content-Length", str(len(data)))
+        handler.end_headers()
+        handler.wfile.write(data)
+
+    def _handle(self, handler) -> None:
+        path = handler.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                self._respond(
+                    handler, 200,
+                    export.prometheus_text(self._registries),
+                    "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/vars":
+                snap = export.merge_snapshots(
+                    r.snapshot() for r in self._registries)
+                self._respond(handler, 200,
+                              json.dumps(snap, default=str),
+                              "application/json")
+            elif path == "/healthz":
+                payload = self._health() if self._health is not None \
+                    else {"status": "ok"}
+                code = 200 if payload.get("status") == "ok" else 503
+                self._respond(handler, code, json.dumps(payload),
+                              "application/json")
+            elif path == "/trace":
+                if not self._events_path:
+                    self._respond(handler, 404,
+                                  '{"error": "no events stream"}',
+                                  "application/json")
+                    return
+                trace = export.chrome_trace(
+                    read_events(self._events_path))
+                self._respond(handler, 200,
+                              json.dumps(trace, default=str),
+                              "application/json")
+            else:
+                self._respond(handler, 404, '{"error": "not found"}',
+                              "application/json")
+        except Exception as exc:   # noqa: BLE001 — a scrape racing a
+            # mutating registry must answer 500, never kill the server
+            try:
+                self._respond(handler, 500,
+                              json.dumps({"error": str(exc)}),
+                              "application/json")
+            except OSError:
+                pass   # client hung up mid-answer
+
+
+#: the process-wide server (every component shares one port)
+_server: Optional[MetricsServer] = None
+_lock = threading.Lock()
+
+
+def get_server() -> Optional[MetricsServer]:
+    """The live singleton, or None when nothing started one."""
+    return _server
+
+
+def start_from_env(registry: Any = None,
+                   health: Optional[Callable[[], Dict[str, Any]]] = None,
+                   events_path: Optional[str] = None
+                   ) -> Optional[MetricsServer]:
+    """Start (or attach to) the singleton when ``PFX_METRICS_PORT``
+    is set; None (and zero cost) when it is not.
+
+    Args:
+        registry: a component registry to attach (the global one is
+            always included).
+        health: /healthz payload provider (last caller wins — in
+            practice the GenerationServer, whose drain state is the
+            payload that matters).
+        events_path: events.jsonl to serve on /trace (last caller
+            wins).
+
+    Returns:
+        The singleton server, or None (knob unset, bad port, or the
+        port is taken — telemetry never kills the run it observes).
+    """
+    raw = os.environ.get("PFX_METRICS_PORT", "").strip()
+    if not raw:
+        return None
+    try:
+        port = int(raw)
+    except ValueError:
+        return None
+    global _server
+    with _lock:
+        if _server is None:
+            try:
+                _server = MetricsServer(port=port)
+            except OSError:
+                return None
+        if registry is not None:
+            _server.add_registry(registry)
+        if health is not None:
+            _server.set_health(health)
+        if events_path is not None:
+            _server.set_events_path(events_path)
+        return _server
+
+
+def stop() -> None:
+    """Shut the singleton down (tests; long-lived runs just exit —
+    the serving thread is a daemon)."""
+    global _server
+    with _lock:
+        if _server is not None:
+            _server.close()
+            _server = None

@@ -1,0 +1,163 @@
+"""LogHistogram: fixed-memory quantiles vs the exact percentile.
+
+The histogram is the latency substrate of PR 10: serving TTFT /
+queue-wait / TPOT / tick and the engine's step time all ride it, and
+``summary()``'s pinned ``ttft_p50_ms``/``ttft_p99_ms`` fields source
+from it — so its quantile error bound (one log bucket, ~8% relative)
+and its edge cases (empty, single sample, non-finite, under/overflow)
+are pinned here against ``np.percentile`` ground truth.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from paddlefleetx_tpu.observability import metrics
+from paddlefleetx_tpu.observability.histogram import LogHistogram
+
+
+# -- quantile accuracy -------------------------------------------------
+
+
+@pytest.mark.parametrize("dist,seed", [
+    ("lognormal", 0), ("lognormal", 7), ("uniform", 1),
+    ("exponential", 2),
+])
+def test_quantiles_within_bucket_tolerance(dist, seed):
+    """p50/p90/p99 within one log bucket (ratio 10^(1/30) ≈ 8%) of
+    the exact ``np.percentile`` over the same samples."""
+    rng = np.random.default_rng(seed)
+    if dist == "lognormal":
+        xs = rng.lognormal(mean=3.0, sigma=1.0, size=5000)
+    elif dist == "uniform":
+        xs = rng.uniform(0.5, 500.0, size=5000)
+    else:
+        xs = rng.exponential(scale=40.0, size=5000)
+    h = LogHistogram()
+    for x in xs:
+        h.observe(float(x))
+    ratio = 10.0 ** (1.0 / 30.0)
+    for p in (50, 90, 99):
+        exact = float(np.percentile(xs, p))
+        got = h.percentile(p)
+        assert exact / ratio <= got <= exact * ratio, \
+            f"p{p}: {got} vs exact {exact}"
+
+
+def test_quantile_monotone_and_clamped():
+    rng = np.random.default_rng(3)
+    h = LogHistogram()
+    xs = rng.lognormal(2.0, 1.5, size=2000)
+    for x in xs:
+        h.observe(float(x))
+    qs = [h.quantile(q) for q in np.linspace(0.0, 1.0, 21)]
+    assert qs == sorted(qs)                      # monotone in q
+    assert qs[0] == pytest.approx(h.min)         # clamped to observed
+    assert qs[-1] == pytest.approx(h.max)
+    assert h.percentile(50) <= h.percentile(99)  # the summary pin
+
+
+def test_single_sample_and_exact_edges():
+    h = LogHistogram()
+    h.observe(42.0)
+    # everything clamps to the lone observation
+    for p in (0, 50, 99, 100):
+        assert h.percentile(p) == pytest.approx(42.0)
+    assert h.count == 1
+    assert h.sum == pytest.approx(42.0)
+
+
+def test_empty_and_nonfinite():
+    h = LogHistogram()
+    assert h.count == 0
+    assert h.quantile(0.5) == 0.0
+    assert h.snapshot() == {"count": 0, "sum": 0.0}
+    h.observe(float("nan"))
+    h.observe(float("inf"))
+    h.observe(float("-inf"))
+    assert h.count == 0    # non-finite samples are dropped, not binned
+
+
+def test_underflow_and_overflow_buckets():
+    h = LogHistogram(lo=1e-3, hi=1e3)
+    h.observe(0.0)          # underflow (<= lo): bucket 0
+    h.observe(-5.0)         # negative: also underflow, never lost
+    h.observe(1e9)          # overflow: clamped to the last bucket
+    assert h.count == 3
+    assert h.min == -5.0 and h.max == 1e9
+    # quantiles stay inside the observed range
+    assert -5.0 <= h.quantile(0.5) <= 1e9
+
+
+def test_fixed_memory_and_reset():
+    h = LogHistogram()
+    n_slots = len(h._counts)
+    for i in range(100_000):
+        h.observe(float(i % 977) + 0.5)
+    assert len(h._counts) == n_slots   # O(buckets) forever
+    h.reset()
+    assert h.count == 0 and h.quantile(0.9) == 0.0
+
+
+def test_cumulative_is_prometheus_shaped():
+    h = LogHistogram()
+    for x in (1.0, 2.0, 4.0, 400.0):
+        h.observe(x)
+    rows = list(h.cumulative())
+    uppers = [u for u, _ in rows]
+    cums = [c for _, c in rows]
+    assert uppers == sorted(uppers)
+    assert cums == sorted(cums)            # cumulative counts
+    assert cums[-1] == h.count
+    for x in (1.0, 2.0, 4.0, 400.0):       # every sample <= some upper
+        assert any(x <= u for u in uppers)
+
+
+# -- registry integration ----------------------------------------------
+
+
+def test_registry_observe_snapshot_reset():
+    reg = metrics.MetricsRegistry(enabled=True)
+    for v in (5.0, 10.0, 20.0):
+        reg.observe("x/lat_ms", v)
+    h = reg.histogram("x/lat_ms")
+    assert h is not None and h.count == 3
+    snap = reg.snapshot()
+    hs = snap["histograms"]["x/lat_ms"]
+    assert hs["count"] == 3
+    assert hs["sum"] == pytest.approx(35.0)
+    assert hs["p50"] <= hs["p99"]
+    reg.reset()
+    assert reg.histogram("x/lat_ms").count == 0
+
+
+def test_registry_observe_disabled_is_noop():
+    reg = metrics.MetricsRegistry(enabled=False)
+    reg.observe("x/lat_ms", 5.0)
+    assert reg.histogram("x/lat_ms") is None
+    assert reg.snapshot()["histograms"] == {}
+
+
+def test_module_level_observe_gated_on_global_enable():
+    prev = metrics.get_registry().enabled
+    try:
+        metrics.set_enabled(False)
+        metrics.observe("gate/check_ms", 1.0)
+        assert metrics.get_registry().histogram("gate/check_ms") is None
+        metrics.set_enabled(True)
+        metrics.observe("gate/check_ms", 1.0)
+        h = metrics.get_registry().histogram("gate/check_ms")
+        assert h is not None and h.count == 1
+    finally:
+        metrics.get_registry().reset()
+        metrics.set_enabled(prev)
+
+
+def test_bucket_width_matches_advertised_ratio():
+    """The docs promise ~8% relative bucket width (30 buckets per
+    decade); the bounds must actually deliver it."""
+    h = LogHistogram()
+    lower, upper = h.bounds(10)
+    assert upper / lower == pytest.approx(10.0 ** (1.0 / 30.0))
+    assert math.log10(upper / lower) * 30 == pytest.approx(1.0)

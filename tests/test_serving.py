@@ -12,6 +12,9 @@ fallback (same masking, the kernels' oracle).
 import dataclasses
 import json
 import os
+import re
+import urllib.error
+import urllib.request
 
 os.environ.setdefault("PFX_PALLAS_INTERPRET", "1")
 
@@ -28,6 +31,8 @@ from paddlefleetx_tpu.models.gpt.generation import (
     GenerationConfig, generate, left_pad_batch,
 )
 from paddlefleetx_tpu.observability import metrics
+from paddlefleetx_tpu.observability import server as obs_server
+from paddlefleetx_tpu.observability.recorder import read_events
 
 CFG = GPTConfig(vocab_size=96, hidden_size=32, num_layers=2,
                 num_attention_heads=4, max_position_embeddings=48,
@@ -1149,3 +1154,215 @@ def test_paged_drain_restart_token_exactness(paged512_model_and_params):
                for i in ids)
     srv2._alloc.check()
     assert srv2._alloc.pages_in_use == 0
+
+
+# -- request tracing ---------------------------------------------------
+
+
+def test_paged_preemption_trace_timeline(paged512_model_and_params,
+                                         tmp_path):
+    """The PR-10 acceptance pin: a preempted-and-readmitted request's
+    COMPLETE span timeline reconstructs from events.jsonl alone —
+    one trace, time-ordered, exactly one open phase at a time
+    (queue -> prefill -> decode -> queue -> prefill -> decode), one
+    first-token point, and the root close carrying the final token
+    count that matches the Completion."""
+    model, params = paged512_model_and_params
+    gen_cfg = _greedy_cfg(max_dec=10)
+    rng = np.random.default_rng(5)
+    # same geometry as the pool-exhaustion test: both slots must grow
+    # mid-decode with one spare page, so somebody gets preempted
+    pa = rng.integers(0, EOS, 250).tolist()
+    pb = rng.integers(0, EOS, 124).tolist()
+    events = tmp_path / "events.jsonl"
+    srv = GenerationServer(model, params, gen_cfg, num_slots=2,
+                           page_size=128, pool_pages=5,
+                           prefill_chunk_pages=1,
+                           events_path=str(events))
+    done = {}
+    ids = [srv.submit(pa), srv.submit(pb)]
+    _drain(srv, done)
+    assert srv.summary()["preempted"] >= 1
+
+    evs = read_events(str(events))
+    # the stream as a whole is time-ordered
+    assert [e["ts"] for e in evs] == sorted(e["ts"] for e in evs)
+
+    # every completion carries its trace id; ids are distinct
+    assert len({done[i].trace_id for i in ids}) == 2
+    pre = next(e for e in evs if e["event"] == "serving_preempt")
+    tid = pre["trace"]
+    victim = pre["request"]
+    assert done[victim].trace_id == tid
+
+    mine = [e for e in evs
+            if e.get("trace") == tid and e["event"].startswith("span")]
+    roots = [e for e in mine if e["event"] == "span_begin"
+             and e["name"] == "serving/request"]
+    assert len(roots) == 1        # preemption never re-roots the trace
+    root = roots[0]
+    assert root["prompt_len"] == len(pa if victim == ids[0] else pb)
+
+    # phase children of the root, in emission order
+    phases = [e for e in mine if e["event"] == "span_begin"
+              and e.get("parent") == root["span"]]
+    names = [e["name"] for e in phases]
+    assert names[0] == "serving/queue"
+    assert names.count("serving/queue") >= 2     # submit + requeue
+    assert names.count("serving/prefill") >= 2   # admitted twice
+    assert names.count("serving/decode") >= 1
+    assert any(e["name"] == "serving/queue" and e.get("requeued")
+               for e in phases)
+
+    # every begun span on the trace ends exactly once
+    begun = sorted(e["span"] for e in mine if e["event"] == "span_begin")
+    ends = [e for e in mine if e["event"] == "span_end"]
+    assert sorted(e["span"] for e in ends) == begun
+
+    # one open phase at a time: in file order, phase i ends before
+    # phase i+1 begins, and the root end closes the whole timeline
+    pos = {(e["event"], e["span"]): i for i, e in enumerate(evs)
+           if e["event"] in ("span_begin", "span_end")
+           and e.get("trace") == tid}
+    for a, b in zip(phases, phases[1:]):
+        assert pos[("span_end", a["span"])] < \
+            pos[("span_begin", b["span"])]
+    assert pos[("span_end", root["span"])] == max(pos.values())
+
+    # the first token fired once, despite the preemption round-trip
+    points = [e for e in mine if e["event"] == "span_point"]
+    assert [e["name"] for e in points] == ["serving/first_token"]
+    assert points[0]["ttft_ms"] > 0
+
+    root_end = next(e for e in ends if e["span"] == root["span"])
+    assert root_end["tokens"] == len(done[victim].tokens)
+    assert done[victim].finish_reason in ("eos", "length")
+
+
+def test_paged_resume_links_trace_across_restart(
+        paged512_model_and_params, tmp_path):
+    """Drain-then-restart keeps the timeline: feeding
+    ``trace_id=partial.trace_id`` back with ``resume_tokens`` makes
+    the fresh server's spans CONTINUE the original trace — two
+    request lifetimes, one trace id, resumed one marked."""
+    model, params = paged512_model_and_params
+    gen_cfg = _greedy_cfg()
+    events = tmp_path / "events.jsonl"
+    srv = GenerationServer(model, params, gen_cfg, num_slots=2,
+                           page_size=128, pool_pages=24,
+                           events_path=str(events))
+    ids = [srv.submit(p) for p in PROMPTS]
+    done = {}
+    for _ in range(3):                          # mid-flight drain
+        for c in srv.step():
+            done[c.request_id] = c
+    for c in srv.drain(max_ticks=0):
+        done[c.request_id] = c
+    partials = [c for c in done.values()
+                if c.finish_reason == "preempted"]
+    assert partials
+    assert all(c.trace_id for c in partials)
+
+    # the restarted server appends to the SAME event stream
+    srv2 = GenerationServer(model, params, gen_cfg, num_slots=2,
+                            page_size=128, pool_pages=24,
+                            events_path=str(events))
+    remap = {}
+    for c in partials:
+        remap[srv2.submit(c.prompt, resume_tokens=c.tokens,
+                          trace_id=c.trace_id)] = c
+    done2 = {}
+    _drain(srv2, done2)
+
+    evs = read_events(str(events))
+    for nid, c in remap.items():
+        assert done2[nid].trace_id == c.trace_id    # continued trace
+        roots = [e for e in evs if e["event"] == "span_begin"
+                 and e["name"] == "serving/request"
+                 and e["trace"] == c.trace_id]
+        assert len(roots) == 2          # original + resumed lifetime
+        assert roots[0]["span"] != roots[1]["span"]
+        if c.tokens:                    # mid-decode partials carry it
+            assert roots[1]["resumed"] is True
+        req_ends = [e for e in evs if e["event"] == "span_end"
+                    and e["name"] == "serving/request"
+                    and e["trace"] == c.trace_id]
+        assert len(req_ends) == 2
+        assert req_ends[1]["tokens"] == len(done2[nid].tokens)
+
+
+#: one Prometheus 0.0.4 sample line (# TYPE comments aside)
+_PROM_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_][a-zA-Z0-9_]*(\{le="[^"]+"\})? [-+0-9.einfE]+$')
+
+
+def test_serving_metrics_endpoint_smoke(paged512_model_and_params,
+                                        tmp_path, monkeypatch):
+    """CI smoke (`-k smoke`), live-export edition: PFX_METRICS_PORT=0
+    starts the HTTP server on an ephemeral port; /metrics scraped
+    MID-RUN parses as Prometheus text exposition, /healthz answers 200
+    ok and flips to 503 draining after ``drain()``, and /trace serves
+    the request spans as Chrome trace JSON. Scraped bodies land as
+    metrics_scrape_* files for CI's failure-diagnostics artifact."""
+    model, params = paged512_model_and_params
+    monkeypatch.setenv("PFX_METRICS_PORT", "0")
+    obs_server.stop()              # a fresh singleton for this test
+    events = tmp_path / "events.jsonl"
+    gen_cfg = _greedy_cfg(max_dec=6)
+
+    def get(url_path):
+        try:
+            with urllib.request.urlopen(msrv.url(url_path),
+                                        timeout=10) as resp:
+                return resp.status, resp.read().decode("utf-8")
+        except urllib.error.HTTPError as err:
+            return err.code, err.read().decode("utf-8")
+
+    try:
+        srv = GenerationServer(model, params, gen_cfg, num_slots=2,
+                               page_size=128, pool_pages=8,
+                               prefill_chunk_pages=1,
+                               events_path=str(events))
+        msrv = obs_server.get_server()
+        assert msrv is not None and msrv.port > 0
+        done = {}
+        ids = [srv.submit([3, 1, 4, 1, 5]),
+               srv.submit([2, 7, 1, 8, 2, 8])]
+        for _ in range(4):            # prefill + first decode ticks
+            for c in srv.step():
+                done[c.request_id] = c
+
+        # mid-run: the exposition must parse line by line
+        code, mbody = get("/metrics")
+        assert code == 200
+        for line in mbody.splitlines():
+            assert line.startswith("# TYPE ") or \
+                _PROM_SAMPLE_RE.match(line), \
+                f"bad exposition line: {line!r}"
+        assert "pfx_serving_ttft_ms_bucket" in mbody
+        assert 'le="+Inf"' in mbody
+        code, hbody = get("/healthz")
+        assert code == 200
+        health = json.loads(hbody)
+        assert health["status"] == "ok" and health["slots"] == 2
+        (tmp_path / "metrics_scrape_metrics.txt").write_text(mbody)
+        (tmp_path / "metrics_scrape_healthz.json").write_text(hbody)
+
+        _drain(srv, done)
+        assert set(done) == set(ids)
+        srv.drain()                   # idle drain: just the flip
+        code, hbody = get("/healthz")
+        assert code == 503
+        assert json.loads(hbody)["status"] == "draining"
+        (tmp_path / "metrics_scrape_healthz_draining.json"
+         ).write_text(hbody)
+
+        code, tbody = get("/trace")
+        assert code == 200
+        names = {e.get("name")
+                 for e in json.loads(tbody)["traceEvents"]}
+        assert "serving/request" in names
+        assert "serving/queue" in names
+    finally:
+        obs_server.stop()
+    assert obs_server.get_server() is None

@@ -1,0 +1,426 @@
+"""Tracing, rotation, exporters, and the live /metrics endpoint.
+
+Covers the PR-10 observability substrate end to end below the serving/
+engine integration level (which `tests/test_serving.py` and
+`tests/test_observability.py` pin): span record grammar and lifecycle
+through the flight recorder, size-capped recorder rotation, the
+Prometheus text exposition and Perfetto/Chrome trace renderers, and an
+HTTP round-trip against a `MetricsServer` on an ephemeral port —
+including the /healthz ok -> draining 503 flip drain relies on.
+"""
+
+import json
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddlefleetx_tpu.observability import export
+from paddlefleetx_tpu.observability import metrics
+from paddlefleetx_tpu.observability import server as obs_server
+from paddlefleetx_tpu.observability.recorder import (
+    FlightRecorder, read_events, read_tail)
+from paddlefleetx_tpu.observability.spans import NULL_SPAN, Span, Tracer
+
+_HEX16 = re.compile(r"^[0-9a-f]{16}$")
+_HEX8 = re.compile(r"^[0-9a-f]{8}$")
+
+
+def _recorded(tmp_path, name="events.jsonl"):
+    path = str(tmp_path / name)
+    return FlightRecorder(path), path
+
+
+# -- span lifecycle ----------------------------------------------------
+
+
+def test_span_lifecycle_records_full_tree(tmp_path):
+    rec, path = _recorded(tmp_path)
+    tracer = Tracer(rec)
+    assert tracer.enabled
+
+    root = tracer.start_trace("serving/request", request="r0",
+                              prompt_len=7)
+    child = root.start_span("serving/queue")
+    root.span_point("serving/first_token", ttft_ms=12.5)
+    root.complete_span("engine/compile", 0.25, step=3)
+    child.end(reason="admitted")
+    root.end(tokens=4)
+    rec.close()
+
+    evs = read_events(path)
+    by_kind = {}
+    for e in evs:
+        by_kind.setdefault(e["event"], []).append(e)
+
+    begins = by_kind["span_begin"]
+    assert [e["name"] for e in begins] == ["serving/request",
+                                          "serving/queue"]
+    troot, tchild = begins
+    # id grammar: 16-hex trace, 8-hex spans; child links to parent on
+    # the same trace
+    assert _HEX16.match(troot["trace"])
+    assert _HEX8.match(troot["span"])
+    assert tchild["trace"] == troot["trace"]
+    assert tchild["parent"] == troot["span"]
+    assert troot["request"] == "r0" and troot["prompt_len"] == 7
+
+    point = by_kind["span_point"][0]
+    assert point["name"] == "serving/first_token"
+    assert point["parent"] == troot["span"]
+    assert point["ttft_ms"] == 12.5
+
+    complete = by_kind["span"][0]
+    assert complete["name"] == "engine/compile"
+    assert complete["parent"] == troot["span"]
+    assert complete["dur_ms"] == pytest.approx(250.0)
+    assert _HEX8.match(complete["span"])
+
+    ends = {e["name"]: e for e in by_kind["span_end"]}
+    assert ends["serving/queue"]["span"] == tchild["span"]
+    assert ends["serving/queue"]["reason"] == "admitted"
+    assert ends["serving/request"]["tokens"] == 4
+    assert ends["serving/request"]["dur_ms"] >= \
+        ends["serving/queue"]["dur_ms"] >= 0.0
+    # the whole timeline is time-ordered as written
+    assert [e["ts"] for e in evs] == sorted(e["ts"] for e in evs)
+
+
+def test_span_end_is_idempotent_and_context_managed(tmp_path):
+    rec, path = _recorded(tmp_path)
+    tracer = Tracer(rec)
+    with tracer.start_trace("engine/fit") as root:
+        with root.start_span("engine/step", step=1):
+            pass
+    root.end()         # second end: must not re-emit
+    root.end(extra=1)
+    rec.close()
+    evs = read_events(path)
+    assert sum(e["event"] == "span_end" for e in evs) == 2
+
+
+def test_explicit_trace_id_links_resumed_request(tmp_path):
+    rec, path = _recorded(tmp_path)
+    tracer = Tracer(rec)
+    first = tracer.start_trace("serving/request")
+    first.end()
+    resumed = tracer.start_trace("serving/request",
+                                 trace_id=first.trace_id, resumed=True)
+    resumed.end()
+    rec.close()
+    begins = [e for e in read_events(path) if e["event"] == "span_begin"]
+    assert begins[0]["trace"] == begins[1]["trace"]
+    assert begins[1]["resumed"] is True
+    # distinct span ids: same timeline, two request lifetimes
+    assert begins[0]["span"] != begins[1]["span"]
+
+
+def test_null_tracer_costs_nothing_and_never_emits(tmp_path):
+    tracer = Tracer(None)
+    assert not tracer.enabled
+    span = tracer.start_trace("serving/request")
+    assert span is NULL_SPAN
+    assert span.start_span("serving/queue") is NULL_SPAN
+    span.span_point("serving/first_token")
+    span.complete_span("engine/compile", 1.0)
+    span.end(tokens=3)
+    with span:
+        pass
+    assert span.trace_id is None and span.span_id is None
+    assert not list(tmp_path.iterdir())   # nothing written anywhere
+
+
+def test_span_direct_construction_parent_grammar(tmp_path):
+    rec, path = _recorded(tmp_path)
+    tracer = Tracer(rec)
+    s = Span(tracer, "engine/step", trace_id="ab" * 8)
+    assert s.parent_id is None
+    c = s.start_span("engine/h2d")
+    assert c.parent_id == s.span_id
+    c.end()
+    s.end()
+    rec.close()
+    begins = [e for e in read_events(path) if e["event"] == "span_begin"]
+    assert "parent" not in begins[0]       # roots carry no parent field
+    assert begins[1]["parent"] == begins[0]["span"]
+
+
+# -- recorder rotation -------------------------------------------------
+
+
+def test_recorder_rotates_once_at_cap(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    rec = FlightRecorder(path, max_bytes=2000)
+    for i in range(200):
+        rec.emit("filler", i=i, pad="x" * 40)
+    rec.close()
+
+    rolled = tmp_path / "events.jsonl.1"
+    assert rolled.exists()
+    # only ONE roll file ever exists; the live file restarted small
+    assert not (tmp_path / "events.jsonl.2").exists()
+    # first record of the live segment after a roll is the rotation
+    # marker, carrying where the bytes went
+    first_live = _parse_file(path)[0]
+    assert first_live["event"] == "recorder_rotated"
+    assert first_live["rotated_to"] == path + ".1"
+    assert first_live["rotated_bytes"] >= 2000
+
+
+def _parse_file(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def test_rotation_aware_readers_span_the_roll(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    rec = FlightRecorder(path, max_bytes=600)
+    for i in range(40):
+        rec.emit("tick", i=i)
+    rec.close()
+
+    evs = read_events(path)
+    seen = [e["i"] for e in evs if e["event"] == "tick"]
+    # every record since the LAST roll plus the whole rolled file is
+    # readable, in order, with no duplicates
+    assert seen == sorted(set(seen))
+    assert seen[-1] == 39
+    assert any(e["event"] == "recorder_rotated" for e in evs)
+
+    # a tail bigger than the live file continues into <path>.1
+    n_live = len(_parse_file(path))
+    t = read_tail(path, n_live + 5)
+    assert len(t) == n_live + 5
+    assert t[-1]["i"] == 39
+    assert [e["ts"] for e in t] == sorted(e["ts"] for e in t)
+
+
+def test_recorder_env_knob_and_default(monkeypatch, tmp_path):
+    monkeypatch.delenv("PFX_RECORDER_MAX_BYTES", raising=False)
+    rec = FlightRecorder(str(tmp_path / "a.jsonl"))
+    assert rec.max_bytes == 64 * 1024 * 1024
+    rec.close()
+    monkeypatch.setenv("PFX_RECORDER_MAX_BYTES", "12345")
+    rec = FlightRecorder(str(tmp_path / "b.jsonl"))
+    assert rec.max_bytes == 12345
+    rec.close()
+    monkeypatch.setenv("PFX_RECORDER_MAX_BYTES", "not-a-number")
+    rec = FlightRecorder(str(tmp_path / "c.jsonl"))
+    assert rec.max_bytes == 64 * 1024 * 1024
+    rec.close()
+
+
+# -- Prometheus exposition --------------------------------------------
+
+#: one valid 0.0.4 sample line: name{labels} value
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{le="[^"]+"\})? '
+    r'[-+]?[0-9.e+-]+(inf)?$')
+
+
+def test_prometheus_text_grammar_and_content():
+    reg = metrics.MetricsRegistry(enabled=True)
+    reg.inc("serving/requests", 3)
+    reg.set_gauge("serving/occupancy", 2)
+    reg.set_gauge("serving/label", "not-a-number")   # must be skipped
+    reg.add_time("engine/step", 1.5)
+    for v in (1.0, 5.0, 9.0, 250.0):
+        reg.observe("serving/ttft_ms", v)
+
+    body = export.prometheus_text([reg])
+    assert body.endswith("\n")
+    lines = body.splitlines()
+    for line in lines:
+        assert line.startswith("# TYPE ") or _SAMPLE_RE.match(line), \
+            f"bad exposition line: {line!r}"
+
+    assert "# TYPE pfx_serving_requests_total counter" in lines
+    assert "pfx_serving_requests_total 3.0" in lines
+    assert "# TYPE pfx_serving_occupancy gauge" in lines
+    assert "pfx_serving_occupancy 2.0" in lines
+    assert "# TYPE pfx_engine_step_seconds_total counter" in lines
+    assert "pfx_engine_step_seconds_total 1.5" in lines
+    assert "# TYPE pfx_serving_ttft_ms histogram" in lines
+    assert not any("label" in ln for ln in lines)
+
+    # histogram: cumulative non-decreasing buckets, +Inf == count
+    buckets = [ln for ln in lines
+               if ln.startswith("pfx_serving_ttft_ms_bucket")]
+    cums = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert cums == sorted(cums)
+    assert buckets[-1].startswith('pfx_serving_ttft_ms_bucket{le="+Inf"}')
+    assert cums[-1] == 4
+    assert "pfx_serving_ttft_ms_count 4" in lines
+    assert "pfx_serving_ttft_ms_sum 265.0" in lines
+
+
+def test_prometheus_text_merges_registries():
+    a = metrics.MetricsRegistry(enabled=True)
+    b = metrics.MetricsRegistry(enabled=True)
+    a.inc("shared/n", 2)
+    b.inc("shared/n", 5)
+    a.set_gauge("g/x", 1)
+    b.set_gauge("g/x", 9)
+    lines = export.prometheus_text([a, b]).splitlines()
+    assert "pfx_shared_n_total 7.0" in lines   # counters sum
+    assert "pfx_g_x 9.0" in lines              # gauges last-wins
+
+
+def test_merge_snapshots_for_vars():
+    a = metrics.MetricsRegistry(enabled=True)
+    b = metrics.MetricsRegistry(enabled=True)
+    a.inc("n", 1)
+    b.inc("n", 2)
+    a.add_time("t", 0.5)
+    b.add_time("t", 0.25)
+    b.observe("h/x_ms", 3.0)
+    out = export.merge_snapshots([a.snapshot(), b.snapshot()])
+    assert out["counters"]["n"] == 3
+    assert out["timers"]["t"] == pytest.approx(0.75)
+    assert out["histograms"]["h/x_ms"]["count"] == 1
+    json.dumps(out)   # /vars must be JSON-serializable
+
+
+# -- Perfetto / Chrome trace JSON -------------------------------------
+
+
+def test_chrome_trace_shapes_and_json_validity(tmp_path):
+    rec, path = _recorded(tmp_path)
+    tracer = Tracer(rec)
+    r1 = tracer.start_trace("serving/request")
+    q = r1.start_span("serving/queue")
+    r1.span_point("serving/first_token")
+    q.end()
+    r1.complete_span("engine/compile", 0.1)
+    r1.end()
+    r2 = tracer.start_trace("serving/request")
+    r2.end()
+    rec.emit("serving_admit", request="r9")   # non-span: skipped
+    rec.close()
+
+    trace = export.chrome_trace(read_events(path))
+    blob = json.dumps(trace)                  # Perfetto-loadable JSON
+    assert json.loads(blob)["displayTimeUnit"] == "ms"
+    evs = trace["traceEvents"]
+    phases = [e["ph"] for e in evs]
+    # one thread_name metadata row per trace id => per track
+    assert phases.count("M") == 2
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert {e["args"]["name"] for e in meta} == \
+        {f"trace {r1.trace_id}", f"trace {r2.trace_id}"}
+    assert {e["tid"] for e in meta} == {1, 2}
+    # begins pair with ends; the complete span is one X with dur
+    assert phases.count("B") == phases.count("E") == 3
+    x = [e for e in evs if e["ph"] == "X"]
+    assert len(x) == 1 and x[0]["dur"] == pytest.approx(100.0 * 1e3)
+    assert x[0]["name"] == "engine/compile"
+    inst = [e for e in evs if e["ph"] == "i"]
+    assert len(inst) == 1 and inst[0]["s"] == "t"
+    # the non-span serving_admit record must not leak into the trace
+    assert all(e["name"] != "serving_admit" for e in evs)
+    # timestamps are microseconds (wall-clock seconds * 1e6)
+    b0 = next(e for e in evs if e["ph"] == "B")
+    assert b0["ts"] > 1e15
+
+
+# -- the live HTTP server ---------------------------------------------
+
+
+def _get(url):
+    """(status, content_type, body) for a GET, errors included."""
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return (resp.status, resp.headers.get("Content-Type", ""),
+                    resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as err:
+        return (err.code, err.headers.get("Content-Type", ""),
+                err.read().decode("utf-8"))
+
+
+def test_metrics_server_http_roundtrip(tmp_path):
+    # names no production code emits: the server always merges the
+    # process-global registry in, and suite-order must not matter
+    reg = metrics.MetricsRegistry(enabled=True)
+    reg.inc("tt/requests", 2)
+    reg.observe("tt/lat_ms", 7.0)
+    rec, events_path = _recorded(tmp_path)
+    Tracer(rec).start_trace("serving/request").end()
+    rec.close()
+
+    health = {"status": "ok", "slots": 4}
+    srv = obs_server.MetricsServer(
+        port=0, registries=[reg], health=lambda: dict(health),
+        events_path=events_path)
+    try:
+        assert srv.port > 0    # ephemeral port resolved
+
+        code, ctype, body = _get(srv.url("/metrics"))
+        assert code == 200 and ctype.startswith("text/plain")
+        assert "version=0.0.4" in ctype
+        assert "pfx_tt_requests_total 2.0" in body
+        assert 'pfx_tt_lat_ms_bucket{le="+Inf"} 1' in body
+
+        code, ctype, body = _get(srv.url("/vars"))
+        assert code == 200 and ctype.startswith("application/json")
+        snap = json.loads(body)
+        assert snap["counters"]["tt/requests"] == 2
+        assert snap["histograms"]["tt/lat_ms"]["count"] == 1
+
+        code, _, body = _get(srv.url("/healthz"))
+        assert code == 200 and json.loads(body)["status"] == "ok"
+        health["status"] = "draining"       # the drain() flip
+        code, _, body = _get(srv.url("/healthz"))
+        assert code == 503
+        assert json.loads(body)["status"] == "draining"
+
+        code, _, body = _get(srv.url("/trace"))
+        assert code == 200
+        trace = json.loads(body)
+        assert any(e.get("ph") == "B" for e in trace["traceEvents"])
+
+        code, _, _ = _get(srv.url("/nope"))
+        assert code == 404
+    finally:
+        srv.close()
+    srv.close()     # idempotent
+
+
+def test_metrics_server_without_events_stream(tmp_path):
+    srv = obs_server.MetricsServer(port=0)
+    try:
+        code, _, _ = _get(srv.url("/trace"))
+        assert code == 404                   # no stream attached
+        code, _, body = _get(srv.url("/healthz"))
+        assert code == 200                   # default health is ok
+        assert json.loads(body)["status"] == "ok"
+    finally:
+        srv.close()
+
+
+def test_start_from_env_gating(monkeypatch, tmp_path):
+    # unset / blank / unparseable: no server, no cost
+    monkeypatch.delenv("PFX_METRICS_PORT", raising=False)
+    assert obs_server.start_from_env() is None
+    monkeypatch.setenv("PFX_METRICS_PORT", "  ")
+    assert obs_server.start_from_env() is None
+    monkeypatch.setenv("PFX_METRICS_PORT", "http")
+    assert obs_server.start_from_env() is None
+    assert obs_server.get_server() is None
+
+    monkeypatch.setenv("PFX_METRICS_PORT", "0")
+    reg = metrics.MetricsRegistry(enabled=True)
+    reg.inc("x/y", 1)
+    try:
+        srv = obs_server.start_from_env(registry=reg)
+        assert srv is not None and srv is obs_server.get_server()
+        # second caller attaches to the SAME singleton
+        again = obs_server.start_from_env(
+            health=lambda: {"status": "ok"},
+            events_path=str(tmp_path / "e.jsonl"))
+        assert again is srv
+        code, _, body = _get(srv.url("/metrics"))
+        assert code == 200 and "pfx_x_y_total 1.0" in body
+    finally:
+        obs_server.stop()
+    assert obs_server.get_server() is None
