@@ -1110,8 +1110,23 @@ def bench_serving():
     ``PFX_BENCH_SERVING_REQUESTS`` / ``_SLOTS`` / ``_SEED`` /
     ``_MIN_PROMPT`` / ``_MAX_PROMPT`` / ``_DEC_LEN``, plus the paged
     KV-cache knobs ``PFX_BENCH_SERVING_PAGED`` / ``_PAGE_SIZE`` /
-    ``_POOL_PAGES`` and the speculative A/B knobs
-    ``PFX_BENCH_SERVING_SPEC`` / ``_SPEC_TOKENS``.
+    ``_POOL_PAGES``, the speculative A/B knobs
+    ``PFX_BENCH_SERVING_SPEC`` / ``_SPEC_TOKENS``, and the
+    device-resident-decode sweep knob
+    ``PFX_BENCH_SERVING_LOOP_TICKS`` (below).
+
+    Device-loop T-sweep: ``PFX_BENCH_SERVING_LOOP_TICKS`` (default
+    ``1,4,16``) lists the ``device_loop_ticks`` values to measure.
+    Every value above 1 serves the SAME seeded trace through the
+    fused ``decode_loop`` (core/serving.py ``device_loop_ticks=T``)
+    and emits an extra record — metric
+    ``..._decode_tokens_per_sec_per_chip_loop_t{T}`` — ahead of the
+    headline, reporting tokens/s/chip, ``tick_p99_ms``, and the
+    measured-pass ``host_roundtrips`` so the host-overhead win
+    (strictly fewer round-trips per committed token at T>1) is
+    visible without a profiler. The headline record itself is always
+    the T=1 path (``loop_ticks: 1`` rides in every serving record);
+    set the knob to ``1`` to suppress the sweep.
 
     Speculative A/B: unless ``PFX_BENCH_SERVING_SPEC=0``, the SAME
     seeded trace is served a second time with n-gram speculative
@@ -1184,19 +1199,26 @@ def bench_serving():
     spec_on = bool(int(os.environ.get("PFX_BENCH_SERVING_SPEC", "1")))
     spec_tokens = int(os.environ.get("PFX_BENCH_SERVING_SPEC_TOKENS",
                                      "4"))
+    loop_sweep = [int(x) for x in
+                  os.environ.get("PFX_BENCH_SERVING_LOOP_TICKS",
+                                 "1,4,16").split(",") if x.strip()]
     paged_kw = {}
     if paged:
         paged_kw = dict(page_size=page_size, pool_pages=pool_pages,
                         prefill_chunk_pages=2 if cap_pages % 2 == 0
                         else 1)
 
-    def _serve(cfg_x):
+    def _serve(cfg_x, loop_ticks=1):
         """Warm pass (compiles every bucket + the tick) then an
         identical measured pass on a fresh server; committed tokens/s
-        from the server's own decode-time accounting."""
+        from the server's own decode-time accounting. Returns the
+        measured pass's committed-token rate, device-tick count, and
+        host round-trip count (== ticks at T=1, strictly fewer at
+        T>1) plus the cumulative summary for its percentiles."""
         srv = GenerationServer(model, params, cfg_x,
                                num_slots=num_slots,
                                rng=jax.random.key(seed + 1),
+                               device_loop_ticks=loop_ticks,
                                **paged_kw)
         srv.run(prompts)
         warm = srv.summary()
@@ -1206,9 +1228,42 @@ def bench_serving():
         dt = total["decode_time_sec"] - warm["decode_time_sec"]
         tps = tokens / dt if dt > 0 else 0.0
         ticks = total["decode_ticks"] - warm["decode_ticks"]
-        return tps, ticks, total
+        rounds = total["host_roundtrips"] - warm["host_roundtrips"]
+        return tps, ticks, rounds, total
 
-    decode_tps, ticks, total = _serve(gen_cfg)
+    # T-sweep first so the headline (always T=1) and the spec A/B
+    # record keep their pinned last-two positions in the output.
+    for t in loop_sweep:
+        if t <= 1:
+            continue  # T=1 IS the headline record below
+        t_tps, t_ticks, t_rounds, t_total = _serve(gen_cfg,
+                                                   loop_ticks=t)
+        t_rec = {
+            "metric": METRIC_BY_MODE["serving"] + f"_loop_t{t}",
+            "value": round(t_tps, 1),
+            "unit": "tokens/s",
+            "vs_baseline": None,
+            "requests": n_requests,
+            "slots": num_slots,
+            "prompt_len_range": [min_p, max_p],
+            "max_dec_len": dec_len,
+            "seed": seed,
+            "paged": paged,
+            "page_size": page_size if paged else 0,
+            "pool_pages": pool_pages if paged else 0,
+            "loop_ticks": t,
+            "decode_ticks": t_ticks,
+            "host_roundtrips": t_rounds,
+            "tick_p99_ms": t_total.get("tick_p99_ms", 0.0),
+            "host_roundtrip_p50_ms":
+                t_total.get("host_roundtrip_p50_ms", 0.0),
+            "host_roundtrip_p99_ms":
+                t_total.get("host_roundtrip_p99_ms", 0.0),
+        }
+        _log_success(t_rec)
+        print(json.dumps(t_rec))
+
+    decode_tps, ticks, rounds, total = _serve(gen_cfg)
     common = {
         "unit": "tokens/s",
         "vs_baseline": None,  # the reference has no serving path
@@ -1225,10 +1280,16 @@ def bench_serving():
         "metric": METRIC_BY_MODE["serving"],
         "value": round(decode_tps, 1),
         **common,
+        "loop_ticks": 1,
         "decode_ticks": ticks,
+        "host_roundtrips": rounds,
         "ttft_p50_ms": total.get("ttft_p50_ms", 0.0),
         "ttft_p99_ms": total.get("ttft_p99_ms", 0.0),
         "tick_p99_ms": total.get("tick_p99_ms", 0.0),
+        "host_roundtrip_p50_ms":
+            total.get("host_roundtrip_p50_ms", 0.0),
+        "host_roundtrip_p99_ms":
+            total.get("host_roundtrip_p99_ms", 0.0),
     }
     _log_success(result)
     print(json.dumps(result))
@@ -1236,13 +1297,16 @@ def bench_serving():
         # A/B on the SAME trace: only the gen config changes
         spec_cfg = dataclasses.replace(gen_cfg, spec_method="ngram",
                                        spec_tokens=spec_tokens)
-        spec_tps, spec_ticks, spec_total = _serve(spec_cfg)
+        spec_tps, spec_ticks, spec_rounds, spec_total = \
+            _serve(spec_cfg)
         spec_result = {
             "metric": "gpt345m_serving_spec_decode_tokens_per_sec"
                       "_per_chip",
             "value": round(spec_tps, 1),
             **common,
+            "loop_ticks": 1,
             "decode_ticks": spec_ticks,
+            "host_roundtrips": spec_rounds,
             "spec_tokens": spec_tokens,
             "spec_accept_rate": spec_total.get("spec_accept_rate",
                                                0.0),

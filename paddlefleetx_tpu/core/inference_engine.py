@@ -165,9 +165,12 @@ class InferenceEngine:
         to the server (``prefill_buckets``, ``rng``, ``events_path``,
         the paged-KV knobs ``page_size`` / ``pool_pages`` /
         ``prefill_chunk_pages`` / ``prefix_sharing`` —
-        docs/inference.md, "Paged KV cache" — and the graceful-
-        degradation knobs ``request_ttl_s`` / ``max_queue_depth`` /
-        ``drain_on_sigterm`` — docs/robustness.md). With
+        docs/inference.md, "Paged KV cache" — the fused-decode knob
+        ``device_loop_ticks`` (up to T ticks per host round-trip —
+        docs/inference.md, "Device-resident decode") and the
+        graceful-degradation knobs ``request_ttl_s`` /
+        ``max_queue_depth`` / ``drain_on_sigterm`` —
+        docs/robustness.md). With
         ``events_path`` the server traces every request
         (docs/observability.md, "Request tracing"); with
         ``PFX_METRICS_PORT`` set it serves live ``/metrics`` +

@@ -50,6 +50,20 @@ page tables above are exactly the substrate this needs; pages past a
 slot's accepted point are handed straight back to the pool). Greedy
 speculative output is token-exact vs the non-speculative server.
 
+Device-resident decode (``device_loop_ticks=T``): with T > 1 every
+:meth:`GenerationServer.step` launches ONE fused
+``decode_loop``/``verify_loop`` program running up to T ticks
+on-device (``lax.while_loop`` over the same tick bodies), exiting
+early when a slot finishes or exhausts its budget, or after one tick
+when the host flagged pending scheduling work at launch — admission,
+drain, chunked prefill, or page-pool pressure. The host then replays
+the returned per-tick token buffers so committed tokens, traces, and
+histograms stay tick-accurate, paying one dispatch/fetch/schedule
+round-trip per up-to-T ticks instead of per tick — the host-overhead
+kill for latency-bound small-batch decode (docs/inference.md
+"Device-resident decode"). T=1 (the default) is byte-identical to the
+pre-loop server; any T commits the same tokens.
+
 Graceful degradation (docs/robustness.md): per-request deadlines/TTL
 (``submit(deadline_s=...)`` or a server-wide ``request_ttl_s``) evict
 expired requests with a ``deadline_exceeded`` result; a bounded queue
@@ -68,14 +82,18 @@ Telemetry (docs/observability.md): ``serving/slot_occupancy`` and
 ``serving/decode_tokens`` counters (committed tokens, NOT ticks — with
 spec decode 1 tick != 1 token), the ``serving/spec_drafted`` /
 ``serving/spec_accepted`` counters + ``serving/spec_accept_rate``
-gauge, a ``serving/decode_tick`` timer, and a tokens/s + TTFT p50/p99
+gauge, the ``serving/device_ticks`` counter and per-reason
+``serving/loop_exit/{finished,admission,budget,drain}`` counters of
+the fused loop, a ``serving/decode_tick`` timer (one timing per
+ROUND-TRIP — T ticks when fused), and a tokens/s + TTFT p50/p99
 summary;
 an optional flight recorder mirrors admissions/evictions to an
 ``events.jsonl`` stream CI's failure-diagnostics artifact collects.
 
 Latency percentiles ride fixed-memory log-bucketed histograms in a
 server-local registry (``serving/ttft_ms``, ``serving/queue_wait_ms``,
-``serving/tpot_ms``, ``serving/tick_ms`` — O(buckets) forever, no
+``serving/tpot_ms``, ``serving/tick_ms``,
+``serving/host_roundtrip_ms`` — O(buckets) forever, no
 unbounded sample lists), and with ``events_path`` set every request
 gets a TRACE: a ``serving/request`` root span with
 ``serving/queue`` → ``serving/prefill`` → ``serving/decode`` phase
@@ -102,9 +120,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.gpt.generation import (
-    GenerationConfig, _unrolled_twin, activate_slot, copy_kv_pages,
+    LOOP_EXIT_BUDGET, LOOP_EXIT_FINISHED, GenerationConfig,
+    _unrolled_twin, activate_slot, copy_kv_pages, decode_loop,
     decode_step, init_page_pool, init_slot_cache, init_slot_state,
-    prefill_chunk_paged, prefill_into_slots, verify_step,
+    prefill_chunk_paged, prefill_into_slots, verify_loop, verify_step,
 )
 from ..observability import metrics
 from ..observability import server as obs_server
@@ -178,7 +197,8 @@ class GenerationServer:
                  request_ttl_s: Optional[float] = None,
                  max_queue_depth: Optional[int] = None,
                  drain_on_sigterm: bool = False,
-                 fault_injector: Optional[FaultInjector] = None):
+                 fault_injector: Optional[FaultInjector] = None,
+                 device_loop_ticks: int = 1):
         if gen_cfg.decode_strategy == "beam_search":
             raise ValueError(
                 "GenerationServer serves sampling/greedy_search; beam "
@@ -186,6 +206,16 @@ class GenerationServer:
                 "lockstep generate() path")
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if device_loop_ticks < 1:
+            raise ValueError(
+                f"device_loop_ticks must be >= 1, got "
+                f"{device_loop_ticks}")
+        # device-resident decode: T > 1 routes step() through ONE
+        # jitted decode_loop/verify_loop launch of up to T ticks per
+        # host round-trip (docs/inference.md "Device-resident decode");
+        # T = 1 keeps the original one-tick step() path byte-for-byte
+        self._loop_ticks = int(device_loop_ticks)
+        self._roundtrips = 0
         model, params = _unrolled_twin(model, params)
         cfg = model.config
         # paged mode: explicit kwargs win, else the config's own
@@ -316,7 +346,8 @@ class GenerationServer:
                    page_size=self._page if self.paged else 0,
                    pool_pages=cfg.kv_pool_pages if self.paged else 0,
                    spec=self.spec,
-                   spec_tokens=self._spec_k if self.spec else 0)
+                   spec_tokens=self._spec_k if self.spec else 0,
+                   loop_ticks=self._loop_ticks)
         if self.paged:
             logger.info(
                 "GenerationServer (paged): %d slots, %d-page pool of "
@@ -905,7 +936,14 @@ class GenerationServer:
         tick every ACTIVE slot — one token plain, 1..k+1 committed
         tokens speculative — then evict and return whatever finished
         (deadline-expired requests included, as ``deadline_exceeded``
-        partials). While draining, admission is skipped."""
+        partials). While draining, admission is skipped.
+
+        With ``device_loop_ticks > 1`` one call runs up to that many
+        ticks in a single fused device program (:meth:`_step_loop`) —
+        same committed tokens, T× fewer host round-trips."""
+        if self._loop_ticks > 1:
+            return self._step_loop()
+        step_t0 = time.time()
         expired = self._expire_deadlines()
         if self._faults is not None:
             self._faults.fire("tick", self._ticks + 1)
@@ -978,6 +1016,8 @@ class GenerationServer:
         if self._watchdog is not None:
             self._watchdog.disarm()
         self._ticks += 1
+        self._roundtrips += 1
+        metrics.inc("serving/device_ticks")
         finished = np.asarray(self._state.finished)
         dec_count = np.asarray(self._state.dec_count)
         done: List[Completion] = []
@@ -1035,6 +1075,230 @@ class GenerationServer:
             self._emit("serving_spec", drafted=drafted,
                        accepted=accepted, committed=committed)
         reg.set_gauge("serving/slot_occupancy", self.occupancy)
+        # one round-trip's full host cost (admit + draft + dispatch +
+        # fetch + replay) — the series the T-sweep compares against
+        # tick_ms to show the amortization win
+        self._metrics.observe("serving/host_roundtrip_ms",
+                              (time.time() - step_t0) * 1000.0)
+        return expired + done
+
+    # -- device-resident decode (device_loop_ticks > 1) ---------------
+    #
+    # One step() call launches ONE fused decode_loop/verify_loop of up
+    # to T ticks; the host amortizes admission, drafting, deadline/TTL
+    # checks, page maintenance, and telemetry over the ticks it gets
+    # back. The loop exits early (ticks_run < T) when a slot finishes
+    # or runs out of budget — eviction can't wait — or when the host
+    # flagged pending scheduling work at launch, in which case exactly
+    # one tick runs and the host resumes control, so drain(max_ticks)
+    # and chunked prefill keep their one-unit-of-progress-per-step
+    # contracts.
+
+    def _loop_host_flag(self, live: List[int]) -> bool:
+        """Should the fused loop hand control back after ONE tick?
+        True while draining (drain()'s tick bound counts step calls),
+        while admission work is pending — ANY queued request: a full-T
+        launch would defer its admission, deadline/TTL expiry, and
+        shed decisions by T ticks, so queued work caps the loop at one
+        tick (the T=1 scheduling cadence) until the queue empties —
+        while a chunked prefill is unfinished (paged), or when the
+        page pool can't cover the full T-tick write window for every
+        live slot without preempting (better one short loop than an
+        avoidable preemption)."""
+        if self._draining:
+            return True
+        if self._queue:
+            return True
+        if self.paged:
+            if self._prefilling:
+                return True
+            per_tick = (self._spec_k + 1) if self.spec else 1
+            span = self._loop_ticks * per_tick
+            cap = self.model.config.cache_capacity
+            need = 0
+            for slot in live:
+                req = self._slots[slot]
+                first = req["cur_len"] // self._page
+                last = -(-min(req["cur_len"] + span, cap) // self._page)
+                for j in range(first, last):
+                    if j >= req["num_pages"] or self._alloc.refcount(
+                            int(self._pt[slot, j])) > 1:
+                        need += 1   # fresh map, or a COW split's copy
+            if need > self._alloc.free_pages:
+                return True
+        return False
+
+    def _step_loop(self) -> List[Completion]:
+        """The ``device_loop_ticks > 1`` body of :meth:`step`: one
+        fused multi-tick launch, then a per-tick replay of the
+        returned token buffers so ``serving/decode_tokens``, TTFT/TPOT
+        timestamps (interpolated across the loop's wall time),
+        ``serving/tick_ms`` and the per-tick ``serving_spec`` events
+        stay tick-accurate. Greedy/seeded output is token-exact vs the
+        T=1 path (tests/test_serving.py parity matrix)."""
+        step_t0 = time.time()
+        expired = self._expire_deadlines()
+        if self._faults is not None:
+            self._faults.fire("tick", self._ticks + 1)
+        if not self._draining:
+            self._admit()
+        reg = metrics.get_registry()
+        if self.paged:
+            self._prefill_pump()
+            reg.set_gauge("serving/pages_in_use",
+                          self._alloc.pages_in_use)
+        live = [s for s, r in enumerate(self._slots)
+                if r is not None and (not self.paged or r.get("active"))]
+        if not live:
+            reg.set_gauge("serving/slot_occupancy", self.occupancy)
+            return expired
+        T = self._loop_ticks
+        host_flag = self._loop_host_flag(live)
+        # flag up -> the loop exits after one tick, so drafting and
+        # page pre-mapping cover one tick's window only (the launch
+        # shape stays [slots, T, ...]: loop_ticks is static, the flag
+        # is traced, nothing recompiles)
+        eff_ticks = 1 if host_flag else T
+        if self._watchdog is not None:
+            self._watchdog.arm(
+                tag=f"ticks {self._ticks + 1}..{self._ticks + T}")
+        t0 = time.time()
+        with reg.timer("serving/decode_tick"):
+            if self.spec:
+                k = self._spec_k
+                drafts = np.zeros((self.num_slots, T, k), np.int32)
+                for slot in live:
+                    req = self._slots[slot]
+                    # k·T drafts per round-trip, all proposed from the
+                    # pre-loop history; tick j verifies chunk j
+                    drafts[slot, :eff_ticks] = np.asarray(
+                        self._draft.propose(
+                            req["prompt"] + req["tokens"],
+                            k * eff_ticks),
+                        np.int32).reshape(eff_ticks, k)
+                if self.paged:
+                    self._page_maintenance(window=eff_ticks * (k + 1))
+                    self._sync_pt()
+                (self._cache, self._state, window_buf, counts_buf,
+                 ticks_run, exit_code) = verify_loop(
+                    self.model, self.params, self._cache, self._state,
+                    jnp.asarray(drafts), self._rng, self.gen_cfg,
+                    jnp.int32(host_flag),
+                    self._pt_dev_dec if self.paged else None,
+                    loop_ticks=T)
+                window_np = np.asarray(window_buf)
+                counts_np = np.asarray(counts_buf)
+                n_ticks = int(ticks_run)
+            else:
+                if self.paged:
+                    self._page_maintenance(window=eff_ticks)
+                    self._sync_pt()
+                (self._cache, self._state, tokens_buf, ticks_run,
+                 exit_code) = decode_loop(
+                    self.model, self.params, self._cache, self._state,
+                    self._rng, self.gen_cfg, jnp.int32(host_flag),
+                    self._pt_dev_dec if self.paged else None,
+                    loop_ticks=T)
+                # device sync inside the timer, like the T=1 path
+                window_np = np.asarray(tokens_buf)[:, :, None]
+                n_ticks = int(ticks_run)
+                counts_np = np.zeros((self.num_slots, T), np.int32)
+                counts_np[:, :n_ticks] = 1
+            exit_code = int(exit_code)
+        loop_s = time.time() - t0
+        self._tick_time += loop_s
+        per_tick_s = loop_s / n_ticks
+        for _ in range(n_ticks):
+            self._metrics.observe("serving/tick_ms",
+                                  per_tick_s * 1000.0)
+        if self._watchdog is not None:
+            self._watchdog.disarm()
+        self._ticks += n_ticks
+        self._roundtrips += 1
+        metrics.inc("serving/device_ticks", n_ticks)
+        metrics.inc(
+            "serving/loop_exit/finished"
+            if exit_code == LOOP_EXIT_FINISHED
+            else "serving/loop_exit/budget"
+            if exit_code == LOOP_EXIT_BUDGET
+            else ("serving/loop_exit/drain" if self._draining
+                  else "serving/loop_exit/admission"))
+        finished = np.asarray(self._state.finished)
+        dec_count = np.asarray(self._state.dec_count)
+        done: List[Completion] = []
+        committed = 0
+        for j in range(n_ticks):
+            # the loop is one opaque device program; per-tick
+            # timestamps interpolate its wall time so TTFT/TPOT stay
+            # comparable with the T=1 histograms
+            t_j = t0 + (j + 1) * per_tick_s
+            tick_committed = 0
+            ticked = 0
+            for slot in live:
+                req = self._slots[slot]
+                if req is None or \
+                        (self.paged and not req.get("active")):
+                    # preempted out from under the launch by page
+                    # pre-mapping (pool exhaustion) — nothing committed
+                    continue
+                ticked += 1
+                m = int(counts_np[slot, j])
+                req["tokens"].extend(
+                    int(t) for t in window_np[slot, j, :m])
+                if "ttft" not in req:
+                    req["ttft"] = t_j - req["submit_t"]
+                    req["first_tok_t"] = t_j
+                    self._metrics.observe("serving/ttft_ms",
+                                          req["ttft"] * 1000.0)
+                    req["span"].span_point(
+                        "serving/first_token",
+                        ttft_ms=round(req["ttft"] * 1000.0, 3))
+                tick_committed += m
+            committed += tick_committed
+            self._decode_tokens += tick_committed
+            if self.spec and ticked:
+                drafted = self._spec_k * ticked
+                accepted = tick_committed - ticked
+                self._spec_drafted += drafted
+                self._spec_accepted += accepted
+                metrics.inc("serving/spec_drafted", drafted)
+                metrics.inc("serving/spec_accepted", accepted)
+                self._emit("serving_spec", drafted=drafted,
+                           accepted=accepted,
+                           committed=tick_committed)
+        metrics.inc("serving/decode_tokens", committed)
+        if self.spec:
+            reg.set_gauge(
+                "serving/spec_accept_rate",
+                self._spec_accepted / max(self._spec_drafted, 1))
+        if self.paged:
+            # advance each slot past its committed tokens and hand
+            # pages wholly past that point back to the pool — both the
+            # pre-mapped-but-unused tail of an early exit and spec's
+            # rejected-KV rollback
+            for slot in live:
+                req = self._slots[slot]
+                if req is None or not req.get("active"):
+                    continue
+                req["cur_len"] += int(counts_np[slot, :n_ticks].sum())
+                used = -(-req["cur_len"] // self._page)
+                if used < req["num_pages"]:
+                    for j in range(used, req["num_pages"]):
+                        self._alloc.release(int(self._pt[slot, j]))
+                        self._pt[slot, j] = NULL_PAGE
+                    req["num_pages"] = used
+                    self._pt_dirty = True
+        for slot in live:
+            req = self._slots[slot]
+            if req is None or (self.paged and not req.get("active")):
+                continue
+            if finished[slot]:
+                done.append(self._evict(slot, "eos"))
+            elif dec_count[slot] >= self.gen_cfg.max_dec_len:
+                done.append(self._evict(slot, "length"))
+        reg.set_gauge("serving/slot_occupancy", self.occupancy)
+        self._metrics.observe("serving/host_roundtrip_ms",
+                              (time.time() - step_t0) * 1000.0)
         return expired + done
 
     def drain(self, max_ticks: Optional[int] = None
@@ -1123,13 +1387,21 @@ class GenerationServer:
              "pending": self.pending, "decode_ticks": self._ticks,
              "decode_tokens": self._decode_tokens,
              "decode_time_sec": round(self._tick_time, 4),
-             "tokens_per_sec": round(tps, 2), **self._counts}
+             "tokens_per_sec": round(tps, 2),
+             # the host-overhead line: device ticks vs host
+             # round-trips — equal at T=1, ticks/roundtrips ≈ T when
+             # the fused loop is winning (docs/inference.md)
+             "device_loop_ticks": self._loop_ticks,
+             "device_ticks": self._ticks,
+             "host_roundtrips": self._roundtrips, **self._counts}
         # percentiles from the fixed-memory histograms — field names
         # ttft_p50_ms/ttft_p99_ms are a pinned contract
         for prefix, series in (("ttft", "serving/ttft_ms"),
                                ("queue_wait", "serving/queue_wait_ms"),
                                ("tpot", "serving/tpot_ms"),
-                               ("tick", "serving/tick_ms")):
+                               ("tick", "serving/tick_ms"),
+                               ("host_roundtrip",
+                                "serving/host_roundtrip_ms")):
             h = self._metrics.histogram(series)
             if h is not None and h.count:
                 s[f"{prefix}_p50_ms"] = round(h.percentile(50), 3)

@@ -28,7 +28,15 @@ class DraftSource(Protocol):
         """Return exactly ``k`` guesses for the tokens following
         ``history`` PLUS the one token the verify tick samples itself
         (i.e. guesses for positions ``len(history) + 2 ..``, given that
-        position ``len(history) + 1`` is sampled, not drafted)."""
+        position ``len(history) + 1`` is sampled, not drafted).
+
+        ``k`` is not always ``gen_cfg.spec_tokens``: the fused
+        multi-tick server (``device_loop_ticks=T`` — docs/inference.md,
+        "Device-resident decode") proposes ``spec_tokens * T`` in ONE
+        call and verifies chunk ``j`` on device tick ``j``, so later
+        chunks guess past tokens the source never saw committed. A
+        source only needs to return ``k`` in-vocab ids; staleness
+        costs accept rate, never correctness."""
         ...
 
 

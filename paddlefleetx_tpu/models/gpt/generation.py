@@ -635,26 +635,14 @@ def prefill_into_slots(model, params, cache, state: SlotState,
     return cache, state
 
 
-@partial(jax.jit, static_argnames=("model", "gen_cfg"))
-def decode_step(model, params, cache, state: SlotState,
-                rng: jax.Array, gen_cfg: GenerationConfig,
-                page_table=None):
-    """One shared decode tick over the whole slot batch.
-
-    Mirrors the lockstep ``body`` of :func:`generate` slot-for-slot —
-    sample from ``last_logits`` through the same processor pipeline
-    (repetition penalty over ``appeared``, min-length over the
-    PER-SLOT ``dec_count``), then advance the model one token with
-    per-slot cache writes and ragged attention (``cache_lengths``).
-    Greedy decoding therefore reproduces ``generate()`` exactly,
-    whatever mix of lengths/admission times the slots hold. Inactive
-    (free) slots ride along as pad tokens with frozen lengths; their
-    writes land at their stale position and are overwritten before any
-    later read (prefill rewrites the full row at admission).
-
-    Returns ``(cache, state, tokens)`` — ``tokens [slots]`` is what
-    each slot emitted this tick (pad for finished/inactive slots).
-    """
+def _decode_tick_impl(model, params, cache, state: SlotState,
+                      rng: jax.Array, gen_cfg: GenerationConfig,
+                      page_table=None):
+    """Trace-level body of one plain decode tick — the SHARED step
+    function of the standalone :func:`decode_step` jit and the fused
+    :func:`decode_loop` ``lax.while_loop``; both paths trace exactly
+    this code, so the loop at any T commits the same tokens the
+    one-tick-per-round-trip server does."""
     slots = state.lengths.shape[0]
     logits = repetition_penalty_processor(
         state.last_logits, state.appeared, gen_cfg.repetition_penalty)
@@ -711,6 +699,30 @@ def decode_step(model, params, cache, state: SlotState,
     return cache, new_state, token
 
 
+@partial(jax.jit, static_argnames=("model", "gen_cfg"))
+def decode_step(model, params, cache, state: SlotState,
+                rng: jax.Array, gen_cfg: GenerationConfig,
+                page_table=None):
+    """One shared decode tick over the whole slot batch.
+
+    Mirrors the lockstep ``body`` of :func:`generate` slot-for-slot —
+    sample from ``last_logits`` through the same processor pipeline
+    (repetition penalty over ``appeared``, min-length over the
+    PER-SLOT ``dec_count``), then advance the model one token with
+    per-slot cache writes and ragged attention (``cache_lengths``).
+    Greedy decoding therefore reproduces ``generate()`` exactly,
+    whatever mix of lengths/admission times the slots hold. Inactive
+    (free) slots ride along as pad tokens with frozen lengths; their
+    writes land at their stale position and are overwritten before any
+    later read (prefill rewrites the full row at admission).
+
+    Returns ``(cache, state, tokens)`` — ``tokens [slots]`` is what
+    each slot emitted this tick (pad for finished/inactive slots).
+    """
+    return _decode_tick_impl(model, params, cache, state, rng,
+                             gen_cfg, page_table)
+
+
 #: fold_in salt separating a verify tick's ACCEPT uniform at request
 #: step c+j from the categorical the NEXT tick draws at the same step
 #: when that draft is rejected (the correction token) — without it the
@@ -719,51 +731,13 @@ def decode_step(model, params, cache, state: SlotState,
 SPEC_ACCEPT_SALT = 7919
 
 
-@partial(jax.jit, static_argnames=("model", "gen_cfg"))
-def verify_step(model, params, cache, state: SlotState,
-                drafts: jax.Array, rng: jax.Array,
-                gen_cfg: GenerationConfig, page_table=None):
-    """One SPECULATIVE tick: score ``k`` drafted tokens per slot in a
-    single forward and commit the accepted prefix (+1 sampled token).
-
-    ``drafts [slots, k]`` are the host draft source's guesses for each
-    request's NEXT k tokens AFTER the one this tick samples
-    (``core/spec.py``; draft content only affects throughput, never
-    output). The tick:
-
-    1. samples ``t0`` from ``last_logits`` through exactly
-       :func:`decode_step`'s processor/sampling pipeline (same
-       ``(nonce, dec_count)`` key fold — the spec-off stream), with
-       the previous tick's ``rejected`` draft masked out post-filter
-       (the rejection-sampling residual);
-    2. runs the model ONCE over the ``[slots, k+1]`` window
-       ``[t0, d_1..d_k]`` at positions ``lengths .. lengths + k``
-       (ragged multi-token cache writes + the within-window causal
-       verify mask — ``flash_decode_ragged``/``flash_decode_paged``
-       or the XLA fallback, docs/inference.md);
-    3. walks the drafts left to right: draft ``d_j`` is committed iff
-       every earlier window token committed, none of them was EOS,
-       the per-request budget allows it (``dec_count + j <
-       max_dec_len`` — the sequential server would have evicted), and
-       it passes the accept test — greedy: ``d_j`` equals the argmax
-       of the processed logits at its position (teacher-forced logits
-       are the sequential logits, so greedy output is token-exact
-       spec-off); sampling: a salted per-step uniform under the
-       draft's model probability (deterministic draft proposal ⇒ the
-       standard rejection rule accepts with prob ``p(d_j)`` and the
-       residual excludes ``d_j``, recorded in ``rejected`` for the
-       next tick).
-
-    Rejected KV needs no device-side undo: lengths only advance by the
-    committed count, so the next window overwrites the stale columns
-    before any masked read reaches them (paged: the server frees/nulls
-    pages past the accepted point).
-
-    Returns ``(cache, state, window, counts)`` — ``window [slots,
-    k+1]`` holds the tick's token run (entry 0 = ``t0``), ``counts
-    [slots]`` how many of them committed (1..k+1; the host appends
-    ``window[slot, :counts[slot]]``).
-    """
+def _verify_tick_impl(model, params, cache, state: SlotState,
+                      drafts: jax.Array, rng: jax.Array,
+                      gen_cfg: GenerationConfig, page_table=None):
+    """Trace-level body of one speculative verify tick — the SHARED
+    step function of the standalone :func:`verify_step` jit and the
+    fused :func:`verify_loop`; see :func:`verify_step` for the full
+    commit semantics."""
     slots, k = drafts.shape
     vocab = model.config.vocab_size
     eos, pad = gen_cfg.eos_token_id, gen_cfg.pad_token_id
@@ -871,6 +845,224 @@ def verify_step(model, params, cache, state: SlotState,
             logits_w, (counts - 1)[:, None, None], axis=1)[:, 0],
         rejected=rejected_new)
     return cache, new_state, window, counts
+
+
+@partial(jax.jit, static_argnames=("model", "gen_cfg"))
+def verify_step(model, params, cache, state: SlotState,
+                drafts: jax.Array, rng: jax.Array,
+                gen_cfg: GenerationConfig, page_table=None):
+    """One SPECULATIVE tick: score ``k`` drafted tokens per slot in a
+    single forward and commit the accepted prefix (+1 sampled token).
+
+    ``drafts [slots, k]`` are the host draft source's guesses for each
+    request's NEXT k tokens AFTER the one this tick samples
+    (``core/spec.py``; draft content only affects throughput, never
+    output). The tick:
+
+    1. samples ``t0`` from ``last_logits`` through exactly
+       :func:`decode_step`'s processor/sampling pipeline (same
+       ``(nonce, dec_count)`` key fold — the spec-off stream), with
+       the previous tick's ``rejected`` draft masked out post-filter
+       (the rejection-sampling residual);
+    2. runs the model ONCE over the ``[slots, k+1]`` window
+       ``[t0, d_1..d_k]`` at positions ``lengths .. lengths + k``
+       (ragged multi-token cache writes + the within-window causal
+       verify mask — ``flash_decode_ragged``/``flash_decode_paged``
+       or the XLA fallback, docs/inference.md);
+    3. walks the drafts left to right: draft ``d_j`` is committed iff
+       every earlier window token committed, none of them was EOS,
+       the per-request budget allows it (``dec_count + j <
+       max_dec_len`` — the sequential server would have evicted), and
+       it passes the accept test — greedy: ``d_j`` equals the argmax
+       of the processed logits at its position (teacher-forced logits
+       are the sequential logits, so greedy output is token-exact
+       spec-off); sampling: a salted per-step uniform under the
+       draft's model probability (deterministic draft proposal ⇒ the
+       standard rejection rule accepts with prob ``p(d_j)`` and the
+       residual excludes ``d_j``, recorded in ``rejected`` for the
+       next tick).
+
+    Rejected KV needs no device-side undo: lengths only advance by the
+    committed count, so the next window overwrites the stale columns
+    before any masked read reaches them (paged: the server frees/nulls
+    pages past the accepted point).
+
+    Returns ``(cache, state, window, counts)`` — ``window [slots,
+    k+1]`` holds the tick's token run (entry 0 = ``t0``), ``counts
+    [slots]`` how many of them committed (1..k+1; the host appends
+    ``window[slot, :counts[slot]]``).
+    """
+    return _verify_tick_impl(model, params, cache, state, drafts,
+                             rng, gen_cfg, page_table)
+
+
+# -- device-resident decode: T ticks per host round-trip ---------------
+#
+# decode_step/verify_step return control to Python after every tick, so
+# small-batch decode pays host->device dispatch, result fetch, and host
+# scheduling per committed token group — the latency-bound (not
+# FLOP-bound) regime. The fused loops below wrap the SAME tick bodies
+# (_decode_tick_impl/_verify_tick_impl) in a lax.while_loop that runs
+# up to `loop_ticks` ticks on-device, buffering each tick's committed
+# tokens in a [slots, T]-shaped ring the host replays afterwards, and
+# exits early the moment host scheduling actually has work to do:
+# any active slot finished (eviction pending), any slot's decode budget
+# expired, or the host flagged pending work (admission / drain /
+# preemption risk) at launch. Exit reasons are reported so the server
+# can count serving/loop_exit/{finished,admission,budget,drain}
+# (docs/inference.md "Device-resident decode").
+
+#: a slot emitted EOS — the host must evict before the next tick
+LOOP_EXIT_FINISHED = 1
+#: a slot's decode budget expired (dec_count hit max_dec_len), or the
+#: loop ran its full `loop_ticks` tick budget with nothing else to do
+LOOP_EXIT_BUDGET = 2
+#: the host-signaled flag was set at launch (pending admission, drain,
+#: or page-pool preemption risk) — the loop ran exactly one tick
+LOOP_EXIT_HOST = 3
+
+
+def _ring_write(buf: jax.Array, vals: jax.Array, tick: jax.Array,
+                loop_ticks: int) -> jax.Array:
+    """Write one tick's row block into the per-tick ring buffer at
+    position ``tick % loop_ticks`` along axis 1 (``buf`` is
+    ``[slots, T]`` or ``[slots, T, k+1]``; ``vals`` drops the T axis).
+    The fused loops never wrap (they run at most ``loop_ticks`` ticks
+    per launch), but the modulo keeps the helper total for any tick
+    counter a caller carries across launches."""
+    return jax.lax.dynamic_update_index_in_dim(
+        buf, vals, jnp.mod(tick, loop_ticks), axis=1)
+
+
+def _loop_exit_flags(state: SlotState, gen_cfg: GenerationConfig):
+    """``(fin_any, bud_any)`` — does any ACTIVE slot need host
+    attention: emitted EOS (eviction), or decode budget spent
+    (``dec_count >= max_dec_len``, the server's length eviction)."""
+    fin_any = jnp.any(state.active & state.finished)
+    bud_any = jnp.any(state.active & ~state.finished &
+                      (state.dec_count >= gen_cfg.max_dec_len))
+    return fin_any, bud_any
+
+
+def _loop_exit_reason(state: SlotState, gen_cfg: GenerationConfig,
+                      host_flag: jax.Array) -> jax.Array:
+    """Why the fused loop stopped, by priority: a finished slot beats
+    a spent budget beats the host flag; a full-T run with none of the
+    above reads as the tick budget expiring (LOOP_EXIT_BUDGET)."""
+    fin_any, bud_any = _loop_exit_flags(state, gen_cfg)
+    return jnp.where(
+        fin_any, LOOP_EXIT_FINISHED,
+        jnp.where(bud_any, LOOP_EXIT_BUDGET,
+                  jnp.where(host_flag != 0, LOOP_EXIT_HOST,
+                            LOOP_EXIT_BUDGET))).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("model", "gen_cfg", "loop_ticks"))
+def decode_loop(model, params, cache, state: SlotState,
+                rng: jax.Array, gen_cfg: GenerationConfig,
+                host_flag: jax.Array, page_table=None, *,
+                loop_ticks: int = 1):
+    """Up to ``loop_ticks`` plain decode ticks in ONE device program.
+
+    Each iteration runs exactly :func:`decode_step`'s tick body, so
+    the committed token stream is identical to ``loop_ticks``
+    sequential ``decode_step`` calls (the T=1/T>1 parity pin in
+    tests/test_serving.py). The ``lax.while_loop`` always executes at
+    least one tick, then keeps going while ticks remain AND no exit
+    condition holds: an active slot finished, a slot's budget expired,
+    or ``host_flag`` (a traced int32 scalar — nonzero means the host
+    has pending admission/drain/preemption work and wants control back
+    after one tick; traced so flag flips never recompile).
+
+    Returns ``(cache, state, tokens_buf, ticks_run, exit_reason)`` —
+    ``tokens_buf [slots, loop_ticks]`` holds tick ``j``'s emitted
+    token per slot in column ``j`` (pad beyond ``ticks_run``),
+    ``ticks_run`` int32 how many ticks executed (1..loop_ticks), and
+    ``exit_reason`` one of the ``LOOP_EXIT_*`` codes.
+    """
+    if loop_ticks < 1:
+        raise ValueError(f"loop_ticks must be >= 1, got {loop_ticks}")
+    slots = state.lengths.shape[0]
+    tokens_buf = jnp.full((slots, loop_ticks), gen_cfg.pad_token_id,
+                          jnp.int32)
+    host_flag = jnp.asarray(host_flag, jnp.int32)
+
+    def cond(carry):
+        _, st, _, tick = carry
+        fin_any, bud_any = _loop_exit_flags(st, gen_cfg)
+        return (tick == 0) | ((tick < loop_ticks) & ~fin_any &
+                              ~bud_any & (host_flag == 0))
+
+    def body(carry):
+        cache, st, buf, tick = carry
+        cache, st, tok = _decode_tick_impl(
+            model, params, cache, st, rng, gen_cfg, page_table)
+        buf = _ring_write(buf, tok, tick, loop_ticks)
+        return cache, st, buf, tick + 1
+
+    cache, state, tokens_buf, ticks = jax.lax.while_loop(
+        cond, body, (cache, state, tokens_buf, jnp.int32(0)))
+    return (cache, state, tokens_buf, ticks,
+            _loop_exit_reason(state, gen_cfg, host_flag))
+
+
+@partial(jax.jit, static_argnames=("model", "gen_cfg", "loop_ticks"))
+def verify_loop(model, params, cache, state: SlotState,
+                drafts: jax.Array, rng: jax.Array,
+                gen_cfg: GenerationConfig, host_flag: jax.Array,
+                page_table=None, *, loop_ticks: int = 1):
+    """Up to ``loop_ticks`` speculative verify ticks in ONE device
+    program — the spec twin of :func:`decode_loop`.
+
+    ``drafts [slots, loop_ticks, k]`` carries k·T host-proposed draft
+    tokens per slot per round-trip; tick ``j`` verifies slice
+    ``drafts[:, j]`` through exactly :func:`verify_step`'s tick body.
+    Drafts for every tick are proposed from the PRE-loop history (the
+    host cannot see mid-loop commits), which never affects correctness
+    — acceptance re-scores every draft against the model — only the
+    accept rate; greedy output stays token-exact vs spec-off at any T.
+    Exit conditions and the ``host_flag`` contract match
+    :func:`decode_loop`.
+
+    Returns ``(cache, state, window_buf, counts_buf, ticks_run,
+    exit_reason)`` — tick ``j``'s token run is
+    ``window_buf[:, j] [slots, k+1]`` of which
+    ``counts_buf[:, j]`` committed per slot (0 beyond ``ticks_run``).
+    """
+    if loop_ticks < 1:
+        raise ValueError(f"loop_ticks must be >= 1, got {loop_ticks}")
+    slots, t_axis, k = drafts.shape
+    if t_axis != loop_ticks:
+        raise ValueError(
+            f"drafts tick axis ({t_axis}) != loop_ticks "
+            f"({loop_ticks})")
+    window_buf = jnp.full((slots, loop_ticks, k + 1),
+                          gen_cfg.pad_token_id, jnp.int32)
+    counts_buf = jnp.zeros((slots, loop_ticks), jnp.int32)
+    host_flag = jnp.asarray(host_flag, jnp.int32)
+    drafts = jnp.asarray(drafts, jnp.int32)
+
+    def cond(carry):
+        _, st, _, _, tick = carry
+        fin_any, bud_any = _loop_exit_flags(st, gen_cfg)
+        return (tick == 0) | ((tick < loop_ticks) & ~fin_any &
+                              ~bud_any & (host_flag == 0))
+
+    def body(carry):
+        cache, st, wbuf, cbuf, tick = carry
+        d = jax.lax.dynamic_index_in_dim(
+            drafts, jnp.mod(tick, loop_ticks), axis=1, keepdims=False)
+        cache, st, window, counts = _verify_tick_impl(
+            model, params, cache, st, d, rng, gen_cfg, page_table)
+        wbuf = _ring_write(wbuf, window, tick, loop_ticks)
+        cbuf = _ring_write(cbuf, counts, tick, loop_ticks)
+        return cache, st, wbuf, cbuf, tick + 1
+
+    cache, state, window_buf, counts_buf, ticks = jax.lax.while_loop(
+        cond, body,
+        (cache, state, window_buf, counts_buf, jnp.int32(0)))
+    return (cache, state, window_buf, counts_buf, ticks,
+            _loop_exit_reason(state, gen_cfg, host_flag))
 
 
 # -- paged KV primitives (core/paging.py owns the host bookkeeping) ----

@@ -582,16 +582,34 @@ def test_bench_moe_runs_offline(capsys):
     assert rec["mfu_active_flops"] is None
 
 
-def test_bench_serving_runs_offline(capsys):
+def test_bench_serving_runs_offline(monkeypatch, capsys):
     """The continuous-batching bench's tiny CPU path must execute end
-    to end and emit TWO records on the same seeded trace — the plain
-    decode-tokens/s headline and the speculative A/B companion — with
-    the pinned metric grammar (same record shapes the on-chip 345M
-    run emits)."""
+    to end and emit the pinned record sequence on the same seeded
+    trace — device-loop sweep records first, then the plain
+    decode-tokens/s headline, then the speculative A/B companion —
+    with the pinned metric grammar (same record shapes the on-chip
+    345M run emits). The sweep is trimmed to T=4 here for CI time;
+    the default knob value is ``1,4,16``."""
+    monkeypatch.setenv("PFX_BENCH_SERVING_LOOP_TICKS", "1,4")
     bench.bench_serving()
     lines = capsys.readouterr().out.strip().splitlines()
     recs = [json.loads(ln) for ln in lines if ln.startswith("{")]
     rec, spec = recs[-2], recs[-1]
+    # the T=4 device-loop record rides AHEAD of the headline: same
+    # committed trace (sampling is T-invariant by construction), same
+    # tick count, strictly fewer host round-trips per committed token
+    t4 = recs[-3]
+    assert t4["metric"] == \
+        "gpt345m_serving_decode_tokens_per_sec_per_chip_loop_t4"
+    assert t4["loop_ticks"] == 4 and t4["value"] > 0
+    assert t4["decode_ticks"] == rec["decode_ticks"]
+    assert t4["host_roundtrips"] < rec["host_roundtrips"]
+    assert t4["tick_p99_ms"] > 0
+    assert t4["host_roundtrip_p99_ms"] >= t4["host_roundtrip_p50_ms"]
+    # at T=1 every device tick is its own round-trip
+    assert rec["loop_ticks"] == 1
+    assert rec["host_roundtrips"] == rec["decode_ticks"]
+    assert rec["host_roundtrip_p50_ms"] > 0
     assert rec["metric"] == bench.METRIC_BY_MODE["serving"]
     assert rec["metric"] == \
         "gpt345m_serving_decode_tokens_per_sec_per_chip"
@@ -627,6 +645,7 @@ def test_bench_serving_runs_offline(capsys):
 def test_bench_serving_spec_knobs(monkeypatch, capsys):
     """PFX_BENCH_SERVING_SPEC=0 suppresses the A/B record entirely;
     _SPEC_TOKENS overrides the draft width and is echoed back."""
+    monkeypatch.setenv("PFX_BENCH_SERVING_LOOP_TICKS", "1")
     monkeypatch.setenv("PFX_BENCH_SERVING_REQUESTS", "3")
     monkeypatch.setenv("PFX_BENCH_SERVING_MAX_PROMPT", "8")
     monkeypatch.setenv("PFX_BENCH_SERVING_DEC_LEN", "4")
@@ -649,6 +668,7 @@ def test_bench_serving_paged_knob_off(monkeypatch, capsys):
     """PFX_BENCH_SERVING_PAGED=0 falls back to the PR-5 contiguous
     per-slot cache and the record says so (page fields zeroed), so
     perf CI can A/B the two layouts on the identical trace."""
+    monkeypatch.setenv("PFX_BENCH_SERVING_LOOP_TICKS", "1")
     monkeypatch.setenv("PFX_BENCH_SERVING_PAGED", "0")
     monkeypatch.setenv("PFX_BENCH_SERVING_SPEC", "0")
     monkeypatch.setenv("PFX_BENCH_SERVING_REQUESTS", "3")
@@ -666,6 +686,7 @@ def test_bench_serving_env_knobs_pin_trace(monkeypatch, capsys):
     """PFX_BENCH_SERVING_* knobs override the trace shape and are
     echoed back in the record (the perf-CI driver pins runs by these;
     mirrors the bench_moe PFX_BENCH_MOE_DISPATCH convention)."""
+    monkeypatch.setenv("PFX_BENCH_SERVING_LOOP_TICKS", "1")
     monkeypatch.setenv("PFX_BENCH_SERVING_REQUESTS", "3")
     monkeypatch.setenv("PFX_BENCH_SERVING_SLOTS", "1")
     monkeypatch.setenv("PFX_BENCH_SERVING_SEED", "7")

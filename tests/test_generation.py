@@ -730,3 +730,90 @@ def test_beam_search_tp4_matches_single_device(model_and_params):
         dist = np.asarray(generate(model, params_s, prompt, None,
                                    jax.random.key(2), gen_cfg))
     np.testing.assert_array_equal(dist, single)
+
+
+# -- device-resident decode loop units (fused multi-tick serving) ------
+
+
+def test_ring_write_wraparound():
+    """_ring_write lands tick j's values in column j % T — the fused
+    loops never wrap in one launch, but the helper must stay total for
+    any tick counter a caller carries across launches."""
+    from paddlefleetx_tpu.models.gpt.generation import _ring_write
+    buf = jnp.full((2, 3), -1, jnp.int32)
+    for tick in range(7):                 # 7 writes into T=3: wraps 2x
+        vals = jnp.full((2,), tick, jnp.int32)
+        buf = _ring_write(buf, vals, jnp.int32(tick), 3)
+    # col j holds the LAST tick congruent to j mod 3: [6, 4, 5]
+    np.testing.assert_array_equal(
+        np.asarray(buf), [[6, 4, 5], [6, 4, 5]])
+    # rank-3 buffers (the verify window [slots, T, k+1]) wrap the same
+    wbuf = jnp.zeros((2, 3, 4), jnp.int32)
+    wbuf = _ring_write(wbuf, jnp.ones((2, 4), jnp.int32),
+                       jnp.int32(5), 3)
+    assert np.asarray(wbuf)[:, 2].tolist() == [[1] * 4] * 2
+    assert np.asarray(wbuf)[:, :2].sum() == 0
+
+
+def test_loop_exit_reason_units():
+    """The exit-reason priority chain on hand-built SlotStates:
+    finished beats budget beats host flag; inactive slots never trip
+    an exit; with nothing pending a full-T run reads as BUDGET."""
+    from paddlefleetx_tpu.models.gpt.generation import (
+        LOOP_EXIT_BUDGET, LOOP_EXIT_FINISHED, LOOP_EXIT_HOST,
+        _loop_exit_flags, _loop_exit_reason, init_slot_state,
+    )
+    gen_cfg = GenerationConfig(max_dec_len=4, eos_token_id=EOS,
+                               pad_token_id=PAD)
+    on = jnp.asarray([True, True])
+    base = init_slot_state(2, CFG.vocab_size)._replace(active=on)
+    z, h = jnp.int32(0), jnp.int32(1)
+
+    fin = base._replace(finished=jnp.asarray([True, False]))
+    bud = base._replace(dec_count=jnp.asarray([4, 1], jnp.int32))
+    both = fin._replace(dec_count=jnp.asarray([4, 1], jnp.int32))
+    assert int(_loop_exit_reason(fin, gen_cfg, z)) == \
+        LOOP_EXIT_FINISHED
+    assert int(_loop_exit_reason(bud, gen_cfg, z)) == LOOP_EXIT_BUDGET
+    assert int(_loop_exit_reason(both, gen_cfg, h)) == \
+        LOOP_EXIT_FINISHED                      # finished wins
+    assert int(_loop_exit_reason(base, gen_cfg, h)) == LOOP_EXIT_HOST
+    assert int(_loop_exit_reason(base, gen_cfg, z)) == \
+        LOOP_EXIT_BUDGET                        # full-T fallback
+    # a FINISHED slot whose dec_count also expired books as finished,
+    # not budget, in the flags the cond() short-circuits on
+    fin_any, bud_any = _loop_exit_flags(both, gen_cfg)
+    assert bool(fin_any) and not bool(bud_any)
+    # inactive slots are invisible to every exit condition
+    idle = init_slot_state(2, CFG.vocab_size)._replace(
+        finished=jnp.asarray([True, True]),
+        dec_count=jnp.asarray([9, 9], jnp.int32))
+    fin_any, bud_any = _loop_exit_flags(idle, gen_cfg)
+    assert not bool(fin_any) and not bool(bud_any)
+
+
+def test_slot_state_pytree_stable_under_loop_carry():
+    """SlotState must thread a jitted lax.while_loop unchanged in
+    pytree structure, leaf dtypes, and leaf shapes — the contract that
+    lets decode_loop carry it across T ticks without recompiles."""
+    from paddlefleetx_tpu.models.gpt.generation import init_slot_state
+    state = init_slot_state(3, CFG.vocab_size)
+
+    @jax.jit
+    def roll(s):
+        def body(carry):
+            st, t = carry
+            st = st._replace(dec_count=st.dec_count + 1,
+                             lengths=st.lengths + 1)
+            return st, t + 1
+        s, _ = jax.lax.while_loop(lambda c: c[1] < 4, body,
+                                  (s, jnp.int32(0)))
+        return s
+
+    out = roll(state)
+    assert jax.tree_util.tree_structure(out) == \
+        jax.tree_util.tree_structure(state)
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(out)):
+        assert a.shape == b.shape and a.dtype == b.dtype
+    assert np.asarray(out.dec_count).tolist() == [4, 4, 4]

@@ -1366,3 +1366,326 @@ def test_serving_metrics_endpoint_smoke(paged512_model_and_params,
     finally:
         obs_server.stop()
     assert obs_server.get_server() is None
+
+
+# -- device-resident decode: T ticks per host round-trip ---------------
+#
+# The fused decode_loop/verify_loop (generation.py) must be INVISIBLE
+# in the tokens: T=1 through the loop equals decode_step, and T>1
+# equals T=1 on every strategy x layout x spec combination — while
+# strictly reducing host round-trips per committed token. The matrix
+# below runs 6 requests over 2 slots so every run exercises a
+# host-signaled admission exit (queue pending behind full slots), and
+# the budget-expiry exit (requests hitting max_dec_len).
+
+
+class _ConstDraft:
+    """Drafts a fixed token regardless of history: propose(h, k*T)
+    reshaped [T, k] equals T separate propose(h, k) calls, so the
+    draft stream is identical at any loop_ticks — the deterministic
+    source the sampling+spec T-parity leg needs (history-dependent
+    sources like ngram draft from the PRE-loop history at T>1, which
+    changes accept patterns, not tokens, under greedy only)."""
+
+    def propose(self, history, k):
+        return [17] * k
+
+
+def _loop_run(model, params, gen_cfg, loop_ticks, *, paged=False,
+              seed=11, draft=None):
+    paged_kw = dict(page_size=128, prefill_chunk_pages=1) if paged \
+        else {}
+    srv = GenerationServer(model, params, gen_cfg, num_slots=2,
+                           rng=jax.random.key(seed),
+                           device_loop_ticks=loop_ticks, **paged_kw)
+    if draft is not None:
+        srv._draft = draft
+    toks = [c.tokens for c in srv.run(PROMPTS)]
+    if paged:
+        srv._alloc.check()
+        assert srv._alloc.pages_in_use == 0
+    return toks, srv.summary()
+
+
+@pytest.mark.parametrize("loop_ticks", [4, 16])
+@pytest.mark.parametrize("strategy", ["greedy", "sampling"])
+def test_device_loop_parity_unpaged(model_and_params, loop_ticks,
+                                    strategy):
+    """T in {4,16} == T=1, token-exact, greedy and seeded sampling,
+    contiguous cache — with strictly fewer host round-trips per
+    committed token at T>1."""
+    model, params = model_and_params
+    if strategy == "greedy":
+        gen_cfg = _greedy_cfg()
+    else:
+        gen_cfg = GenerationConfig(
+            max_dec_len=8, decode_strategy="sampling", top_k=8,
+            top_p=0.9, temperature=0.7, eos_token_id=EOS,
+            pad_token_id=PAD)
+    ref, ref_summ = _loop_run(model, params, gen_cfg, 1)
+    out, summ = _loop_run(model, params, gen_cfg, loop_ticks)
+    assert out == ref
+    assert summ["decode_tokens"] == ref_summ["decode_tokens"]
+    assert summ["host_roundtrips"] < ref_summ["host_roundtrips"]
+    assert summ["device_ticks"] == ref_summ["device_ticks"]
+
+
+@pytest.mark.parametrize("loop_ticks", [4, 16])
+@pytest.mark.parametrize("strategy", ["greedy", "sampling"])
+def test_device_loop_parity_paged(paged_model_and_params, loop_ticks,
+                                  strategy):
+    """The paged edition of the T-parity matrix: page pre-mapping for
+    the loop window and the past-commit rollback must leave the pool
+    whole (checked inside _loop_run) and the tokens untouched."""
+    model, params = paged_model_and_params
+    if strategy == "greedy":
+        gen_cfg = _greedy_cfg()
+    else:
+        gen_cfg = GenerationConfig(
+            max_dec_len=8, decode_strategy="sampling", top_k=8,
+            top_p=0.9, temperature=0.7, eos_token_id=EOS,
+            pad_token_id=PAD)
+    ref, ref_summ = _loop_run(model, params, gen_cfg, 1, paged=True)
+    out, summ = _loop_run(model, params, gen_cfg, loop_ticks,
+                          paged=True)
+    assert out == ref
+    assert summ["host_roundtrips"] < ref_summ["host_roundtrips"]
+
+
+@pytest.mark.parametrize("paged", [False, True])
+@pytest.mark.parametrize("loop_ticks", [4, 16])
+def test_device_loop_spec_greedy_parity(request, paged, loop_ticks):
+    """Spec-on greedy at T in {4,16}: ngram drafting proposes k*T
+    tokens from the pre-loop history, acceptance re-scores every
+    draft, and the argmax chain keeps the output token-identical to
+    both spec-on T=1 and spec-off lockstep."""
+    model, params = request.getfixturevalue(
+        "paged_model_and_params" if paged else "model_and_params")
+    gen_cfg = _spec_cfg(_greedy_cfg(), 3)
+    ref = _lockstep(model, params, PROMPTS, _greedy_cfg())
+    t1, _ = _loop_run(model, params, gen_cfg, 1, paged=paged)
+    out, summ = _loop_run(model, params, gen_cfg, loop_ticks,
+                          paged=paged)
+    assert out == t1 == ref
+    assert summ["spec_accepted"] >= 0
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_device_loop_spec_sampling_const_draft_parity(request, paged):
+    """Seeded sampling + spec-on T-parity needs a draft source whose
+    proposals don't depend on WHEN they were proposed (_ConstDraft):
+    then the per-(nonce, dec_count) rng streams line up tick for tick
+    and T=4 replays T=1 exactly, rejection sampling included."""
+    model, params = request.getfixturevalue(
+        "paged_model_and_params" if paged else "model_and_params")
+    gen_cfg = GenerationConfig(
+        max_dec_len=8, decode_strategy="sampling", top_k=8,
+        top_p=0.9, temperature=0.7, eos_token_id=EOS,
+        pad_token_id=PAD, spec_method="ngram", spec_tokens=3)
+    ref, _ = _loop_run(model, params, gen_cfg, 1, paged=paged,
+                       draft=_ConstDraft())
+    out, _ = _loop_run(model, params, gen_cfg, 4, paged=paged,
+                       draft=_ConstDraft())
+    assert out == ref
+
+
+def test_device_loop_mid_loop_eos_parity(model_and_params):
+    """A slot finishing MID-loop (eos on an interior tick of a T=4
+    launch) must exit the loop that tick, evict on time, and leave
+    every row token-identical to T=1. The eos id is picked from the
+    T=1 reference so one row provably finishes early."""
+    model, params = model_and_params
+    probe = _lockstep(model, params, PROMPTS, _greedy_cfg())
+    eos = probe[0][3]                    # row 0 finishes at tick 4
+    gen_cfg = _greedy_cfg()
+    gen_cfg = dataclasses.replace(gen_cfg, eos_token_id=eos)
+    ref, ref_summ = _loop_run(model, params, gen_cfg, 1)
+    out, summ = _loop_run(model, params, gen_cfg, 4)
+    assert out == ref
+    assert any(len(r) < gen_cfg.max_dec_len for r in ref)  # eos hit
+    assert summ["decode_tokens"] == ref_summ["decode_tokens"]
+
+
+def test_device_loop_t1_step_path_unchanged(model_and_params):
+    """device_loop_ticks=1 must not even route through _step_loop —
+    the T=1 server IS today's tick-per-step path, byte-identical."""
+    model, params = model_and_params
+    gen_cfg = _greedy_cfg()
+    srv = GenerationServer(model, params, gen_cfg, num_slots=2,
+                           device_loop_ticks=1)
+    ref = _lockstep(model, params, PROMPTS[:2], gen_cfg)
+    assert [c.tokens for c in srv.run(PROMPTS[:2])] == ref
+    summ = srv.summary()
+    assert summ["device_loop_ticks"] == 1
+    assert summ["host_roundtrips"] == summ["decode_ticks"]
+
+
+def test_device_loop_ticks_validation(model_and_params):
+    model, params = model_and_params
+    with pytest.raises(ValueError, match="device_loop_ticks"):
+        GenerationServer(model, params, _greedy_cfg(),
+                         num_slots=2, device_loop_ticks=0)
+
+
+def test_decode_loop_t1_matches_decode_step(model_and_params):
+    """The loop at loop_ticks=1 is decode_step: same token, same
+    state (field for field), same carry pytree STRUCTURE (the jit
+    contract — a structure change would silently recompile every
+    launch)."""
+    from paddlefleetx_tpu.models.gpt.generation import (
+        LOOP_EXIT_BUDGET, decode_loop, decode_step,
+    )
+    model, params = model_and_params
+    gen_cfg = _greedy_cfg()
+    srv = GenerationServer(model, params, gen_cfg, num_slots=2)
+    for p in PROMPTS[:2]:
+        srv.submit(p)
+    srv._admit()
+    model_u, params_u = srv.model, srv.params
+    cache, state = srv._cache, srv._state
+    c1, s1, tok = decode_step(model_u, params_u, cache, state,
+                              srv._rng, gen_cfg)
+    c2, s2, buf, ticks, reason = decode_loop(
+        model_u, params_u, cache, state, srv._rng, gen_cfg,
+        jnp.int32(0), loop_ticks=1)
+    assert int(ticks) == 1
+    assert int(reason) == LOOP_EXIT_BUDGET  # full-T run, nothing else
+    np.testing.assert_array_equal(np.asarray(buf)[:, 0],
+                                  np.asarray(tok))
+    assert jax.tree_util.tree_structure(s2) == \
+        jax.tree_util.tree_structure(state)
+    assert jax.tree_util.tree_structure(c2) == \
+        jax.tree_util.tree_structure(cache)
+    for a, b in zip(jax.tree_util.tree_leaves(s1),
+                    jax.tree_util.tree_leaves(s2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(c1),
+                    jax.tree_util.tree_leaves(c2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_decode_loop_host_flag_exits_after_one_tick(model_and_params):
+    """host_flag != 0 at launch -> exactly one tick runs and the exit
+    reason says LOOP_EXIT_HOST (the host asked for control back); the
+    one tick still matches decode_step."""
+    from paddlefleetx_tpu.models.gpt.generation import (
+        LOOP_EXIT_HOST, decode_loop, decode_step,
+    )
+    model, params = model_and_params
+    gen_cfg = _greedy_cfg()
+    srv = GenerationServer(model, params, gen_cfg, num_slots=2)
+    for p in PROMPTS[:2]:
+        srv.submit(p)
+    srv._admit()
+    cache, state = srv._cache, srv._state
+    _, _, tok = decode_step(srv.model, srv.params, cache, state,
+                            srv._rng, gen_cfg)
+    _, _, buf, ticks, reason = decode_loop(
+        srv.model, srv.params, cache, state, srv._rng, gen_cfg,
+        jnp.int32(1), loop_ticks=8)
+    assert int(ticks) == 1
+    assert int(reason) == LOOP_EXIT_HOST
+    np.testing.assert_array_equal(np.asarray(buf)[:, 0],
+                                  np.asarray(tok))
+    # columns past ticks_run stay at the pad sentinel
+    assert (np.asarray(buf)[:, 1:] == PAD).all()
+
+
+def test_decode_loop_budget_exit(model_and_params):
+    """max_dec_len=3 with a 16-tick budget: the loop stops itself
+    after exactly 3 ticks (dec_count hit the budget) and reports
+    LOOP_EXIT_BUDGET — the host's length eviction fires next."""
+    from paddlefleetx_tpu.models.gpt.generation import (
+        LOOP_EXIT_BUDGET, decode_loop,
+    )
+    model, params = model_and_params
+    gen_cfg = _greedy_cfg(max_dec=3)
+    srv = GenerationServer(model, params, gen_cfg, num_slots=2)
+    for p in PROMPTS[:2]:
+        srv.submit(p)
+    srv._admit()
+    _, s2, _, ticks, reason = decode_loop(
+        srv.model, srv.params, srv._cache, srv._state, srv._rng,
+        gen_cfg, jnp.int32(0), loop_ticks=16)
+    assert int(ticks) == 3
+    assert int(reason) == LOOP_EXIT_BUDGET
+    assert np.asarray(s2.dec_count).tolist() == [3, 3]
+
+
+def test_device_loop_exit_counters(model_and_params):
+    """One T=4 run over the 6-request trace books every loop launch
+    under exactly one serving/loop_exit/* reason, counts device ticks
+    apart from round-trips, and sees at least one admission exit
+    (queue pending behind full slots) plus the final budget/finish
+    exits."""
+    model, params = model_and_params
+    gen_cfg = _greedy_cfg()
+    metrics.set_enabled(True)
+    reg = metrics.get_registry()
+    reg.reset()
+    try:
+        srv = GenerationServer(model, params, gen_cfg, num_slots=2,
+                               device_loop_ticks=4)
+        srv.run(PROMPTS)
+        summ = srv.summary()
+        exits = {r: reg.counter(f"serving/loop_exit/{r}")
+                 for r in ("finished", "admission", "budget", "drain")}
+        assert sum(exits.values()) == summ["host_roundtrips"]
+        assert exits["admission"] >= 1       # 6 requests > 2 slots
+        assert exits["budget"] >= 1          # rows run to max_dec_len
+        assert exits["drain"] == 0
+        assert reg.counter("serving/device_ticks") == \
+            summ["device_ticks"] == summ["decode_ticks"]
+        assert summ["host_roundtrips"] < summ["device_ticks"]
+        assert summ["host_roundtrip_p99_ms"] >= \
+            summ["host_roundtrip_p50_ms"] > 0
+    finally:
+        metrics.set_enabled(False)
+        reg.reset()
+
+
+def test_device_loop_serving_smoke_interpret_kernel(model_and_params,
+                                                    tmp_path):
+    """CI smoke (`-k smoke`), device-loop edition: the T=4 fused loop
+    with the RAGGED PALLAS KERNEL in interpret mode, a mid-run
+    admission forcing a host-signaled early exit, and the events.jsonl
+    trail CI's failure-diagnostics artifact collects."""
+    _, params = model_and_params
+    kcfg = GPTConfig(**{**CFG.__dict__, "use_flash_attention": True})
+    model = GPTForPretraining(kcfg)
+    # max_dec (6) > T (4): the first fused launch leaves both slots
+    # live, so the mid-run submit below finds them busy and forces
+    # host-signaled 1-tick exits until one frees
+    gen_cfg = _greedy_cfg(max_dec=6)
+    ref = _lockstep(model, params, PROMPTS[:3], gen_cfg)
+    events = tmp_path / "events.jsonl"
+    metrics.set_enabled(True)
+    reg = metrics.get_registry()
+    reg.reset()
+    try:
+        srv = GenerationServer(model, params, gen_cfg, num_slots=2,
+                               device_loop_ticks=4,
+                               events_path=str(events))
+        done = {}
+        ids = [srv.submit(p) for p in PROMPTS[:2]]
+        for c in srv.step():             # first fused launch: 4 ticks
+            done[c.request_id] = c
+        ids.append(srv.submit(PROMPTS[2]))   # mid-run admission
+        _drain(srv, done)
+        assert [done[i].tokens for i in ids] == ref
+        assert reg.counter("attention/flash_decode_ragged") >= 1
+        assert reg.counter("serving/admitted") == 3
+        assert reg.counter("serving/evicted") == 3
+        assert reg.counter("serving/device_ticks") == \
+            srv.summary()["decode_ticks"]
+        # the pending admit forced at least one 1-tick host exit
+        assert reg.counter("serving/loop_exit/admission") >= 1
+        kinds = [json.loads(l)["event"] for l in
+                 events.read_text().splitlines()]
+        assert kinds[0] == "serving_start"
+        assert "serving_admit" in kinds and "serving_evict" in kinds
+        start = json.loads(events.read_text().splitlines()[0])
+        assert start["loop_ticks"] == 4
+    finally:
+        metrics.set_enabled(False)
+        reg.reset()
