@@ -1111,9 +1111,18 @@ def bench_serving():
     ``_MIN_PROMPT`` / ``_MAX_PROMPT`` / ``_DEC_LEN``, plus the paged
     KV-cache knobs ``PFX_BENCH_SERVING_PAGED`` / ``_PAGE_SIZE`` /
     ``_POOL_PAGES``, the speculative A/B knobs
-    ``PFX_BENCH_SERVING_SPEC`` / ``_SPEC_TOKENS``, and the
+    ``PFX_BENCH_SERVING_SPEC`` / ``_SPEC_TOKENS``, the int8-KV A/B
+    knob ``PFX_BENCH_SERVING_KV_DTYPE``, and the
     device-resident-decode sweep knob
     ``PFX_BENCH_SERVING_LOOP_TICKS`` (below).
+
+    int8-KV A/B: with ``PFX_BENCH_SERVING_KV_DTYPE=int8`` (paged mode
+    only) the same trace and slot count are ALSO served with
+    ``kv_cache_dtype="int8"`` from a pool resized to the same device
+    bytes as the bf16 pool (``core/paging.py::pool_pages_for_bytes``),
+    emitting one extra record ahead of the headline — tokens/s plus
+    ``slots_admitted`` / ``slot_ratio`` density accounting
+    (docs/quantization.md). The bf16 headline itself never changes.
 
     Device-loop T-sweep: ``PFX_BENCH_SERVING_LOOP_TICKS`` (default
     ``1,4,16``) lists the ``device_loop_ticks`` values to measure.
@@ -1208,18 +1217,19 @@ def bench_serving():
                         prefill_chunk_pages=2 if cap_pages % 2 == 0
                         else 1)
 
-    def _serve(cfg_x, loop_ticks=1):
+    def _serve(cfg_x, loop_ticks=1, model_x=None, paged_kw_x=None):
         """Warm pass (compiles every bucket + the tick) then an
         identical measured pass on a fresh server; committed tokens/s
         from the server's own decode-time accounting. Returns the
         measured pass's committed-token rate, device-tick count, and
         host round-trip count (== ticks at T=1, strictly fewer at
         T>1) plus the cumulative summary for its percentiles."""
-        srv = GenerationServer(model, params, cfg_x,
+        srv = GenerationServer(model_x or model, params, cfg_x,
                                num_slots=num_slots,
                                rng=jax.random.key(seed + 1),
                                device_loop_ticks=loop_ticks,
-                               **paged_kw)
+                               **(paged_kw if paged_kw_x is None
+                                  else paged_kw_x))
         srv.run(prompts)
         warm = srv.summary()
         srv.run(prompts)
@@ -1262,6 +1272,61 @@ def bench_serving():
         }
         _log_success(t_rec)
         print(json.dumps(t_rec))
+
+    # int8-KV A/B (PFX_BENCH_SERVING_KV_DTYPE=int8): the SAME trace
+    # and slot count served from a page pool holding the SAME device
+    # BYTES as the bf16 pool — int8 + fp32 scales pack ~1.9x the
+    # pages (core/paging.py), so the record carries both tokens/s and
+    # the admission-capacity ratio (docs/quantization.md). Emitted
+    # BEFORE the headline so the headline/spec records keep their
+    # pinned last-two positions; the bf16 headline itself is
+    # untouched by the knob.
+    kv_dtype = os.environ.get("PFX_BENCH_SERVING_KV_DTYPE", "")
+    if kv_dtype and paged:
+        from paddlefleetx_tpu.core.paging import (
+            pool_bytes, pool_pages_for_bytes,
+        )
+        budget = pool_bytes(cfg.num_layers, cfg.num_attention_heads,
+                            cfg.head_dim, page_size, pool_pages,
+                            "bf16")
+        kv_pool_pages = pool_pages_for_bytes(
+            budget, cfg.num_layers, cfg.num_attention_heads,
+            cfg.head_dim, page_size, kv_dtype)
+        kv_cfg = dataclasses.replace(cfg, kv_cache_dtype=kv_dtype)
+        kv_model = GPTForPretraining(kv_cfg)
+        kv_kw = dict(paged_kw, pool_pages=kv_pool_pages)
+        kv_tps, kv_ticks, kv_rounds, kv_total = _serve(
+            gen_cfg, model_x=kv_model, paged_kw_x=kv_kw)
+        # full-capacity slots each pool admits on the same bytes
+        admit = (kv_pool_pages - 1) // cap_pages
+        admit_bf16 = (pool_pages - 1) // cap_pages
+        kv_rec = {
+            "metric": METRIC_BY_MODE["serving"] + f"_kv_{kv_dtype}",
+            "value": round(kv_tps, 1),
+            "unit": "tokens/s",
+            "vs_baseline": None,
+            "requests": n_requests,
+            "slots": num_slots,
+            "prompt_len_range": [min_p, max_p],
+            "max_dec_len": dec_len,
+            "seed": seed,
+            "paged": paged,
+            "page_size": page_size,
+            "pool_pages": kv_pool_pages,
+            "loop_ticks": 1,
+            "kv_cache_dtype": kv_dtype,
+            "pool_bytes": budget,
+            "decode_ticks": kv_ticks,
+            "host_roundtrips": kv_rounds,
+            "slots_admitted": admit,
+            "slots_admitted_bf16": admit_bf16,
+            "slot_ratio": round(admit / max(admit_bf16, 1), 3),
+            "ttft_p50_ms": kv_total.get("ttft_p50_ms", 0.0),
+            "ttft_p99_ms": kv_total.get("ttft_p99_ms", 0.0),
+            "tick_p99_ms": kv_total.get("tick_p99_ms", 0.0),
+        }
+        _log_success(kv_rec)
+        print(json.dumps(kv_rec))
 
     decode_tps, ticks, rounds, total = _serve(gen_cfg)
     common = {

@@ -227,3 +227,48 @@ class PageAllocator:
             for pid in pages:
                 assert self._ref.get(pid, 0) > 0, (key, pid)
                 assert key in self._page_prompt_keys.get(pid, set())
+
+
+# -- pool sizing -------------------------------------------------------
+
+def kv_page_bytes(num_heads: int, head_dim: int, page_size: int,
+                  kv_cache_dtype: str = "bf16") -> int:
+    """Device bytes ONE K or V page costs per layer.
+
+    ``bf16``: 2 bytes per element. ``int8``: 1 byte per element plus
+    one fp32 scale per (head, position) — the ``cached_*_scale`` pool
+    leaves of ``models/gpt/model.py`` — i.e. ``head_dim + 4`` bytes
+    per head-token instead of ``2 * head_dim``: a 1.88x density win at
+    head_dim 64 (docs/quantization.md)."""
+    if kv_cache_dtype == "int8":
+        per_token = num_heads * (head_dim + 4)
+    elif kv_cache_dtype == "bf16":
+        per_token = num_heads * head_dim * 2
+    else:
+        raise ValueError(
+            f"unknown kv_cache_dtype {kv_cache_dtype!r} "
+            f"(expected 'bf16' or 'int8')")
+    return per_token * page_size
+
+
+def pool_bytes(num_layers: int, num_heads: int, head_dim: int,
+               page_size: int, num_pages: int,
+               kv_cache_dtype: str = "bf16") -> int:
+    """Total device bytes of a ``num_pages`` KV pool (K and V, all
+    layers) — the figure the serving summary reports and the A/B
+    bench divides slot counts by."""
+    return 2 * num_layers * num_pages * kv_page_bytes(
+        num_heads, head_dim, page_size, kv_cache_dtype)
+
+
+def pool_pages_for_bytes(budget_bytes: int, num_layers: int,
+                         num_heads: int, head_dim: int,
+                         page_size: int,
+                         kv_cache_dtype: str = "bf16") -> int:
+    """Largest pool (in pages) fitting ``budget_bytes`` of HBM —
+    the inverse of :func:`pool_bytes`, used to hold pool BYTES fixed
+    while switching ``kv_cache_dtype`` (int8 admits ~1.9x the pages,
+    hence ~1.9x the resident slots on the same memory)."""
+    per_page = 2 * num_layers * kv_page_bytes(
+        num_heads, head_dim, page_size, kv_cache_dtype)
+    return int(budget_bytes) // max(per_page, 1)

@@ -1,0 +1,205 @@
+"""Post-training quantization (PTQ) to the weight-only int8 format.
+
+The QAT pass (``ops/quantization.py``) trains THROUGH a simulated
+abs-max int8 grid but still materializes fp weights; nothing in the
+repo executed real int8 until the ``quant_execution`` path
+(``models/gpt/model.py::_QuantDense`` over
+``ops/pallas/quantized_matmul.py``). This module is the bridge: it
+rewrites a trained GPT parameter tree into that path's storage format
+— each dense-site ``kernel`` becomes an int8 leaf plus a sibling fp32
+``kernel_scale`` — so a base checkpoint quantizes into exactly the
+tree a ``quant_execution="weight_only_int8"`` model abstract-inits,
+and restores through the ordinary manifest-verified checkpoint
+machinery (``core/checkpoint.py``).
+
+Grid compatibility: scales are symmetric abs-max with ``qmax = 127``,
+the same grid ``ops/quantization.py::fake_quant`` simulates (bits=8),
+so PTQ of a QAT-trained checkpoint lands on the grid the weights were
+trained to tolerate — but per OUTPUT CHANNEL rather than per tensor,
+which is strictly finer (every channel of a QAT-optimal tensor is
+also representable). Sites and their contraction layout are keyed by
+parameter NAME, not by module introspection, so the pass works on a
+bare restored pytree with no model object: ``qkv_proj`` / ``q_proj``
+/ ``k_proj`` / ``v_proj`` / ``out_proj`` / ``linear1`` / ``linear2``
+kernels quantize; embeddings, norms, biases and every other leaf pass
+through untouched. Scan-stacked trees (``decoder/...`` leaves with a
+leading ``[num_layers]`` axis) are detected by rank and get
+independent per-layer scales, matching the QAT pass's
+``stacked_module`` handling.
+
+Driven by ``scripts/quantize_checkpoint.py``; numerics pinned in
+``tests/test_quantized_matmul.py``; workflow in
+``docs/quantization.md``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import traverse_util
+
+#: dense-site kernel layout, keyed by the flax module name the site
+#: keeps across the fp / collective / quantized implementations:
+#: name -> (contract_ndim, base_ndim). ``base_ndim`` is the kernel
+#: rank WITHOUT the nn.scan layer axis; a leaf of rank base_ndim + 1
+#: is a stacked ``decoder`` kernel and keeps its leading layer axis
+#: out of the scale reduction.
+QUANT_SITES: Dict[str, Tuple[int, int]] = {
+    "qkv_proj": (1, 4),    # [h, 3, heads, head_dim]
+    "q_proj": (1, 3),      # [h, heads, head_dim]
+    "k_proj": (1, 3),
+    "v_proj": (1, 3),
+    "out_proj": (2, 3),    # [heads, head_dim, h]
+    "linear1": (1, 2),     # [h, ffn]
+    "linear2": (1, 2),     # [ffn, h]
+}
+
+#: symmetric int8 grid shared with ``ops/quantization.py::fake_quant``
+QMAX = 127.0
+_EPS = 1e-8
+
+
+def quantize_kernel(w, contract_ndim: int,
+                    base_ndim: int) -> Tuple[jax.Array, jax.Array]:
+    """One kernel -> ``(int8 values, fp32 per-output-channel scales)``.
+
+    The scale reduces over the ``contract_ndim`` axes that the site's
+    matmul contracts (skipping a leading nn.scan layer axis when the
+    leaf is rank ``base_ndim + 1``), i.e. one scale per output
+    channel — the layout ``_QuantDense`` holds in VMEM and
+    ``quantized_matmul`` applies at write-out.
+    """
+    w = jnp.asarray(w)
+    if w.ndim == base_ndim + 1:
+        lead = 1
+    elif w.ndim == base_ndim:
+        lead = 0
+    else:
+        raise ValueError(
+            f"kernel rank {w.ndim} matches neither the site's base "
+            f"rank {base_ndim} nor its scan-stacked rank "
+            f"{base_ndim + 1} (shape {w.shape})")
+    axes = tuple(range(lead, lead + contract_ndim))
+    f = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(f), axis=axes)
+    scale = jnp.maximum(amax / QMAX, _EPS)
+    q = jnp.clip(jnp.round(f / jnp.expand_dims(scale, axes)),
+                 -QMAX, QMAX).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_kernel(q, scale, contract_ndim: int,
+                      base_ndim: int) -> jax.Array:
+    """Exact inverse mapping of the storage format back to fp32 —
+    the XLA fallback's dequantize-then-dot weight and the oracle the
+    parity tests compare the Pallas kernel against."""
+    q = jnp.asarray(q)
+    lead = 1 if q.ndim == base_ndim + 1 else 0
+    axes = tuple(range(lead, lead + contract_ndim))
+    return q.astype(jnp.float32) * jnp.expand_dims(
+        jnp.asarray(scale, jnp.float32), axes)
+
+
+def quantize_param_tree(
+        params) -> Tuple[Any, List[Dict[str, Any]]]:
+    """Rewrite a GPT param tree into the weight-only int8 format.
+
+    Returns ``(quantized_tree, report)``: every ``<site>/kernel``
+    with ``<site>`` in :data:`QUANT_SITES` is replaced by its int8
+    values plus a new ``<site>/kernel_scale`` sibling; all other
+    leaves (biases, norms, embeddings, already-int8 kernels) pass
+    through by reference. The report has one row per quantized site
+    with the shapes and the compression it bought — callers log it
+    and stash it in the checkpoint meta.
+    """
+    flat = traverse_util.flatten_dict(params)
+    out: Dict[Tuple[str, ...], Any] = {}
+    report: List[Dict[str, Any]] = []
+    for key, leaf in flat.items():
+        site = key[-2] if len(key) >= 2 else ""
+        if key[-1] != "kernel" or site not in QUANT_SITES \
+                or getattr(leaf, "dtype", None) == jnp.int8:
+            out[key] = leaf
+            continue
+        cn, base_ndim = QUANT_SITES[site]
+        q, scale = quantize_kernel(leaf, cn, base_ndim)
+        out[key] = q
+        out[key[:-1] + ("kernel_scale",)] = scale
+        report.append({
+            "path": "/".join(key),
+            "shape": list(np.shape(leaf)),
+            "stacked": q.ndim == base_ndim + 1,
+            "bytes_fp": int(np.size(leaf)) * jnp.dtype(leaf.dtype).itemsize,
+            "bytes_int8": int(np.size(leaf)) + 4 * int(np.size(scale)),
+        })
+    return traverse_util.unflatten_dict(out), report
+
+
+def dequantize_param_tree(qparams) -> Any:
+    """Inverse of :func:`quantize_param_tree`: fold every
+    ``kernel_scale`` back into an fp32 ``kernel`` — the reference
+    tree a base (fp) model can apply, used to bound quantized-vs-base
+    deviation without a second trained checkpoint."""
+    flat = traverse_util.flatten_dict(qparams)
+    out: Dict[Tuple[str, ...], Any] = {}
+    for key, leaf in flat.items():
+        if key[-1] == "kernel_scale":
+            continue
+        site = key[-2] if len(key) >= 2 else ""
+        skey = key[:-1] + ("kernel_scale",)
+        if key[-1] == "kernel" and site in QUANT_SITES \
+                and skey in flat:
+            cn, base_ndim = QUANT_SITES[site]
+            out[key] = dequantize_kernel(leaf, flat[skey], cn,
+                                         base_ndim)
+        else:
+            out[key] = leaf
+    return traverse_util.unflatten_dict(out)
+
+
+def calibrate_activation_absmax(model, params, sample_ids,
+                                max_records: int = 512
+                                ) -> Dict[str, float]:
+    """Seed-batch activation calibration: one fp forward with the
+    activation abs-max recorded at every module boundary (the
+    moving-average abs-max statistic of the QAT config, evaluated at
+    its per-batch fixed point — ``ops/quantization.py``). The result
+    is a ``path -> absmax`` table the PTQ script stores in the
+    checkpoint meta; a future activation-quantized executor consumes
+    it, and until then it documents the dynamic range the weights
+    were calibrated against."""
+    _, inter = model.apply(
+        {"params": params}, sample_ids, deterministic=True,
+        capture_intermediates=True, mutable=["intermediates"])
+    table: Dict[str, float] = {}
+    flat = traverse_util.flatten_dict(inter["intermediates"])
+    for key, leaf in sorted(flat.items()):
+        if len(table) >= max_records:
+            break
+        for arr in jax.tree_util.tree_leaves(leaf):
+            if hasattr(arr, "dtype") and jnp.issubdtype(
+                    arr.dtype, jnp.floating):
+                path = "/".join(str(k) for k in key)
+                cur = float(jnp.max(jnp.abs(arr)))
+                table[path] = max(table.get(path, 0.0), cur)
+    return table
+
+
+def quantization_meta(report: List[Dict[str, Any]],
+                      calibration: Optional[Dict[str, float]] = None
+                      ) -> Dict[str, Any]:
+    """The ``meta["quantization"]`` payload written next to a
+    quantized checkpoint — enough for a reader (or the chaos drill's
+    resume leg) to know the artifact's format without probing dtypes."""
+    payload: Dict[str, Any] = {
+        "format": "weight_only_int8",
+        "qmax": QMAX,
+        "sites": sorted({r["path"] for r in report}),
+        "report": report,
+    }
+    if calibration is not None:
+        payload["activation_absmax"] = calibration
+    return payload

@@ -267,10 +267,16 @@ class GenerationServer:
         compute_dtype = jnp.dtype(cfg.dtype)
         if compute_dtype != jnp.float32:
             # same one-time cast as generate(): halve the per-token
-            # parameter bandwidth of the decode tick
-            params = jax.tree.map(
-                lambda p: p.astype(compute_dtype)
-                if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+            # parameter bandwidth of the decode tick; int8 kernels and
+            # their fp32 "kernel_scale" dequant grids pass through
+            # (quant_execution, docs/quantization.md)
+            def _cast(path, p):
+                name = getattr(path[-1], "key", "")
+                if name == "kernel_scale" or not jnp.issubdtype(
+                        p.dtype, jnp.floating):
+                    return p
+                return p.astype(compute_dtype)
+            params = jax.tree_util.tree_map_with_path(_cast, params)
         self.model, self.params = model, params
         self.gen_cfg = gen_cfg
         self.num_slots = num_slots
@@ -1413,11 +1419,20 @@ class GenerationServer:
             s["spec_accept_rate"] = round(
                 self._spec_accepted / max(self._spec_drafted, 1), 4)
         if self.paged:
+            from .paging import pool_bytes
+            mcfg = self.model.config
             s["paged"] = True
             s["page_size"] = self._page
             s["pool_pages"] = self._alloc.num_pages
             s["pages_in_use"] = self._alloc.pages_in_use
             s["prefill_chunks"] = self._prefill_chunk_count
+            # density accounting (docs/quantization.md): same pool
+            # BYTES admit ~1.9x the pages under int8 + fp32 scales
+            s["kv_cache_dtype"] = mcfg.kv_cache_dtype
+            s["pool_bytes"] = pool_bytes(
+                mcfg.num_layers, mcfg.num_attention_heads,
+                mcfg.head_dim, self._page, self._alloc.num_pages,
+                mcfg.kv_cache_dtype)
             s.update(self._alloc.stats)
         self._emit("serving_summary", **s)
         return s

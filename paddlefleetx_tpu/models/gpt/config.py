@@ -99,6 +99,24 @@ class GPTConfig:
     #: plus the null page — or a single request could deadlock the
     #: server. Required (> 0) whenever ``kv_page_size`` is set.
     kv_pool_pages: int = 0
+    #: Decode KV-cache storage dtype (docs/quantization.md). "bf16"
+    #: stores the cache in the compute dtype (the historical layout —
+    #: the name covers fp32 compute too); "int8" stores K/V as int8
+    #: plus one fp32 scale per (row, head, position), halving the
+    #: cache bytes per token so the same pool HBM admits ~2x the
+    #: paged slots. Both decode kernels (ragged and paged, verify
+    #: windows included) dequantize in-kernel; the dense fallback
+    #: widens up front (``attention/*_int8`` counters).
+    kv_cache_dtype: str = "bf16"
+    #: Dense-matmul execution (docs/quantization.md). "off" runs the
+    #: fp kernels as ever; "weight_only_int8" expects the param tree
+    #: a PTQ pass emitted (scripts/quantize_checkpoint.py: int8
+    #: ``kernel`` + fp32 per-output-channel ``kernel_scale``) and
+    #: routes qkv/out-proj/fc1/fc2 — the `_CollectiveDense` mp path
+    #: included — through the weight-only int8 Pallas GEMM
+    #: (ops/pallas/quantized_matmul.py; ``quant/*`` counters, per-site
+    #: XLA dequantize-then-dot fallback).
+    quant_execution: str = "off"
     dtype: str = "float32"                # compute dtype (bf16 for AMP-O2)
     param_dtype: str = "float32"
 
@@ -244,6 +262,30 @@ class GPTConfig:
                     f"{self.max_kv_pages} pages plus the reserved "
                     f"null page 0), or a single request can deadlock "
                     f"the page pool")
+        # Quantized execution knobs fail construction loudly: a typo'd
+        # value silently running fp would defeat the whole A/B (the
+        # YAML-side typo path is caught earlier by the config-warning
+        # pass — utils/config.py)
+        if self.kv_cache_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"unknown kv_cache_dtype {self.kv_cache_dtype!r} "
+                f"(expected 'bf16' or 'int8' — "
+                f"docs/quantization.md)")
+        if self.quant_execution not in ("off", "weight_only_int8"):
+            raise ValueError(
+                f"unknown quant_execution {self.quant_execution!r} "
+                f"(expected 'off' or 'weight_only_int8' — "
+                f"docs/quantization.md)")
+        if self.quant_execution != "off" and self.use_collective_matmul:
+            from ...utils.log import logger
+            logger.warning(
+                "quant_execution=%r with use_collective_matmul=True: "
+                "the overlapped mp rings stream fp weight chunks and "
+                "cannot consume the frozen int8 kernels, so quantized "
+                "sites take the int8 GEMM (or its XLA dequant "
+                "fallback) under the plain GSPMD constraint path — "
+                "quantization wins over the rings at shared sites "
+                "(docs/quantization.md).", self.quant_execution)
 
     @property
     def head_dim(self) -> int:

@@ -224,10 +224,17 @@ def generate(model, params, input_ids: jax.Array,
         # flax casts fp32 params to the compute dtype inside every op,
         # so the decode loop would stream fp32 bytes each token; one
         # up-front cast is numerically identical and halves the
-        # per-token parameter bandwidth (the decode bottleneck)
-        params = jax.tree.map(
-            lambda p: p.astype(compute_dtype)
-            if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+        # per-token parameter bandwidth (the decode bottleneck).
+        # int8 kernels (non-floating) and their fp32 dequant scales
+        # (quant_execution, docs/quantization.md) pass through — the
+        # scale grid is part of the PTQ artifact's numerics.
+        def _cast(path, p):
+            name = getattr(path[-1], "key", "")
+            if name == "kernel_scale" or not jnp.issubdtype(
+                    p.dtype, jnp.floating):
+                return p
+            return p.astype(compute_dtype)
+        params = jax.tree_util.tree_map_with_path(_cast, params)
     if prompt_len + gen_cfg.max_dec_len > cfg.max_position_embeddings:
         raise ValueError(
             f"prompt ({prompt_len}) + max_dec_len "
@@ -320,7 +327,8 @@ def _gather_cache(cache, gidx):
     ``cache_index`` is batch-free and passes through."""
     def g(path, leaf):
         name = getattr(path[-1], "key", "")
-        if name in ("cached_key", "cached_value"):
+        if name in ("cached_key", "cached_value",
+                    "cached_key_scale", "cached_value_scale"):
             return jnp.take(leaf, gidx, axis=leaf.ndim - 4)
         return leaf
     return jax.tree_util.tree_map_with_path(g, cache)
@@ -563,7 +571,8 @@ def _constrain_slot_cache(cache):
     A no-op without an active mesh/rules context."""
     def g(path, leaf):
         name = getattr(path[-1], "key", "")
-        if name in ("cached_key", "cached_value"):
+        if name in ("cached_key", "cached_value",
+                    "cached_key_scale", "cached_value_scale"):
             axes = (None,) * (leaf.ndim - 4) + (
                 "cache_slots", "act_heads", None, None)
             return with_logical_constraint(leaf, axes)
@@ -579,7 +588,8 @@ def _scatter_slot_rows(cache, rows, slot_ids):
     persistent cache's value — slot lengths live in ``SlotState``."""
     def put(path, pleaf, rleaf):
         name = getattr(path[-1], "key", "")
-        if name in ("cached_key", "cached_value"):
+        if name in ("cached_key", "cached_value",
+                    "cached_key_scale", "cached_value_scale"):
             ax = pleaf.ndim - 4
             idx = (slice(None),) * ax + (slot_ids,)
             return pleaf.at[idx].set(rleaf.astype(pleaf.dtype))
@@ -1135,7 +1145,8 @@ def copy_kv_pages(cache, src: jax.Array, dst: jax.Array):
     refcounts around it."""
     def cp(path, leaf):
         name = getattr(path[-1], "key", "")
-        if name in ("cached_key", "cached_value"):
+        if name in ("cached_key", "cached_value",
+                    "cached_key_scale", "cached_value_scale"):
             ax = leaf.ndim - 4
             sel = (slice(None),) * ax
             return leaf.at[sel + (dst,)].set(leaf[sel + (src,)])

@@ -135,6 +135,93 @@ class _CollectiveDense(nn.Module):
         return y + bias
 
 
+class _QuantDense(nn.Module):
+    """Weight-only int8 twin of the DenseGeneral/_CollectiveDense call
+    sites (``quant_execution="weight_only_int8"``,
+    docs/quantization.md).
+
+    Parameter contract: ``kernel`` keeps the fp sites' name, shape and
+    logical axes but stores int8 — the frozen PTQ artifact
+    ``core/quantize.py`` emits; ``kernel_scale`` is its fp32
+    per-output-channel dequant scale (shape = the kernel's output
+    dims, axes = the kernel axes past the contraction); ``bias`` is
+    unchanged. A fresh ``init()`` therefore yields zero weights and
+    unit scales — real values come from quantizing a trained
+    checkpoint (scripts/quantize_checkpoint.py), and the abstract
+    tree this init builds is exactly what the quantized checkpoint
+    restores into.
+
+    Dispatch: flatten the site to ``[M, K] @ [K, N]``, try the Pallas
+    weight-only GEMM (``quant/matmul``), fall back PER SITE to the
+    XLA dequantize-then-dot (``quant/fallback/kernel_rejected``) —
+    the same per-site contract as the attention/moe/mp_linear
+    families. When ``use_collective_matmul`` is also on, this module
+    replaces ``_CollectiveDense`` at the shared sites: the rings
+    stream fp weight chunks and cannot consume frozen int8 kernels,
+    so quantization wins (warned at config construction; dispatch
+    matrix in docs/quantization.md).
+    """
+    config: GPTConfig
+    features: Tuple[int, ...]
+    kernel_axes: Tuple[Optional[str], ...]
+    contract_ndim: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        from ...observability import metrics
+        cfg = self.config
+        cn = self.contract_ndim
+        kshape = tuple(x.shape[-cn:]) + tuple(self.features)
+        kernel = self.param(
+            "kernel",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(),
+                                         self.kernel_axes),
+            kshape, jnp.int8)
+        scale = self.param(
+            "kernel_scale",
+            nn.with_logical_partitioning(nn.initializers.ones_init(),
+                                         self.kernel_axes[cn:]),
+            tuple(self.features), jnp.float32)
+        bias = self.param(
+            "bias",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(),
+                                         self.kernel_axes[cn:]),
+            tuple(self.features), jnp.dtype(cfg.param_dtype))
+        dtype = jnp.dtype(cfg.dtype)
+        x = x.astype(dtype)
+        k_dim = int(np.prod(kshape[:cn]))
+        n_dim = int(np.prod(self.features))
+        x2 = x.reshape(-1, k_dim)
+        w2 = kernel.reshape(k_dim, n_dim)
+        s = scale.reshape(n_dim)
+        try:
+            from ...ops.pallas.quantized_matmul import quantized_matmul
+            y = quantized_matmul(x2, w2, s)
+            metrics.inc("quant/matmul")
+        except (ImportError, NotImplementedError):
+            # XLA dequantize-then-dot: numerically the kernel's oracle
+            # (same int8 grid, scale applied outside the contraction)
+            metrics.inc("quant/fallback/kernel_rejected")
+            w_deq = (w2.astype(jnp.float32) * s[None, :]).astype(dtype)
+            y = jax.lax.dot_general(x2, w_deq, (((1,), (0,)), ((), ())))
+        y = y.reshape(x.shape[:-cn] + tuple(self.features))
+        return y + bias.astype(dtype)
+
+
+def _quantize_kv(t):
+    """Symmetric per-(row, token, head) abs-max int8 quantization of a
+    ``[b, W, h, d]`` K/V tensor: ``(int8 values, [b, W, h, 1] fp32
+    scales)``. Per-token scales keep every cache write independent —
+    a page- or slot-granular scale would force requantizing already
+    written positions on each incremental decode write. The scale is
+    clamped away from zero so all-zero rows round-trip exactly."""
+    f = t.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(f), axis=-1, keepdims=True)
+    sc = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(f / sc), -127, 127).astype(jnp.int8)
+    return q, sc
+
+
 def _remat_policy(granularity: str):
     """Map reference recompute granularities onto checkpoint policies.
 
@@ -188,8 +275,16 @@ class MultiHeadAttention(nn.Module):
             bias_init=nn.with_logical_partitioning(
                 nn.initializers.zeros_init(), axes))
 
+        quant = cfg.quant_execution == "weight_only_int8"
         if cfg.fuse_attn_qkv:
-            if cfg.use_collective_matmul:
+            if quant:
+                # quantization wins over the rings at shared sites
+                # (config.py warns; docs/quantization.md matrix)
+                qkv = _QuantDense(
+                    cfg, features=(3, nh, hd),
+                    kernel_axes=("embed", None, "heads", "kv"),
+                    name="qkv_proj")(x)
+            elif cfg.use_collective_matmul:
                 qkv = _CollectiveDense(
                     cfg, features=(3, nh, hd),
                     kernel_axes=("embed", None, "heads", "kv"),
@@ -198,6 +293,16 @@ class MultiHeadAttention(nn.Module):
                 qkv = dense((3, nh, hd), "qkv_proj",
                             (None, "heads", "kv"))(x)
             q, k, v = (qkv[..., i, :, :] for i in range(3))
+        elif quant:
+            q = _QuantDense(cfg, features=(nh, hd),
+                            kernel_axes=("embed", "heads", "kv"),
+                            name="q_proj")(x)
+            k = _QuantDense(cfg, features=(nh, hd),
+                            kernel_axes=("embed", "heads", "kv"),
+                            name="k_proj")(x)
+            v = _QuantDense(cfg, features=(nh, hd),
+                            kernel_axes=("embed", "heads", "kv"),
+                            name="v_proj")(x)
         else:
             # non-fused qkv stays on the plain GSPMD path: three
             # narrow column projections are not worth three rings
@@ -214,6 +319,14 @@ class MultiHeadAttention(nn.Module):
         query_offset = 0
         kv_cache_layout = False
         page_table_arg = None
+        k_scale = v_scale = None
+        # int8 KV cache (kv_cache_dtype="int8", docs/quantization.md):
+        # values quantize per (row, token, head) on the way into the
+        # cache; fp32 scales live in rank-4 lookalike variables whose
+        # feature axis is a dummy 1 ([b, h, 1, S] / [P, h, 1, page]) so
+        # every write expression, page gather and slot helper
+        # (generation.py) applies to scales exactly as to values.
+        kv_int8 = cfg.kv_cache_dtype == "int8"
         if use_cache and page_table is not None:
             # Paged KV (core/paging.py): the cache variables hold the
             # GLOBAL page pool [kv_pool_pages, h, d, kv_page_size] —
@@ -243,10 +356,24 @@ class MultiHeadAttention(nn.Module):
                     "are not configured (GPTConfig)")
             cache_k = self.variable(
                 "cache", "cached_key", jnp.zeros,
-                (cfg.kv_pool_pages, nh, hd, page), dtype)
+                (cfg.kv_pool_pages, nh, hd, page),
+                jnp.int8 if kv_int8 else dtype)
             cache_v = self.variable(
                 "cache", "cached_value", jnp.zeros,
-                (cfg.kv_pool_pages, nh, hd, page), dtype)
+                (cfg.kv_pool_pages, nh, hd, page),
+                jnp.int8 if kv_int8 else dtype)
+            writes = [(cache_k, k), (cache_v, v)]
+            if kv_int8:
+                kq, ks = _quantize_kv(k)
+                vq, vs = _quantize_kv(v)
+                cache_ks = self.variable(
+                    "cache", "cached_key_scale", jnp.zeros,
+                    (cfg.kv_pool_pages, nh, 1, page), jnp.float32)
+                cache_vs = self.variable(
+                    "cache", "cached_value_scale", jnp.zeros,
+                    (cfg.kv_pool_pages, nh, 1, page), jnp.float32)
+                writes = [(cache_k, kq), (cache_v, vq),
+                          (cache_ks, ks), (cache_vs, vs)]
             pt = jnp.asarray(page_table, jnp.int32)
             if cache_lengths is not None:
                 base = jnp.clip(
@@ -255,12 +382,10 @@ class MultiHeadAttention(nn.Module):
                 if x.shape[1] == 1:
                     pid = jnp.take_along_axis(
                         pt, (base // page)[:, None], axis=1)[:, 0]
-                    cache_k.value = cache_k.value.at[pid, :, :,
-                                                     base % page].set(
-                        k.transpose(0, 2, 3, 1)[..., 0])
-                    cache_v.value = cache_v.value.at[pid, :, :,
-                                                     base % page].set(
-                        v.transpose(0, 2, 3, 1)[..., 0])
+                    for var, t in writes:
+                        var.value = var.value.at[pid, :, :,
+                                                 base % page].set(
+                            t.transpose(0, 2, 3, 1)[..., 0])
                 else:
                     # speculative verify window: row i's W tokens land
                     # at positions lengths[i] .. lengths[i] + W - 1,
@@ -276,10 +401,9 @@ class MultiHeadAttention(nn.Module):
                         + jnp.arange(x.shape[1], dtype=jnp.int32)[
                             None, :], 0, cfg.cache_capacity - 1)
                     pid = jnp.take_along_axis(pt, wpos // page, axis=1)
-                    cache_k.value = cache_k.value.at[
-                        pid, :, :, wpos % page].set(k)
-                    cache_v.value = cache_v.value.at[
-                        pid, :, :, wpos % page].set(v)
+                    for var, t in writes:
+                        var.value = var.value.at[
+                            pid, :, :, wpos % page].set(t)
                 query_offset = base                     # [b]
             elif chunk_start is not None:
                 c = x.shape[1]
@@ -292,18 +416,23 @@ class MultiHeadAttention(nn.Module):
                 pids = jnp.take_along_axis(
                     pt, (c0 // page)[:, None] +
                     jnp.arange(cp, dtype=jnp.int32)[None, :], axis=1)
-                # [b, h, d, c] -> [b, cp, h, d, page] page-major blocks
-                chunk_kv = lambda t: t.transpose(0, 2, 3, 1).reshape(  # noqa: E731
-                    x.shape[0], nh, hd, cp, page).transpose(
-                    0, 3, 1, 2, 4)
-                cache_k.value = cache_k.value.at[pids].set(chunk_kv(k))
-                cache_v.value = cache_v.value.at[pids].set(chunk_kv(v))
+                # [b, h, dd, c] -> [b, cp, h, dd, page] page-major
+                # blocks (dd = head_dim for values, 1 for scales)
+                def chunk_kv(t):
+                    tt = t.transpose(0, 2, 3, 1)
+                    return tt.reshape(
+                        x.shape[0], nh, tt.shape[2], cp,
+                        page).transpose(0, 3, 1, 2, 4)
+                for var, t in writes:
+                    var.value = var.value.at[pids].set(chunk_kv(t))
                 query_offset = c0                       # [b]
             else:
                 raise ValueError(
                     "page_table requires cache_lengths (ragged decode)"
                     " or chunk_start (chunked prefill)")
             k, v = cache_k.value, cache_v.value
+            if kv_int8:
+                k_scale, v_scale = cache_ks.value, cache_vs.value
             kv_cache_layout = True
             page_table_arg = pt
         elif use_cache:
@@ -325,10 +454,24 @@ class MultiHeadAttention(nn.Module):
             capacity = cfg.cache_capacity
             cache_k = self.variable(
                 "cache", "cached_key", jnp.zeros,
-                (x.shape[0], nh, hd, capacity), dtype)
+                (x.shape[0], nh, hd, capacity),
+                jnp.int8 if kv_int8 else dtype)
             cache_v = self.variable(
                 "cache", "cached_value", jnp.zeros,
-                (x.shape[0], nh, hd, capacity), dtype)
+                (x.shape[0], nh, hd, capacity),
+                jnp.int8 if kv_int8 else dtype)
+            writes = [(cache_k, k), (cache_v, v)]
+            if kv_int8:
+                kq, ks = _quantize_kv(k)
+                vq, vs = _quantize_kv(v)
+                cache_ks = self.variable(
+                    "cache", "cached_key_scale", jnp.zeros,
+                    (x.shape[0], nh, 1, capacity), jnp.float32)
+                cache_vs = self.variable(
+                    "cache", "cached_value_scale", jnp.zeros,
+                    (x.shape[0], nh, 1, capacity), jnp.float32)
+                writes = [(cache_k, kq), (cache_v, vq),
+                          (cache_ks, ks), (cache_vs, vs)]
             cache_index = self.variable(
                 "cache", "cache_index",
                 lambda: jnp.zeros((), jnp.int32))
@@ -347,12 +490,10 @@ class MultiHeadAttention(nn.Module):
                     jnp.asarray(cache_lengths, jnp.int32), 0,
                     capacity - 1)
                 if x.shape[1] == 1:
-                    cache_k.value = cache_k.value.at[
-                        rows, :, :, base].set(
-                        k.transpose(0, 2, 3, 1)[..., 0])
-                    cache_v.value = cache_v.value.at[
-                        rows, :, :, base].set(
-                        v.transpose(0, 2, 3, 1)[..., 0])
+                    for var, t in writes:
+                        var.value = var.value.at[
+                            rows, :, :, base].set(
+                            t.transpose(0, 2, 3, 1)[..., 0])
                 else:
                     # speculative verify window (see the paged branch
                     # above): scatter row i's W columns at
@@ -364,22 +505,21 @@ class MultiHeadAttention(nn.Module):
                         jnp.asarray(cache_lengths, jnp.int32)[:, None]
                         + jnp.arange(x.shape[1], dtype=jnp.int32)[
                             None, :], 0, capacity - 1)
-                    cache_k.value = cache_k.value.at[
-                        rows[:, None], :, :, wpos].set(k)
-                    cache_v.value = cache_v.value.at[
-                        rows[:, None], :, :, wpos].set(v)
+                    for var, t in writes:
+                        var.value = var.value.at[
+                            rows[:, None], :, :, wpos].set(t)
                 query_offset = base                     # [b]
             else:
                 idx = cache_index.value
-                cache_k.value = jax.lax.dynamic_update_slice(
-                    cache_k.value, k.transpose(0, 2, 3, 1),
-                    (0, 0, 0, idx))
-                cache_v.value = jax.lax.dynamic_update_slice(
-                    cache_v.value, v.transpose(0, 2, 3, 1),
-                    (0, 0, 0, idx))
+                for var, t in writes:
+                    var.value = jax.lax.dynamic_update_slice(
+                        var.value, t.transpose(0, 2, 3, 1),
+                        (0, 0, 0, idx))
                 query_offset = idx
                 cache_index.value = idx + x.shape[1]
             k, v = cache_k.value, cache_v.value
+            if kv_int8:
+                k_scale, v_scale = cache_ks.value, cache_vs.value
             kv_cache_layout = True
 
         dropout_rng = None
@@ -429,14 +569,20 @@ class MultiHeadAttention(nn.Module):
                 dropout_rng=dropout_rng, deterministic=deterministic,
                 use_flash=cfg.use_flash_attention,
                 kv_cache_layout=kv_cache_layout,
-                page_table=page_table_arg)
+                page_table=page_table_arg,
+                k_scale=k_scale, v_scale=v_scale)
         if use_ulysses:
             # all-to-all back: seq re-shards over cp, heads gather
             out = with_logical_constraint(
                 out, ("batch", "seq", "act_heads", None))
         out = checkpoint_name(out, "attn")
 
-        if cfg.use_collective_matmul:
+        if quant:
+            out = _QuantDense(
+                cfg, features=(h,),
+                kernel_axes=("heads", "kv", "embed"),
+                contract_ndim=2, name="out_proj")(out)
+        elif cfg.use_collective_matmul:
             out = _CollectiveDense(
                 cfg, features=(h,),
                 kernel_axes=("heads", "kv", "embed"),
@@ -494,6 +640,17 @@ class TransformerDecoderLayer(nn.Module):
         if cfg.moe_num_experts:
             from .moe import MoEMLP
             y, moe_aux = MoEMLP(cfg, name="moe_mlp")(y, deterministic)
+        elif cfg.quant_execution == "weight_only_int8":
+            y = _QuantDense(cfg, features=(cfg.ffn_hidden_size,),
+                            kernel_axes=("embed", "mlp"),
+                            name="linear1")(y)
+            y = checkpoint_name(y, "mlp1")
+            y = nn.gelu(y, approximate=True)
+            y = with_logical_constraint(y, ("batch", None, "act_mlp"))
+            y = _QuantDense(cfg, features=(cfg.hidden_size,),
+                            kernel_axes=("mlp", "embed"),
+                            name="linear2")(y)
+            y = checkpoint_name(y, "mlp2")
         elif cfg.use_collective_matmul:
             y = _CollectiveDense(
                 cfg, features=(cfg.ffn_hidden_size,),

@@ -202,7 +202,9 @@ def dot_product_attention(
         softmax_in_fp32: bool = True,
         use_flash: bool = False,
         kv_cache_layout: bool = False,
-        page_table: Optional[jax.Array] = None) -> jax.Array:
+        page_table: Optional[jax.Array] = None,
+        k_scale: Optional[jax.Array] = None,
+        v_scale: Optional[jax.Array] = None) -> jax.Array:
     """Causal attention; dispatches to the Pallas flash kernel on TPU.
 
     ``bias`` is an additive mask broadcastable to ``[b, h, sq, sk]``
@@ -221,7 +223,21 @@ def dot_product_attention(
     chunked prefill, kernel rejection, ``use_flash=False`` — gathers
     the rows contiguous (:func:`_gather_kv_pages`) and rides the
     per-row-offset dense path (dispatch matrix: docs/inference.md).
+
+    ``k_scale``/``v_scale`` (require ``kv_cache_layout``): the cache
+    is int8 (``GPTConfig.kv_cache_dtype="int8"``) and these are its
+    per-(row, head, position) fp32 dequant scales, shaped like the
+    cache minus its d axis (``[b, h, 1, S]``, or the page-parallel
+    pool ``[P, h, 1, page]``). Every kernel branch takes its
+    dequant-in-kernel variant (``attention/*_int8`` counters); the
+    dense fallback dequantizes the gathered rows up front and is the
+    parity oracle (dispatch matrix: docs/quantization.md).
     """
+    if (k_scale is None) is not (v_scale is None):
+        raise ValueError("k_scale and v_scale come together")
+    if k_scale is not None and not kv_cache_layout:
+        raise ValueError("KV scales require kv_cache_layout (the "
+                         "int8 cache is decode-only)")
     skv = k.shape[3] if kv_cache_layout else k.shape[1]
     if page_table is not None:
         if not kv_cache_layout:
@@ -276,8 +292,13 @@ def dot_product_attention(
                     # (flash_decode_paged) — each row streams only its
                     # own pages
                     out = fa.flash_decode_paged(q, k, v, query_offset,
-                                                page_table)
-                    metrics.inc("attention/flash_decode_paged")
+                                                page_table,
+                                                k_scale=k_scale,
+                                                v_scale=v_scale)
+                    if k_scale is not None:
+                        metrics.inc("attention/flash_decode_paged_int8")
+                    else:
+                        metrics.inc("attention/flash_decode_paged")
                     return out
                 if causal and 1 < q.shape[1] <= MAX_VERIFY_WINDOW \
                         and bias is None \
@@ -286,8 +307,15 @@ def dot_product_attention(
                     # same table walk, within-window causal mask
                     # (docs/inference.md, speculative decoding)
                     out = fa.flash_decode_paged(q, k, v, query_offset,
-                                                page_table)
-                    metrics.inc("attention/flash_decode_paged_verify")
+                                                page_table,
+                                                k_scale=k_scale,
+                                                v_scale=v_scale)
+                    if k_scale is not None:
+                        metrics.inc(
+                            "attention/flash_decode_paged_verify_int8")
+                    else:
+                        metrics.inc(
+                            "attention/flash_decode_paged_verify")
                     return out
                 # chunked prefill (page-sized sq) and other paged
                 # shapes fall through to the shared kv_cache_layout
@@ -299,14 +327,24 @@ def dot_product_attention(
                     # each row masks and block-skips against its OWN
                     # last valid position
                     out = fa.flash_decode_ragged(q, k, v, query_offset,
-                                                 bias=bias)
-                    metrics.inc("attention/flash_decode_ragged")
+                                                 bias=bias,
+                                                 k_scale=k_scale,
+                                                 v_scale=v_scale)
+                    if k_scale is not None:
+                        metrics.inc(
+                            "attention/flash_decode_ragged_int8")
+                    else:
+                        metrics.inc("attention/flash_decode_ragged")
                     return out
                 # cached decode: single query token, dynamic cache
                 # index — the kernel skips blocks past the index
                 out = fa.flash_decode(q, k, v, query_offset,
-                                      bias=bias)
-                metrics.inc("attention/flash_decode")
+                                      bias=bias, k_scale=k_scale,
+                                      v_scale=v_scale)
+                if k_scale is not None:
+                    metrics.inc("attention/flash_decode_int8")
+                else:
+                    metrics.inc("attention/flash_decode")
                 return out
             elif kv_cache_layout and causal and bias is None \
                     and 1 < q.shape[1] <= MAX_VERIFY_WINDOW \
@@ -314,8 +352,14 @@ def dot_product_attention(
                 # speculative k-token verify over the contiguous slot
                 # cache: window query j of row i masks keys
                 # <= query_offset[i] + j (within-window causal mask)
-                out = fa.flash_decode_ragged(q, k, v, query_offset)
-                metrics.inc("attention/flash_decode_ragged_verify")
+                out = fa.flash_decode_ragged(q, k, v, query_offset,
+                                             k_scale=k_scale,
+                                             v_scale=v_scale)
+                if k_scale is not None:
+                    metrics.inc(
+                        "attention/flash_decode_ragged_verify_int8")
+                else:
+                    metrics.inc("attention/flash_decode_ragged_verify")
                 return out
             # non-causal at short seq: the dense XLA batched matmul
             # beats the kernel (measured on ERNIE h=768/s=512/d=64:
@@ -345,6 +389,15 @@ def dot_product_attention(
         # awareness at all
         k = _gather_kv_pages(k, page_table)
         v = _gather_kv_pages(v, page_table)
+        if k_scale is not None:
+            k_scale = _gather_kv_pages(k_scale, page_table)
+            v_scale = _gather_kv_pages(v_scale, page_table)
+    if k_scale is not None:
+        # dense oracle for the int8 cache: widen up front with the
+        # same per-(row, head, position) scales the kernels apply
+        # in-VMEM, then attend exactly as bf16 would
+        k = (k.astype(jnp.float32) * k_scale).astype(q.dtype)
+        v = (v.astype(jnp.float32) * v_scale).astype(q.dtype)
     return _xla_attention(q, k, v, bias, causal, query_offset, dropout_rate,
                           dropout_rng, deterministic, softmax_in_fp32,
                           kv_cache_layout=kv_cache_layout)

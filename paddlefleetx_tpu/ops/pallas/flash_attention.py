@@ -1053,7 +1053,8 @@ def flash_attention_with_lse(q, k, v, causal: bool = True,
 
 
 def _decode_kernel(off_ref, q_ref, k_ref, v_ref, *refs, sm_scale,
-                   block_kv, num_kv, has_bias, ragged=False):
+                   block_kv, num_kv, has_bias, ragged=False,
+                   quantized=False):
     """Single-token decode over the fixed-capacity KV cache.
 
     Decode attention is a matvec, not a matmul — per (head, key-block)
@@ -1076,7 +1077,17 @@ def _decode_kernel(off_ref, q_ref, k_ref, v_ref, *refs, sm_scale,
     continuous-batching slot lengths) instead of one shared scalar —
     each batch row masks and block-skips against its OWN last valid
     position, so a short slot never pays a long slot's cache walk.
+
+    ``quantized``: the cache tiles are int8 and two extra operands
+    carry the per-(row, head, position) fp32 scales (``[h, 1, bkv]``
+    blocks riding the same index maps as K/V minus the d axis);
+    dequant happens HERE, on the VMEM-resident block — the widened
+    f32 copy never exists in HBM, so the streamed bytes stay int8.
     """
+    refs = list(refs)
+    if quantized:
+        ks_ref, vs_ref = refs[0], refs[1]
+        refs = refs[2:]
     if has_bias:
         bias_ref, o_ref, m_scr, l_scr, acc_scr = refs
     else:
@@ -1100,6 +1111,9 @@ def _decode_kernel(off_ref, q_ref, k_ref, v_ref, *refs, sm_scale,
         q = q_ref[0].astype(jnp.float32)           # [h, d, 1]
         k = k_ref[0].astype(jnp.float32)           # [h, d, bkv]
         v = v_ref[0].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0]                      # [h, 1, bkv] bcast
+            v = v * vs_ref[0]
         # every head in one vectorized pass — a per-head loop would
         # issue ~6x num_heads small VPU ops and dominate the call
         s = jnp.sum(q * k, axis=1) * sm_scale      # [h, bkv] f32
@@ -1123,9 +1137,9 @@ def _decode_kernel(off_ref, q_ref, k_ref, v_ref, *refs, sm_scale,
             o_ref.dtype)
 
 
-def _verify_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
-                   acc_scr, *, sm_scale, block_kv, num_kv, window,
-                   ragged=True):
+def _verify_kernel(off_ref, q_ref, k_ref, v_ref, *refs, sm_scale,
+                   block_kv, num_kv, window, ragged=True,
+                   quantized=False):
     """Speculative k-token VERIFY over the KV cache: ``window`` query
     tokens per row, where query ``j`` sits at cache position
     ``offset + j`` and attends keys ``<= offset + j`` — the
@@ -1142,7 +1156,16 @@ def _verify_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
     dead block arrives) and the running ``m/l/acc`` state passes
     through unchanged. Scratch carries one ``[h, 1]`` / ``[h, d]``
     state row per window position. No bias operand (serving decode
-    carries none — per-slot validity lives in the offsets)."""
+    carries none — per-slot validity lives in the offsets). With
+    ``quantized`` the int8 cache block dequantizes ONCE per resident
+    block (``[h, 1, bkv]`` fp32 scale operands, same contract as
+    :func:`_decode_kernel`) and all ``window`` queries share the
+    widened copy."""
+    refs = list(refs)
+    if quantized:
+        ks_ref, vs_ref = refs[0], refs[1]
+        refs = refs[2:]
+    o_ref, m_scr, l_scr, acc_scr = refs
     ki = pl.program_id(1)
     offset = off_ref[pl.program_id(0)] if ragged else off_ref[0]
 
@@ -1160,6 +1183,9 @@ def _verify_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
             jnp.int32, (1, block_kv), 1)
         k = k_ref[0].astype(jnp.float32)           # [h, d, bkv]
         v = v_ref[0].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0]                      # [h, 1, bkv] bcast
+            v = v * vs_ref[0]
         for j in range(window):
             live = k_pos <= offset + j             # [1, bkv]
             qj = q_ref[0, :, :, j].astype(jnp.float32)   # [h, d]
@@ -1182,15 +1208,41 @@ def _verify_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
         o_ref[0] = o.transpose(1, 2, 0).astype(o_ref.dtype)
 
 
-def _flash_decode_call(q, k, v, off, bias, block_kv: int, ragged: bool):
+def _check_kv_scales(k, v, k_scale, v_scale, h, skv):
+    """Admission for the int8-KV operand pair: scales come BOTH or
+    NEITHER, the cache must actually be int8, and each scale is the
+    cache minus its d axis (``[..., h, 1, S]`` fp32 — one scale per
+    (row, head, position), written by the cache-update path in
+    ``models/gpt/model.py``)."""
+    if (k_scale is None) != (v_scale is None):
+        raise NotImplementedError(
+            "int8 KV wants both k_scale and v_scale (or neither)")
+    if k_scale is None:
+        return False
+    if k.dtype != jnp.int8 or v.dtype != jnp.int8:
+        raise NotImplementedError(
+            f"KV scales given but cache is {k.dtype}/{v.dtype}, "
+            "not int8")
+    want = k.shape[:1] + (h, 1, skv)
+    if k_scale.shape != want or v_scale.shape != want:
+        raise NotImplementedError(
+            f"KV scales must be {want}, got {k_scale.shape} / "
+            f"{v_scale.shape}")
+    return True
+
+
+def _flash_decode_call(q, k, v, off, bias, block_kv: int, ragged: bool,
+                       k_scale=None, v_scale=None):
     """Shared shape-check + ``pallas_call`` builder behind
     :func:`flash_decode` (``off [1]``, one shared cache index) and
     :func:`flash_decode_ragged` (``off [b]``, per-slot lengths). With
     ``sq > 1`` the queries are a speculative VERIFY window — query
     ``j`` of row ``i`` sits at position ``off[i] + j`` and the
     windowed kernel (:func:`_verify_kernel`) applies the within-window
-    causal mask; bias is single-token only. Raises NotImplementedError
-    where the caller must fall back to XLA."""
+    causal mask; bias is single-token only. ``k_scale``/``v_scale``
+    (``[b, h, 1, S]`` fp32) switch the kernels to the int8-KV
+    dequant-in-kernel variants. Raises NotImplementedError where the
+    caller must fall back to XLA."""
     if jax.default_backend() != "tpu" and not _interpret():
         raise NotImplementedError("flash kernel targets TPU")
     b, sq, h, d = q.shape
@@ -1202,6 +1254,7 @@ def _flash_decode_call(q, k, v, off, bias, block_kv: int, ragged: bool):
             "verify window (sq > 1) takes no bias (per-slot validity "
             "is the offsets')")
     skv = k.shape[3]
+    quantized = _check_kv_scales(k, v, k_scale, v_scale, h, skv)
     # largest 128-aligned divisor <= block_kv: capacities that are
     # 128-multiples but not block_kv-multiples (e.g. 1280) stay on the
     # kernel instead of tripping the skv % block_kv rejection below
@@ -1251,6 +1304,15 @@ def _flash_decode_call(q, k, v, off, bias, block_kv: int, ragged: bool):
                                           kv_block(bi, ki, off))),
     ]
     operands = [qp, k, v]
+    if quantized:
+        # fp32 scale blocks ride the SAME clamped index maps as their
+        # K/V tiles (the d axis collapsed to 1), so a skipped block's
+        # scale copy is elided right along with it
+        for _ in range(2):
+            in_specs.append(pl.BlockSpec(
+                (1, h, 1, block_kv),
+                lambda bi, ki, off: (bi, 0, 0, kv_block(bi, ki, off))))
+        operands += [k_scale, v_scale]
     if bias is not None:
         # per-key additive bias (the generation loop's left-pad mask),
         # [b, skv] or broadcastable [b, 1, 1, skv]; a [1, bkv] row
@@ -1265,7 +1327,7 @@ def _flash_decode_call(q, k, v, off, bias, block_kv: int, ragged: bool):
         kernel = functools.partial(_decode_kernel, sm_scale=d ** -0.5,
                                    block_kv=block_kv, num_kv=num_kv,
                                    has_bias=bias is not None,
-                                   ragged=ragged)
+                                   ragged=ragged, quantized=quantized)
         scratch = [
             pltpu.VMEM((h, 1), jnp.float32),
             pltpu.VMEM((h, 1), jnp.float32),
@@ -1274,7 +1336,8 @@ def _flash_decode_call(q, k, v, off, bias, block_kv: int, ragged: bool):
     else:
         kernel = functools.partial(_verify_kernel, sm_scale=d ** -0.5,
                                    block_kv=block_kv, num_kv=num_kv,
-                                   window=window, ragged=ragged)
+                                   window=window, ragged=ragged,
+                                   quantized=quantized)
         scratch = [
             pltpu.VMEM((window, h, 1), jnp.float32),
             pltpu.VMEM((window, h, 1), jnp.float32),
@@ -1298,7 +1361,8 @@ def _flash_decode_call(q, k, v, off, bias, block_kv: int, ragged: bool):
 
 
 def flash_decode(q, k, v, query_offset, bias=None,
-                 block_kv: int = DEFAULT_BLOCK_KV):
+                 block_kv: int = DEFAULT_BLOCK_KV,
+                 k_scale=None, v_scale=None):
     """One decode step through the cache: ``q [b, 1, h, d]`` attends to
     ``k/v [b, h, d, S]`` positions ``<= query_offset`` (a traced
     scalar — the fixed-capacity cache index of ``models/gpt/model.py``).
@@ -1313,11 +1377,13 @@ def flash_decode(q, k, v, query_offset, bias=None,
     """
     off = jnp.reshape(jnp.asarray(query_offset, jnp.int32), (1,))
     return _flash_decode_call(q, k, v, off, bias, block_kv,
-                              ragged=False)
+                              ragged=False, k_scale=k_scale,
+                              v_scale=v_scale)
 
 
 def flash_decode_ragged(q, k, v, query_offsets, bias=None,
-                        block_kv: int = DEFAULT_BLOCK_KV):
+                        block_kv: int = DEFAULT_BLOCK_KV,
+                        k_scale=None, v_scale=None):
     """Per-row decode through the cache: row ``i`` of ``q [b, 1, h, d]``
     attends to ``k/v [b, h, d, S]`` positions ``<= query_offsets[i]``
     (a traced ``[b]`` int vector — the continuous-batching server's
@@ -1336,6 +1402,12 @@ def flash_decode_ragged(q, k, v, query_offsets, bias=None,
     pass scores a whole drafted token run. Inference-only; raises
     NotImplementedError where the caller must fall back to the XLA
     per-row-offset path (``ops/attention.py::_xla_attention``).
+
+    ``k_scale``/``v_scale`` (``[b, h, 1, S]`` fp32, one scale per
+    (slot, head, position)) switch both the single-token and the
+    verify-window kernel to their int8-KV dequant-in-kernel variants
+    — the cache streams as int8 and widens on the VMEM-resident
+    block (docs/quantization.md).
     """
     b = q.shape[0]
     offs = jnp.asarray(query_offsets, jnp.int32)
@@ -1343,7 +1415,8 @@ def flash_decode_ragged(q, k, v, query_offsets, bias=None,
         raise NotImplementedError(
             f"ragged offsets must be [b={b}], got {offs.shape}")
     return _flash_decode_call(q, k, v, offs, bias, block_kv,
-                              ragged=True)
+                              ragged=True, k_scale=k_scale,
+                              v_scale=v_scale)
 
 
 def _paged_decode_kernel(off_ref, pt_ref, *refs, **kw):
@@ -1366,7 +1439,8 @@ def _paged_verify_kernel(off_ref, pt_ref, *refs, **kw):
 
 
 def flash_decode_paged(q, k, v, query_offsets, page_table, bias=None,
-                       block_kv: int = DEFAULT_BLOCK_KV):
+                       block_kv: int = DEFAULT_BLOCK_KV,
+                       k_scale=None, v_scale=None):
     """Per-row decode through a PAGED KV pool: row ``i`` of
     ``q [b, 1, h, d]`` attends to positions ``<= query_offsets[i]`` of
     its logical cache, whose physical storage is scattered across the
@@ -1394,6 +1468,12 @@ def flash_decode_paged(q, k, v, query_offsets, page_table, bias=None,
     per-slot validity lives in the offsets). Raises
     NotImplementedError where the caller must fall back to the XLA
     gather path (``ops/attention.py::_gather_kv_pages``).
+
+    ``k_scale``/``v_scale`` (``[num_pages, h, 1, page_size]`` fp32
+    scale POOLS, page-parallel with the int8 K/V pools) switch both
+    the single-token and the verify-window kernel to their int8-KV
+    dequant-in-kernel variants; the scale blocks redirect through the
+    same page-table index map as their K/V tiles.
     """
     if jax.default_backend() != "tpu" and not _interpret():
         raise NotImplementedError("flash kernel targets TPU")
@@ -1411,6 +1491,7 @@ def flash_decode_paged(q, k, v, query_offsets, page_table, bias=None,
         raise NotImplementedError(
             f"paged pool must be [P, {h}, {d}, page], got {k.shape}")
     page = k.shape[3]
+    quantized = _check_kv_scales(k, v, k_scale, v_scale, h, page)
     offs = jnp.asarray(query_offsets, jnp.int32)
     if offs.ndim != 1 or offs.shape[0] != b:
         raise NotImplementedError(
@@ -1451,11 +1532,20 @@ def flash_decode_paged(q, k, v, query_offsets, page_table, bias=None,
         pl.BlockSpec((1, h, d, block_kv), kv_block),
         pl.BlockSpec((1, h, d, block_kv), kv_block),
     ]
+    operands = [qp, k, v]
+    if quantized:
+        # scale pools redirect through the SAME page-table index map
+        # as their K/V tiles (d axis collapsed to 1)
+        for _ in range(2):
+            in_specs.append(pl.BlockSpec((1, h, 1, block_kv),
+                                         kv_block))
+        operands += [k_scale, v_scale]
     if window == 1:
         kernel = functools.partial(_paged_decode_kernel,
                                    sm_scale=d ** -0.5,
                                    block_kv=block_kv, num_kv=num_kv,
-                                   has_bias=False, ragged=True)
+                                   has_bias=False, ragged=True,
+                                   quantized=quantized)
         scratch = [
             pltpu.VMEM((h, 1), jnp.float32),
             pltpu.VMEM((h, 1), jnp.float32),
@@ -1465,7 +1555,8 @@ def flash_decode_paged(q, k, v, query_offsets, page_table, bias=None,
         kernel = functools.partial(_paged_verify_kernel,
                                    sm_scale=d ** -0.5,
                                    block_kv=block_kv, num_kv=num_kv,
-                                   window=window, ragged=True)
+                                   window=window, ragged=True,
+                                   quantized=quantized)
         scratch = [
             pltpu.VMEM((window, h, 1), jnp.float32),
             pltpu.VMEM((window, h, 1), jnp.float32),
@@ -1484,5 +1575,5 @@ def flash_decode_paged(q, k, v, query_offsets, page_table, bias=None,
         ),
         out_shape=_sds((b, h, d, window), q.dtype, q),
         interpret=_interpret(),
-    )(offs, pt, qp, k, v)
+    )(offs, pt, *operands)
     return out.transpose(0, 3, 1, 2)

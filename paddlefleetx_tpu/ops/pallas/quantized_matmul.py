@@ -1,0 +1,170 @@
+"""Weight-only int8 matmul with per-output-channel scales, in Pallas.
+
+The serving decode path is weight-bandwidth-bound: every tick streams
+the full dense stack (qkv/out-proj/fc1/fc2) from HBM for a handful of
+query rows. Storing those kernels as int8 plus one fp32 scale per
+output channel halves the streamed bytes; this kernel keeps the
+matmul exact-to-rounding by dequantizing **inside the accumulation
+loop** — each int8 weight tile is widened to the activation dtype in
+VMEM right before the MXU dot, partial products accumulate in fp32
+scratch, and the per-channel scale (a ``[1, N]`` row held in VMEM for
+the whole grid) is applied once at the write-out, which is exact
+because a per-output-channel factor commutes with the K-sum.
+
+Layout: ``x [M, K]`` activations (bf16/f32), ``w [K, N]`` frozen int8
+weights, ``scale [N]`` fp32. Grid ``(M/bm, N/bn, K/bk)`` with the K
+axis innermost-sequential, fp32 VMEM accumulator per ``(bm, bn)``
+tile — the same structure as ``grouped_matmul.py``. The backward is
+wired through ``jax.custom_vjp``: dx reuses the forward kernel with
+the scale folded into the cotangent and the int8 weight transposed
+(``dx = (g · s) @ wqᵀ``); the weights are *frozen-quantized* (a PTQ
+artifact, not a trainable leaf), so dw is a symbolic zero — int8
+operands take ``float0`` cotangents, mirroring the ``counts`` leaf in
+``grouped_matmul``. Interpret mode (``PFX_PALLAS_INTERPRET=1``) lets
+the CPU suite validate kernel semantics (tests/test_quantized_matmul
+.py) without a TPU; shape admission raises ``NotImplementedError`` so
+dispatch sites fall back to the XLA dequantize-then-dot path
+(``quant/fallback/kernel_rejected`` — docs/quantization.md).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import _dot, _interpret, _sds
+from .grouped_matmul import _block
+
+
+def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc_scr, *, num_k):
+    """out = x @ dequant(w), scale applied at the final-ki write-out.
+
+    The int8 tile widens to the activation dtype in VMEM (the fused
+    dequant — no f32 weight copy ever exists in HBM); fp32 scratch
+    accumulates across the sequential ki axis."""
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    acc_scr[:] += _dot(x_ref[:], w_ref[:].astype(x_ref.dtype))
+
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        o_ref[:] = (acc_scr[:] * s_ref[:]).astype(o_ref.dtype)
+
+
+def _qmm_call(x, w, scale, block_m, block_n, block_k):
+    """One pallas_call: ``[M, K] @ int8 [K, N] * scale [N] ->
+    [M, N]`` in ``x.dtype``, accumulated in fp32."""
+    m_dim, k_dim = x.shape
+    n_dim = w.shape[1]
+    bm = _block(m_dim, block_m)
+    bn = _block(n_dim, block_n)
+    bk = _block(k_dim, block_k)
+    num_m, num_n, num_k = m_dim // bm, n_dim // bn, k_dim // bk
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(num_m, num_n, num_k),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda mi, ni, ki: (mi, ki)),
+            pl.BlockSpec((bk, bn), lambda mi, ni, ki: (ki, ni)),
+            pl.BlockSpec((1, bn), lambda mi, ni, ki: (0, ni)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda mi, ni, ki: (mi, ni)),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_qmm_kernel, num_k=num_k),
+        grid_spec=grid_spec,
+        out_shape=_sds((m_dim, n_dim), x.dtype, x),
+        interpret=_interpret(),
+    )(x, w, scale.astype(jnp.float32).reshape(1, n_dim))
+
+
+def _check_shapes(x, w, scale):
+    """Kernel admission: a ``NotImplementedError`` here sends the
+    dense site to its XLA dequantize-then-dot fallback (counted as
+    ``quant/fallback/kernel_rejected`` — docs/quantization.md)."""
+    if jax.default_backend() != "tpu" and not _interpret():
+        raise NotImplementedError("quantized_matmul needs TPU")
+    if x.ndim != 2 or w.ndim != 2 or scale.ndim != 1:
+        raise NotImplementedError(
+            f"quantized_matmul wants x[M,K] w[K,N] scale[N], got "
+            f"{x.shape} / {w.shape} / {scale.shape}")
+    if x.shape[1] != w.shape[0] or w.shape[1] != scale.shape[0]:
+        raise NotImplementedError(
+            f"quantized_matmul shape mismatch: x {x.shape}, "
+            f"w {w.shape}, scale {scale.shape}")
+    if w.dtype != jnp.int8:
+        raise NotImplementedError(
+            f"quantized_matmul wants int8 weights, got {w.dtype}")
+    m_dim, k_dim = x.shape
+    n_dim = w.shape[1]
+    # tiling floor: int8 wants (32, 128) tiles, activations (8, 128);
+    # _block() shrinks toward 1 but sub-tile blocks lower badly, so
+    # reject shapes the MXU can't tile instead of limping through
+    if m_dim % 8 or k_dim % 128 or n_dim % 128:
+        raise NotImplementedError(
+            f"quantized_matmul wants M%8==0, K%128==0, N%128==0; got "
+            f"M={m_dim} K={k_dim} N={n_dim}")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _quantized_matmul(x, w, scale, block_m, block_n, block_k):
+    return _qmm_call(x, w, scale, block_m, block_n, block_k)
+
+
+def _quantized_matmul_fwd(x, w, scale, block_m, block_n, block_k):
+    return (_qmm_call(x, w, scale, block_m, block_n, block_k),
+            (w, scale))
+
+
+def _quantized_matmul_bwd(block_m, block_n, block_k, res, g):
+    w, scale = res
+    # dx = (g * s) @ wqᵀ — the forward kernel with the per-channel
+    # scale folded into the cotangent (exact: s is per-N, the
+    # contraction axis of this product) and unit scales on the
+    # transposed int8 weight
+    gs = (g.astype(jnp.float32) * scale[None, :]).astype(g.dtype)
+    dx = _qmm_call(gs, jnp.swapaxes(w, 0, 1),
+                   jnp.ones((w.shape[0],), jnp.float32),
+                   block_m, block_k, block_n)
+    # frozen-quantized weights: int8 leaves take float0 cotangents and
+    # the scale is a calibration constant, not a trainable parameter
+    return (dx, np.zeros(w.shape, jax.dtypes.float0),
+            jnp.zeros_like(scale))
+
+
+_quantized_matmul.defvjp(_quantized_matmul_fwd, _quantized_matmul_bwd)
+
+
+def quantized_matmul(x: jax.Array, w: jax.Array, scale: jax.Array,
+                     block_m: int = 256, block_n: int = 256,
+                     block_k: int = 512) -> jax.Array:
+    """Weight-only int8 matmul ``out = x @ (w.astype(f32) * scale)``.
+
+    Args:
+      x: ``[M, K]`` activations (bf16/f32); M is the flattened
+        batch·sequence token count at a dense site.
+      w: ``[K, N]`` frozen int8 weights (a PTQ artifact —
+        ``core/quantize.py`` emits them on the QAT abs-max grid).
+      scale: ``[N]`` fp32 per-output-channel dequant scales, held in
+        VMEM for the whole grid.
+      block_m / block_n / block_k: tile targets (shrunk to divisors).
+
+    Returns ``[M, N]`` in ``x.dtype``, accumulated in fp32 with the
+    int8→activation-dtype widening fused into the K loop and the
+    scale applied once at write-out (exact — per-output-channel
+    factors commute with the K-sum). The custom VJP computes dx
+    through the same kernel; dw/dscale are symbolic zeros (weights
+    are frozen-quantized).
+    """
+    _check_shapes(x, w, scale)
+    return _quantized_matmul(x, w, scale, block_m, block_n, block_k)

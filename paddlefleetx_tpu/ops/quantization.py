@@ -41,8 +41,19 @@ class QuantizationConfig:
 
     @classmethod
     def from_config(cls, config) -> "QuantizationConfig":
+        """Build from a YAML ``Quantization`` section, warning on (and
+        dropping) keys that no field matches."""
         section = dict(config.get("Quantization", {}) or {})
         fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(section) - fields)
+        if unknown:
+            # a typo here silently trains WITHOUT quantization (the
+            # reference's paddleslim would have raised) — warn loudly
+            from ..utils.log import logger
+            logger.warning(
+                "Quantization config keys %s are not recognized and "
+                "will be ignored (known keys: %s)", unknown,
+                sorted(fields))
         return cls(**{k: v for k, v in section.items() if k in fields})
 
 

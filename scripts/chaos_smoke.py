@@ -20,10 +20,22 @@ no replayed step windows); the resumed loss curve is IDENTICAL to the
 baseline from the restore point on; the resumed event log contains no
 ``ckpt_fallback`` (the kill landed between saves, so the newest
 checkpoint must verify). Exit 0 on success, 1 with a diagnosis on any
-violation. Run from the repo root:
+violation.
+
+With ``--ptq`` a fourth leg runs ``scripts/quantize_checkpoint.py``
+on the resumed output and drills the quantized artifact the same way
+the training checkpoints are drilled: the int8 checkpoint must
+verify, a single flipped byte in a payload file (the fp32
+``kernel_scale`` arrays ride in the same ocdbt payload as the int8
+kernels) must fail manifest verification AND drop the step dir out of
+``latest_checkpoint`` (the resume fallback path), and restoring the
+byte must verify again — proving the scale arrays are covered as
+payload, not sidecar metadata (docs/quantization.md). Run from the
+repo root:
 
   python scripts/chaos_smoke.py [--workdir DIR] [--steps 12]
                                 [--kill-step 7] [--save-steps 4]
+                                [--ptq]
 """
 
 import argparse
@@ -182,6 +194,71 @@ def fail(msg):
     sys.exit(1)
 
 
+def ptq_leg(work, chaos_out, cfg_path):
+    """Quantize the resumed checkpoint and drill the int8 artifact:
+    byte-flip a scale payload -> verify fails and latest_checkpoint
+    falls back; restore the byte -> verifies again."""
+    ptq_out = os.path.join(work, "ptq_out")
+    cmd = [sys.executable,
+           os.path.join(REPO, "scripts", "quantize_checkpoint.py"),
+           "--checkpoint", chaos_out, "--output", ptq_out,
+           "--config", cfg_path, "--max-rel-dev", "0.05"]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(cmd, cwd=REPO, env=env, timeout=600,
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True)
+    sys.stdout.write(f"--- ptq run: rc={proc.returncode} ---\n")
+    if proc.returncode != 0:
+        sys.stdout.write(proc.stdout[-4000:] + "\n")
+        fail(f"quantize_checkpoint.py exited {proc.returncode}")
+    if "QUANTIZE CHECKPOINT OK" not in proc.stdout:
+        fail("quantize run missing its OK line")
+
+    sys.path.insert(0, REPO)
+    from paddlefleetx_tpu.core.checkpoint import (
+        latest_checkpoint, verify_checkpoint,
+    )
+    step_dir = latest_checkpoint(ptq_out)
+    if step_dir is None:
+        fail(f"no verified quantized checkpoint under {ptq_out}")
+
+    # pick a payload file holding the fp32 kernel scales if the store
+    # names arrays in its paths, else the largest non-manifest payload
+    payload = [os.path.join(root, name)
+               for root, _, files in os.walk(step_dir)
+               for name in files if name != "pfx_manifest.json"]
+    if not payload:
+        fail(f"quantized step dir {step_dir} holds no payload files")
+    scales = [p for p in payload
+              if "kernel_scale" in os.path.relpath(p, step_dir)]
+    target = max(scales or payload, key=os.path.getsize)
+
+    size = os.path.getsize(target)
+    with open(target, "r+b") as f:
+        f.seek(size // 2)
+        orig = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([orig[0] ^ 0xFF]))
+    rel = os.path.relpath(target, step_dir)
+    reason = verify_checkpoint(step_dir)
+    if reason is None:
+        fail(f"flipped byte in {rel} still passed verification — "
+             f"scale arrays are not covered as payload")
+    if latest_checkpoint(ptq_out) == step_dir:
+        fail(f"latest_checkpoint still resolves the corrupted "
+             f"{step_dir} (resume would load a torn artifact)")
+    with open(target, "r+b") as f:
+        f.seek(size // 2)
+        f.write(orig)
+    if verify_checkpoint(step_dir) is not None:
+        fail(f"restored byte in {rel} no longer verifies")
+    sys.stdout.write(
+        f"PTQ LEG OK: quantized {os.path.basename(chaos_out)} -> "
+        f"{step_dir}; corrupting {rel} failed verify and fallback "
+        f"skipped it; restored artifact verifies\n")
+
+
 def main():
     """Run the baseline/chaos/resume triple and assert continuity."""
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -189,6 +266,9 @@ def main():
     ap.add_argument("--steps", type=int, default=12)
     ap.add_argument("--kill-step", type=int, default=7)
     ap.add_argument("--save-steps", type=int, default=4)
+    ap.add_argument("--ptq", action="store_true",
+                    help="also PTQ the resumed checkpoint and drill "
+                         "the int8 artifact's manifest verification")
     args = ap.parse_args()
 
     work = args.workdir or tempfile.mkdtemp(prefix="pfx_chaos_")
@@ -255,6 +335,10 @@ def main():
                 if res_losses[s] != base_losses[s]}
     if diverged:
         fail(f"resumed loss curve diverged from baseline: {diverged}")
+
+    # 4. optional: PTQ the resumed checkpoint, drill the artifact
+    if args.ptq:
+        ptq_leg(work, chaos_out, cfg_path)
 
     sys.stdout.write(
         f"CHAOS SMOKE OK: killed at step {args.kill_step}, restored "

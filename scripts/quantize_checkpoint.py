@@ -1,0 +1,163 @@
+"""PTQ a trained GPT checkpoint to weight-only int8, offline.
+
+Reads the newest VERIFIED ``epoch_*_step_*`` checkpoint under
+``--checkpoint`` (or an explicit step dir), rewrites its parameter
+tree into the ``quant_execution="weight_only_int8"`` storage format
+(``core/quantize.py``: int8 ``kernel`` + fp32 per-output-channel
+``kernel_scale`` at every dense site, everything else untouched), and
+writes it as a NEW manifest-verified checkpoint under ``--output`` —
+same ``epoch_E_step_S`` layout, so ``latest_checkpoint`` /
+``load_checkpoint`` and the serving loaders consume it unchanged.
+The optimizer state is dropped: quantized kernels are frozen
+inference artifacts (their VJP is a symbolic zero —
+``ops/pallas/quantized_matmul.py``).
+
+With ``--config`` (the training YAML) the script also builds the
+model pair and runs a deterministic synthetic seed batch through
+both: the fp forward records per-module activation abs-max into the
+checkpoint meta (the QAT moving-average statistic at its per-batch
+fixed point), and the quantized forward bounds the logit deviation —
+printed, stored in meta, and enforced by ``--max-rel-dev`` when set.
+Workflow docs: docs/quantization.md. Run from the repo root:
+
+  python scripts/quantize_checkpoint.py \\
+      --checkpoint out/ --output out_int8/ [--config cfg.yaml] \\
+      [--calib-batch 4 --calib-seqlen 32] [--max-rel-dev 0.05]
+"""
+
+import argparse
+import os
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def fail(msg):
+    """Print the diagnosis and exit nonzero."""
+    sys.stdout.write(f"QUANTIZE CHECKPOINT FAILED: {msg}\n")
+    sys.exit(1)
+
+
+def load_raw_state(path):
+    """Restore ``(state, meta)`` exactly as saved (host arrays, no
+    sharding template) — PTQ is tree surgery, not a mesh restore."""
+    import orbax.checkpoint as ocp
+    with ocp.Checkpointer(ocp.CompositeCheckpointHandler()) as ckptr:
+        restored = ckptr.restore(
+            path, args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(),
+                meta=ocp.args.JsonRestore()))
+    return restored.state, restored.meta or {}
+
+
+def main():
+    """Resolve, verify, quantize, (optionally) calibrate, save."""
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--checkpoint", required=True,
+                    help="checkpoint root or explicit step dir")
+    ap.add_argument("--output", required=True,
+                    help="directory for the quantized step dir")
+    ap.add_argument("--config", default=None,
+                    help="training YAML; enables seed-batch "
+                         "calibration + logit-deviation validation")
+    ap.add_argument("--calib-batch", type=int, default=4)
+    ap.add_argument("--calib-seqlen", type=int, default=32)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--max-rel-dev", type=float, default=None,
+                    help="fail when quantized logits deviate more "
+                         "than this relative to fp logits")
+    args = ap.parse_args()
+
+    from paddlefleetx_tpu.core.checkpoint import (
+        _STEP_DIR, latest_checkpoint, save_checkpoint,
+        verify_checkpoint,
+    )
+    from paddlefleetx_tpu.core.quantize import (
+        calibrate_activation_absmax, quantization_meta,
+        quantize_param_tree,
+    )
+
+    src = latest_checkpoint(args.checkpoint)
+    if src is None:
+        fail(f"no verified checkpoint under {args.checkpoint}")
+    reason = verify_checkpoint(src)
+    if reason is not None:
+        fail(f"{src} failed verification: {reason}")
+    m = _STEP_DIR.search(src)
+    epoch, step = (int(m.group(1)), int(m.group(2))) if m else (0, 0)
+
+    state, meta = load_raw_state(src)
+    if "params" not in state:
+        fail(f"{src} holds no 'params' subtree (keys: "
+             f"{sorted(state)})")
+    qparams, report = quantize_param_tree(state["params"])
+    if not report:
+        fail("no quantizable dense-site kernels found — is this a "
+             "GPT checkpoint?")
+    for row in report:
+        sys.stdout.write(
+            f"  quantized {row['path']} {row['shape']} "
+            f"({row['bytes_fp']} -> {row['bytes_int8']} bytes)\n")
+
+    calibration = None
+    deviation = None
+    if args.config:
+        import jax
+        import jax.numpy as jnp
+        from paddlefleetx_tpu.models.gpt.config import GPTConfig
+        from paddlefleetx_tpu.models.gpt.model import (
+            GPTForPretraining, GPTModel,
+        )
+        from paddlefleetx_tpu.utils.config import get_config
+        cfg = get_config(args.config)
+        mcfg = GPTConfig.from_config(cfg)
+        qcfg = GPTConfig(**{**mcfg.__dict__,
+                            "quant_execution": "weight_only_int8"})
+        # engine checkpoints carry the pretraining wrapper's scope
+        # ("gpt/..."); bare GPTModel trees start at "embeddings"
+        cls = GPTForPretraining if "gpt" in state["params"] else GPTModel
+        ids = jax.random.randint(
+            jax.random.PRNGKey(args.seed),
+            (args.calib_batch, args.calib_seqlen), 0,
+            mcfg.vocab_size)
+        base = cls(mcfg).apply({"params": state["params"]}, ids)
+        calibration = calibrate_activation_absmax(
+            cls(mcfg), state["params"], ids)
+        quant = cls(qcfg).apply({"params": qparams}, ids)
+        err = float(jnp.max(jnp.abs(
+            base.astype(jnp.float32) - quant.astype(jnp.float32))))
+        denom = max(float(jnp.max(jnp.abs(base))), 1e-8)
+        deviation = {"max_abs": err, "max_rel": err / denom}
+        sys.stdout.write(
+            f"  seed-batch logit deviation: abs {err:.5f} "
+            f"rel {err / denom:.5f}\n")
+        if args.max_rel_dev is not None \
+                and deviation["max_rel"] > args.max_rel_dev:
+            fail(f"quantized logits deviate {deviation['max_rel']:.5f}"
+                 f" > --max-rel-dev {args.max_rel_dev}")
+
+    qmeta = dict(meta)
+    qmeta["quantization"] = quantization_meta(report, calibration)
+    if deviation is not None:
+        qmeta["quantization"]["seed_batch_deviation"] = deviation
+    new_state = {"params": qparams}
+    if "step" in state:
+        new_state["step"] = state["step"]
+    dropped = sorted(set(state) - set(new_state))
+    if dropped:
+        sys.stdout.write(f"  dropping {dropped} (frozen inference "
+                         f"artifact)\n")
+    path = save_checkpoint(args.output, epoch, step, new_state, qmeta)
+    reason = verify_checkpoint(path)
+    if reason is not None:
+        fail(f"freshly saved {path} failed verification: {reason}")
+    sys.stdout.write(
+        f"QUANTIZE CHECKPOINT OK: {src} -> {path} "
+        f"({len(report)} sites)\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -708,6 +708,70 @@ def test_bench_serving_env_knobs_pin_trace(monkeypatch, capsys):
     assert rec2["decode_ticks"] == first_ticks
 
 
+def test_bench_serving_kv_dtype_ab_record(monkeypatch, capsys):
+    """PFX_BENCH_SERVING_KV_DTYPE=int8 adds ONE A/B record ahead of
+    the headline: the same trace served from an int8 pool resized to
+    the bf16 pool's byte budget, reporting slots_admitted /
+    slot_ratio density accounting (docs/quantization.md). The bf16
+    headline and spec record keep their pinned last-two positions
+    and their values' provenance (the knob must not perturb them)."""
+    from paddlefleetx_tpu.core.paging import pool_bytes
+    monkeypatch.setenv("PFX_BENCH_SERVING_LOOP_TICKS", "1")
+    monkeypatch.setenv("PFX_BENCH_SERVING_REQUESTS", "3")
+    monkeypatch.setenv("PFX_BENCH_SERVING_MAX_PROMPT", "8")
+    monkeypatch.setenv("PFX_BENCH_SERVING_DEC_LEN", "4")
+    monkeypatch.setenv("PFX_BENCH_SERVING_SPEC", "1")
+    monkeypatch.setenv("PFX_BENCH_SERVING_KV_DTYPE", "int8")
+    bench.bench_serving()
+    lines = capsys.readouterr().out.strip().splitlines()
+    recs = [json.loads(ln) for ln in lines if ln.startswith("{")]
+    kv, rec, spec = recs[-3], recs[-2], recs[-1]
+    # pinned positions: headline second-to-last, spec last
+    assert rec["metric"] == bench.METRIC_BY_MODE["serving"]
+    assert spec["metric"] == \
+        "gpt345m_serving_spec_decode_tokens_per_sec_per_chip"
+    # the A/B record rides ahead of them
+    assert kv["metric"] == \
+        "gpt345m_serving_decode_tokens_per_sec_per_chip_kv_int8"
+    assert kv["kv_cache_dtype"] == "int8"
+    assert kv["value"] > 0 and kv["unit"] == "tokens/s"
+    assert kv["requests"] == rec["requests"]
+    assert kv["seed"] == rec["seed"]
+    # byte-matched pools: the int8 pool's budget is the bf16 pool's
+    # bytes, and it packs more pages on them
+    assert kv["pool_bytes"] == pool_bytes(
+        2, 4, 16, rec["page_size"], rec["pool_pages"], "bf16")
+    assert kv["pool_pages"] > rec["pool_pages"]
+    assert kv["slots_admitted"] >= kv["slots_admitted_bf16"] >= 1
+    assert kv["slot_ratio"] >= 1.0
+    # headline untouched by the knob (bf16 record has no kv fields)
+    assert "kv_cache_dtype" not in rec
+    assert rec["value"] > 0
+
+
+def test_bench_serving_kv_dtype_off_by_default_and_unpaged(
+        monkeypatch, capsys):
+    """No knob -> no A/B record; knob + PAGED=0 -> also no record
+    (the density story is the paged pool's — a contiguous cache has
+    no byte-matched resize to report)."""
+    monkeypatch.setenv("PFX_BENCH_SERVING_LOOP_TICKS", "1")
+    monkeypatch.setenv("PFX_BENCH_SERVING_REQUESTS", "3")
+    monkeypatch.setenv("PFX_BENCH_SERVING_MAX_PROMPT", "8")
+    monkeypatch.setenv("PFX_BENCH_SERVING_DEC_LEN", "4")
+    monkeypatch.setenv("PFX_BENCH_SERVING_SPEC", "0")
+    monkeypatch.delenv("PFX_BENCH_SERVING_KV_DTYPE", raising=False)
+    bench.bench_serving()
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert not any("_kv_int8" in ln for ln in lines)
+    monkeypatch.setenv("PFX_BENCH_SERVING_KV_DTYPE", "int8")
+    monkeypatch.setenv("PFX_BENCH_SERVING_PAGED", "0")
+    bench.bench_serving()
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert not any("_kv_int8" in ln for ln in lines)
+    assert json.loads(lines[-1])["metric"] == \
+        bench.METRIC_BY_MODE["serving"]
+
+
 # -- observability wiring (flight recorder, probe stderr tails) --------
 
 

@@ -76,6 +76,40 @@ def test_stacked_kernel_per_layer_scale():
     np.testing.assert_allclose(np.asarray(shared[1]), 0.0)
 
 
+def test_from_config_warns_on_unknown_keys():
+    """A typo'd Quantization key silently trains WITHOUT quantization
+    (the reference's paddleslim would have raised) — from_config must
+    warn loudly, naming the bad keys, and still build from the good
+    ones."""
+    import logging
+
+    from paddlefleetx_tpu.utils.log import logger
+
+    lines = []
+    h = logging.Handler()
+    h.emit = lambda rec: lines.append(rec.getMessage())
+    logger.addHandler(h)
+    try:
+        cfg = QuantizationConfig.from_config(
+            {"Quantization": {"enable": True, "wieght_bits": 4,
+                              "onnx_format": True}})
+    finally:
+        logger.removeHandler(h)
+    assert cfg.enable and cfg.weight_bits == 8  # typo ignored
+    text = "\n".join(lines)
+    assert "wieght_bits" in text and "onnx_format" in text
+    assert "not recognized" in text
+    # a clean section stays silent
+    lines.clear()
+    logger.addHandler(h)
+    try:
+        QuantizationConfig.from_config(
+            {"Quantization": {"enable": True, "weight_bits": 8}})
+    finally:
+        logger.removeHandler(h)
+    assert not lines
+
+
 def test_qat_gpt_trains(tmp_path):
     """QAT-enabled GPT through the engine: loss finite and decreasing,
     quantized forward close to the fp forward."""

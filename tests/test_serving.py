@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from paddlefleetx_tpu.core.paging import pool_bytes
 from paddlefleetx_tpu.core.serving import (
     GenerationServer, RequestShed, default_prefill_buckets,
 )
@@ -1686,6 +1687,122 @@ def test_device_loop_serving_smoke_interpret_kernel(model_and_params,
         assert "serving_admit" in kinds and "serving_evict" in kinds
         start = json.loads(events.read_text().splitlines()[0])
         assert start["loop_ticks"] == 4
+    finally:
+        metrics.set_enabled(False)
+        reg.reset()
+
+
+# -- int8 KV cache -----------------------------------------------------
+#
+# kv_cache_dtype="int8" swaps the decode cache storage (int8 K/V +
+# per-token fp32 scales, dequant-in-kernel — docs/quantization.md) and
+# NOTHING else: the acceptance bar is the bf16 parity matrices passing
+# unchanged, greedy token-exact against the bf16 lockstep reference.
+
+ICFG = GPTConfig(**{**CFG.__dict__, "kv_cache_dtype": "int8"})
+
+
+@pytest.mark.parametrize("num_slots,order", [
+    (2, [5, 4, 3, 2, 1, 0]),        # reversed admission
+    (6, list(range(6))),            # everything admitted at once
+])
+def test_int8_kv_parity_matrix_greedy(model_and_params, num_slots,
+                                      order):
+    """Spec-off greedy parity matrix under the int8 KV cache: every
+    served completion equals the BF16 lockstep row — per-token abs-max
+    KV quantization is argmax-invisible."""
+    model, params = model_and_params
+    gen_cfg = _greedy_cfg()
+    ref = _lockstep(model, params, PROMPTS, gen_cfg)
+    srv = GenerationServer(GPTForPretraining(ICFG), params, gen_cfg,
+                           num_slots=num_slots)
+    comps = srv.run([PROMPTS[i] for i in order])
+    assert [c.tokens for c in comps] == [ref[i] for i in order]
+
+
+def test_int8_kv_spec_parity_greedy(model_and_params):
+    """Spec-on greedy under int8 KV: drafting, the k+1 verify window,
+    and rejected-token rollback all read the quantized cache — tokens
+    still match the bf16 spec-OFF lockstep reference."""
+    model, params = model_and_params
+    gen_cfg = _greedy_cfg()
+    ref = _lockstep(model, params, PROMPTS, gen_cfg)
+    srv = GenerationServer(GPTForPretraining(ICFG), params,
+                           _spec_cfg(gen_cfg, 3), num_slots=2)
+    comps = srv.run(PROMPTS)
+    assert [c.tokens for c in comps] == ref
+
+
+@pytest.mark.parametrize("strategy", ["greedy", "sampling"])
+def test_int8_kv_device_loop_t16_parity(model_and_params, strategy):
+    """T=16 fused decode loop under int8 KV == the T=1 int8 server ==
+    (greedy) the bf16 lockstep rows: multi-token quantized cache
+    writes inside the loop body are tick-order invariant."""
+    model, params = model_and_params
+    if strategy == "greedy":
+        gen_cfg = _greedy_cfg()
+    else:
+        gen_cfg = GenerationConfig(
+            max_dec_len=8, decode_strategy="sampling", top_k=8,
+            top_p=0.9, temperature=0.7, eos_token_id=EOS,
+            pad_token_id=PAD)
+    imodel = GPTForPretraining(ICFG)
+    ref, _ = _loop_run(imodel, params, gen_cfg, 1)
+    out, summ = _loop_run(imodel, params, gen_cfg, 16)
+    assert out == ref
+    if strategy == "greedy":
+        assert ref == [
+            r for r in _lockstep(model, params, PROMPTS, gen_cfg)]
+
+
+def test_paged_int8_kv_spec_serving_smoke_interpret_kernel(
+        paged512_model_and_params, tmp_path):
+    """CI smoke (`-k smoke`), int8-KV edition: a SHARED-PREFIX paged
+    pool in int8 with the interpret-mode dequant-in-kernel VERIFY
+    kernel (`attention/flash_decode_paged_verify_int8`) carrying the
+    speculative ticks, COW prefix pages (values AND scales) shared
+    across rows, greedy parity vs the bf16 lockstep rows, and the
+    drained pool whole."""
+    model, params = paged512_model_and_params
+    kcfg = GPTConfig(**{**PCFG512.__dict__,
+                        "use_flash_attention": True,
+                        "kv_cache_dtype": "int8"})
+    imodel = GPTForPretraining(kcfg)
+    gen_cfg = _greedy_cfg(max_dec=4)
+    rng = np.random.default_rng(9)
+    sys_prompt = rng.integers(0, EOS, 130).tolist()
+    p_shared = sys_prompt[:128] + rng.integers(0, EOS, 40).tolist()
+    prompts = [sys_prompt, p_shared]
+    ref = _lockstep(model, params, prompts, gen_cfg)
+    events = tmp_path / "events.jsonl"
+    metrics.set_enabled(True)
+    reg = metrics.get_registry()
+    reg.reset()
+    try:
+        srv = GenerationServer(imodel, params,
+                               _spec_cfg(gen_cfg, 3), num_slots=2,
+                               page_size=128, pool_pages=12,
+                               prefill_chunk_pages=1,
+                               events_path=str(events))
+        done = {}
+        ids = [srv.submit(sys_prompt)]
+        for _ in range(2):            # sys prompt's pages registered
+            for c in srv.step():
+                done[c.request_id] = c
+        ids.append(srv.submit(p_shared))
+        _drain(srv, done)
+        assert [done[i].tokens for i in ids] == ref
+        assert reg.counter(
+            "attention/flash_decode_paged_verify_int8") >= 1
+        assert reg.counter("attention/flash_decode_paged_verify") == 0
+        assert srv._alloc.stats["prefix_hits"] >= 1
+        summ = srv.summary()
+        assert summ["kv_cache_dtype"] == "int8"
+        assert summ["pool_bytes"] == pool_bytes(
+            kcfg.num_layers, kcfg.num_attention_heads, kcfg.head_dim,
+            128, 12, "int8")
+        srv._alloc.check()
+        assert srv._alloc.pages_in_use == 0
     finally:
         metrics.set_enabled(False)
         reg.reset()
