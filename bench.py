@@ -26,6 +26,12 @@ mixed-length request trace (``PFX_BENCH_SERVING_*`` knobs) in decode
 tokens/s/chip — the throughput the lockstep ``--mode generation``
 number forfeits by running every request at the batch's slowest pace.
 
+``--mode fleet`` benchmarks the multi-replica FleetRouter
+(core/fleet.py) on a seeded mixed-prefix trace — a few shared "system
+prompts" fanned out across many requests — against a same-chips
+single server with the summed slot count, emitting the A/B rows
+(``PFX_BENCH_FLEET_*`` knobs).
+
 ``--mode moe`` benchmarks the 8-expert top-2 MoE variant of the 345M
 geometry (models/gpt/moe.py; no reference analogue — it has no MoE).
 Reported MFU counts ACTIVE FLOPs (top-2 of 8 experts ≈ 2x the dense
@@ -60,6 +66,7 @@ METRIC_BY_MODE = {
     "moe": "gpt345m_moe8_top2_pretrain_tokens_per_sec_per_chip",
     "generation": "gpt345m_generation_decode_tokens_per_sec",
     "serving": "gpt345m_serving_decode_tokens_per_sec_per_chip",
+    "fleet": "gpt345m_fleet_2replica_decode_tokens_per_sec_per_chip",
     "convergence": "gpt345m_convergence_loss_at_300",
     "67b": "gpt3_6p7b_geometry_mfu",
     "longctx": "gpt345m_long_context_s8192_mfu",
@@ -1383,6 +1390,142 @@ def bench_serving():
         print(json.dumps(spec_result))
 
 
+def bench_fleet():
+    """``--mode fleet``: multi-replica router decode tokens/s/chip.
+
+    A :class:`FleetRouter` (core/fleet.py) over
+    ``PFX_BENCH_FLEET_REPLICAS`` paged GenerationServer replicas
+    serves a seeded mixed-prefix trace: ``_PREFIXES`` shared "system
+    prompts" of ``_PREFIX_LEN`` tokens, each request adding a short
+    per-user tail — the workload shape prefix-affinity routing exists
+    for (millions of users, a few thousand prefixes).  With
+    ``PFX_BENCH_FLEET_PREFILL_SPLIT=1`` the first replica takes the
+    prefill role and hands finished KV pages to the decode replicas
+    (the disaggregated regime).  Trace knobs: ``_REQUESTS`` /
+    ``_SLOTS`` (per replica) / ``_DEC_LEN`` / ``_SEED``.
+
+    Two records, the A/B the ISSUE pins: first a same-chips
+    single-server baseline — ONE server with the summed slot count
+    (and the server's matching default pool) on the identical trace —
+    then the fleet headline with aggregate committed tokens/s
+    (replicas tick sequentially on the same host/chips, so the
+    aggregate divides summed tokens by SUMMED decode time — the
+    honest same-chips number) plus the fleet-level
+    ``fleet_ttft_p99_ms`` percentile and the router counters."""
+    from paddlefleetx_tpu.core.fleet import FleetRouter
+    from paddlefleetx_tpu.core.serving import GenerationServer
+    from paddlefleetx_tpu.models.gpt.generation import GenerationConfig
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = _gpt345m(True)
+        d_req, d_slots, d_dec = 32, 8, 128
+        prefix_len, tail_max, n_prefixes = 256, 128, 4
+    else:  # offline smoke: the machinery, not the 345M numbers
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_attention_heads=4,
+                        max_position_embeddings=256,
+                        hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+        d_req, d_slots, d_dec = 6, 2, 8
+        prefix_len, tail_max, n_prefixes = 128, 16, 2
+    page_size = 128
+    replicas = int(os.environ.get("PFX_BENCH_FLEET_REPLICAS", "2"))
+    split = bool(int(os.environ.get("PFX_BENCH_FLEET_PREFILL_SPLIT",
+                                    "0")))
+    n_requests = int(os.environ.get("PFX_BENCH_FLEET_REQUESTS", d_req))
+    num_slots = int(os.environ.get("PFX_BENCH_FLEET_SLOTS", d_slots))
+    dec_len = int(os.environ.get("PFX_BENCH_FLEET_DEC_LEN", d_dec))
+    seed = int(os.environ.get("PFX_BENCH_FLEET_SEED", "0"))
+    model = GPTForPretraining(cfg)
+    rng = np.random.default_rng(seed)
+    prefixes = [rng.integers(0, cfg.vocab_size - 2,
+                             prefix_len).tolist()
+                for _ in range(n_prefixes)]
+    prompts = []
+    for i in range(n_requests):
+        tail = rng.integers(
+            0, cfg.vocab_size - 2,
+            int(rng.integers(1, tail_max + 1))).tolist()
+        prompts.append(prefixes[i % n_prefixes] + tail)
+    params = jax.jit(model.init)(
+        {"params": jax.random.key(0)},
+        jnp.asarray(prompts[0], jnp.int32)[None])["params"]
+    gen_cfg = GenerationConfig(
+        max_dec_len=dec_len, decode_strategy="sampling", top_k=50,
+        top_p=0.75, eos_token_id=cfg.vocab_size - 1,
+        pad_token_id=cfg.vocab_size - 1)
+
+    def _mk(slots):
+        return GenerationServer(model, params, gen_cfg,
+                                num_slots=slots,
+                                rng=jax.random.key(seed + 1),
+                                page_size=page_size,
+                                prefill_chunk_pages=1)
+
+    def _measure(run, summarize):
+        """Warm pass then an identical measured pass; committed
+        tokens/s from the decode-time deltas."""
+        run()
+        warm = summarize()
+        run()
+        total = summarize()
+        tokens = total["decode_tokens"] - warm["decode_tokens"]
+        dt = total["decode_time_sec"] - warm["decode_time_sec"]
+        return tokens / dt if dt > 0 else 0.0, total
+
+    # -- same-chips baseline: one server, summed slot count ----------
+    base = _mk(num_slots * replicas)
+    base_tps, base_total = _measure(lambda: base.run(prompts),
+                                    base.summary)
+    common = {
+        "unit": "tokens/s",
+        "vs_baseline": None,   # the reference has no fleet path
+        "requests": n_requests,
+        "prompt_prefixes": n_prefixes,
+        "prefix_len": prefix_len,
+        "max_dec_len": dec_len,
+        "seed": seed,
+        "page_size": page_size,
+    }
+    base_rec = {
+        "metric": "gpt345m_fleet_single_server_baseline_decode"
+                  "_tokens_per_sec_per_chip",
+        "value": round(base_tps, 1),
+        **common,
+        "slots": num_slots * replicas,
+        "ttft_p50_ms": base_total.get("ttft_p50_ms", 0.0),
+        "ttft_p99_ms": base_total.get("ttft_p99_ms", 0.0),
+    }
+    _log_success(base_rec)
+    print(json.dumps(base_rec))
+
+    # -- the fleet row ------------------------------------------------
+    fleet = FleetRouter(lambda name: _mk(num_slots), replicas,
+                        prefill_replicas=1 if split else 0)
+    fleet_tps, fleet_total = _measure(lambda: fleet.run(prompts),
+                                      fleet.summary)
+    result = {
+        "metric": METRIC_BY_MODE["fleet"],
+        "value": round(fleet_tps, 1),
+        **common,
+        "replicas": replicas,
+        "prefill_split": split,
+        "slots_per_replica": num_slots,
+        "fleet_ttft_p50_ms": fleet_total.get("ttft_p50_ms", 0.0),
+        "fleet_ttft_p99_ms": fleet_total.get("ttft_p99_ms", 0.0),
+        "routed_affinity": fleet_total["routed_affinity"],
+        "routed_least_depth": fleet_total["routed_least_depth"],
+        "handoffs": fleet_total["handoffs"],
+        "shed": fleet_total["shed"],
+        "baseline_single_server_tokens_per_sec": round(base_tps, 1),
+        "speedup_vs_single_server": round(fleet_tps / base_tps, 3)
+        if base_tps > 0 else None,
+    }
+    _log_success(result)
+    print(json.dumps(result))
+    fleet.close()
+
+
 def _zipf_markov_corpus(vocab: int, n_tokens: int, seq: int,
                         seed: int = 0, s: float = 1.1,
                         p_rep: float = 0.5):
@@ -1533,8 +1676,8 @@ def main():
     """Parse --mode, acquire the backend, run the selected bench."""
     p = argparse.ArgumentParser()
     p.add_argument("--mode",
-                   choices=["train", "generation", "serving", "moe",
-                            "convergence", "67b", "longctx"],
+                   choices=["train", "generation", "serving", "fleet",
+                            "moe", "convergence", "67b", "longctx"],
                    default="train")
     args = p.parse_args()
     global _active_metric
@@ -1567,6 +1710,8 @@ def main():
         bench_train()
     elif args.mode == "serving":
         bench_serving()
+    elif args.mode == "fleet":
+        bench_fleet()
     elif args.mode == "moe":
         bench_moe()
     elif args.mode == "convergence":

@@ -4,6 +4,7 @@ from .module import BasicModule, LanguageModule  # noqa: F401
 from .resilience import (  # noqa: F401
     FaultInjector, InjectedKill, StepWatchdog,
 )
+from .fleet import FleetReplica, FleetRouter  # noqa: F401
 from .serving import (  # noqa: F401
     Completion, GenerationServer, RequestShed,
 )

@@ -1,0 +1,556 @@
+"""Fleet serving: a prefix-affinity router over GenerationServer
+replicas (docs/fleet_serving.md).
+
+The paper's north star is serving heavy traffic from millions of
+users, and every fleet ingredient exists in single-server form by
+PR 12: chunked prefill + refcounted page pool with prefix/prompt
+registries (``core/paging.py``), drain + ``resume_tokens`` token-exact
+re-entry and deadline/shedding admission (``core/serving.py``), and
+per-trace-id request tracing with a live ``/metrics`` + ``/healthz``
+endpoint (``observability/``). This module composes them into a
+multi-replica deployment while keeping the GSPMD discipline: each
+replica stays ONE jitted SPMD program and ALL fleet coordination is
+host-side Python — the devices only ever see the jitted slot
+primitives plus the page gather/scatter ops of a KV handoff.
+
+Three capabilities, one ``FleetRouter``:
+
+- **Prefix-affinity routing** — millions of users share a few
+  thousand system prompts, so a request is worth routing to the
+  replica that already holds its prefix pages.  ``submit()`` scores
+  every non-draining replica via
+  :meth:`GenerationServer.prefix_affinity` (whole-prompt registry hit
+  beats any partial prefix share) and breaks ties by least queue
+  depth; admission refusals spill over to the next-ranked replica and
+  only when EVERY replica refuses does the router shed.
+- **Prefill/decode disaggregation** — with ``prefill_replicas > 0``
+  new requests land on prefill-role replicas that run chunked prefill
+  but never a decode tick (:meth:`GenerationServer.prefill_step`).
+  The moment a prompt finishes prefill the router moves its KV pages
+  to a decode replica: ``kv_export`` (pin) → ``kv_page_data`` (jitted
+  gather; ``jax.device_get`` staging when ``handoff="host"``) →
+  ``kv_import`` on the peer (fresh local page ids — the page-table
+  remap — then scatter + registry insert, int8 pools move their scale
+  leaves in the same tree) → re-``submit`` on the decode replica,
+  which admits as a whole-prompt registry hit with ZERO prefill.  The
+  decode-side import stays pinned until the request completes.
+- **Rolling restarts** — :meth:`restart_replica` drains one replica
+  (its ``/healthz`` flips 503 immediately), finishes or fails over
+  every in-flight request, swaps in a fresh server from the factory
+  and re-arms the fleet-level health aggregation.  Failover re-submits
+  each partial to a peer via ``submit(resume_tokens=...,
+  trace_id=..., nonce=...)``: committed tokens, the trace id AND the
+  sampling nonce all survive, so the resumed stream is token-exact
+  and reads as one trace in events.jsonl.
+
+Determinism contract: the router assigns sampling nonces from its OWN
+counter in global submission order (consumed only on successful
+admission — a shed must not burn a draw).  Replicas built by the same
+factory share model/params/gen_cfg/rng, so any replica produces the
+identical sampled stream for a given nonce: fleet output is
+token-identical to a single lockstep server for greedy AND sampled
+decoding, under any routing interleaving, with or without failover
+(pinned in tests/test_fleet.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from ..observability import metrics
+from ..observability import server as obs_server
+from ..observability.recorder import FlightRecorder
+from ..observability.spans import Tracer
+from ..utils.log import logger
+from .serving import Completion, GenerationServer, RequestShed
+
+
+@dataclass
+class FleetReplica:
+    """One routed replica: the live server plus its fleet identity."""
+    name: str
+    server: GenerationServer
+    #: "mixed" (routing by affinity only), or "prefill"/"decode" in
+    #: disaggregated mode
+    role: str = "mixed"
+    #: rolling-restart generation count (restart_replica bumps it)
+    restarts: int = 0
+
+
+class FleetRouter:
+    """Host-side router over N :class:`GenerationServer` replicas.
+
+    Args:
+        server_factory: ``name -> GenerationServer``; called once per
+            replica at construction and again on every restart.  For
+            the parity contract every call must build an identical
+            server (same model/params/gen_cfg/rng) — the factory IS
+            the fleet's reproducibility boundary.
+        num_replicas: fleet size.
+        prefill_replicas: first K replicas take the prefill role and
+            the rest decode (0 = every replica mixed).
+        events_path: fleet-level events.jsonl for router spans and
+            fleet events; point the factory's servers at the SAME file
+            and one stream tells the whole story.
+        handoff: ``"device"`` hands the gathered page tree straight to
+            the peer's scatter (replicas share devices — the
+            ``copy_kv_pages`` regime); ``"host"`` stages it through
+            ``jax.device_get`` (foreign-mesh fallback).
+    """
+
+    def __init__(self, server_factory: Callable[[str], GenerationServer],
+                 num_replicas: int = 2, *,
+                 prefill_replicas: int = 0,
+                 events_path: Optional[str] = None,
+                 handoff: str = "device"):
+        if num_replicas < 1:
+            raise ValueError(
+                f"num_replicas must be >= 1, got {num_replicas}")
+        if prefill_replicas and not \
+                0 < prefill_replicas < num_replicas:
+            raise ValueError(
+                f"prefill_replicas ({prefill_replicas}) must leave at "
+                f"least one decode replica out of {num_replicas}")
+        if handoff not in ("device", "host"):
+            raise ValueError(
+                f"handoff must be 'device' or 'host', got {handoff!r}")
+        self._factory = server_factory
+        self._split = prefill_replicas > 0
+        self._handoff = handoff
+        self.replicas: List[FleetReplica] = []
+        for i in range(num_replicas):
+            role = "mixed" if not self._split else (
+                "prefill" if i < prefill_replicas else "decode")
+            name = f"replica{i}"
+            self.replicas.append(
+                FleetReplica(name=name, server=server_factory(name),
+                             role=role))
+        #: global sampling-nonce counter — the parity linchpin:
+        #: assigned in submission order, consumed ONLY on successful
+        #: admission, carried by the request through every handoff
+        #: and failover
+        self._nonce = 0
+        self._next_gid = 0
+        #: fleet request id -> routing record (prompt, nonce,
+        #: trace_id, current replica/local_id, stage, committed
+        #: tokens, pinned imports)
+        self._reqs: Dict[int, dict] = {}
+        #: (replica index, replica-local request id) -> fleet id
+        self._local: Dict[Tuple[int, int], int] = {}
+        self._counts = {k: 0 for k in (
+            "submitted", "routed_affinity", "routed_least_depth",
+            "spillover", "shed", "handoffs", "handoff_pages",
+            "failovers", "restarts")}
+        # fleet-level latency histogram lives in an always-on local
+        # registry, same discipline as the per-server ones
+        self._metrics = metrics.MetricsRegistry(enabled=True)
+        self._events_path = events_path
+        self._recorder = FlightRecorder(events_path) if events_path \
+            else None
+        self._tracer = Tracer(self._recorder)
+        self._metrics_server = None
+        self._install_endpoint()
+        self._emit("fleet_start", replicas=num_replicas,
+                   prefill_replicas=prefill_replicas, handoff=handoff)
+        logger.info(
+            "FleetRouter: %d replicas (%s), handoff=%s", num_replicas,
+            "/".join(r.role for r in self.replicas), handoff)
+
+    # -- bookkeeping ---------------------------------------------------
+
+    def _emit(self, event: str, **fields) -> None:
+        if self._recorder is not None:
+            self._recorder.emit(event, **fields)
+
+    def inc(self, name: str, n: int = 1) -> None:
+        """Bump a ``fleet/<counter>`` both in the summary dict and the
+        global dispatch-counter registry."""
+        self._counts[name.split("/", 1)[1]] += n
+        metrics.inc(name, n)
+
+    def _install_endpoint(self) -> None:
+        """(Re-)attach the fleet view to the live telemetry endpoint.
+
+        Every replica's constructor calls ``start_from_env`` too and
+        the /healthz provider is last-caller-wins — so the fleet
+        installs its aggregation after building the replicas and again
+        after every factory() restart, keeping /healthz answering for
+        the FLEET (ok while ANY replica serves) rather than for
+        whichever replica spoke last."""
+        self._metrics_server = obs_server.start_from_env(
+            registry=self._metrics, health=self._health_state,
+            events_path=self._events_path)
+
+    def _health_state(self) -> dict:
+        """Fleet ``/healthz``: per-replica drain state plus the
+        aggregate — ``ok`` while at least one replica admits, which is
+        exactly the rolling-restart availability story."""
+        reps = []
+        for rep in self.replicas:
+            s = rep.server
+            reps.append({"name": rep.name, "role": rep.role,
+                         "status": "draining" if s.draining else "ok",
+                         "occupancy": s.occupancy,
+                         "pending": s.pending,
+                         "restarts": rep.restarts})
+        ok = sum(1 for r in reps if r["status"] == "ok")
+        return {"status": "ok" if ok else "draining",
+                "replicas_ok": ok, "replicas": reps}
+
+    @property
+    def pending(self) -> int:
+        """Requests queued on replicas plus handoffs awaiting a
+        decode-side slot."""
+        n = sum(r.server.pending for r in self.replicas)
+        n += sum(1 for r in self._reqs.values()
+                 if r["stage"] == "pending_decode")
+        return n
+
+    @property
+    def busy(self) -> bool:
+        """True while any routed request is unfinished."""
+        return bool(self._reqs)
+
+    # -- routing -------------------------------------------------------
+
+    def _ranked(self, tokens: Sequence[int],
+                roles: Tuple[str, ...]) -> List[Tuple[int, int, int]]:
+        """Candidate replicas as ``(affinity, depth, index)``, best
+        first: highest registry affinity, then least queue depth, then
+        index (a stable tiebreak keeps routing reproducible)."""
+        scored = []
+        for i, rep in enumerate(self.replicas):
+            if rep.role not in roles or rep.server.draining:
+                continue
+            aff = rep.server.prefix_affinity(tokens)
+            depth = rep.server.pending + rep.server.occupancy
+            scored.append((-aff, depth, i))
+        scored.sort()
+        return [(-naff, depth, i) for naff, depth, i in scored]
+
+    def submit(self, prompt: Sequence[int],
+               deadline_s: Optional[float] = None) -> int:
+        """Route one request; returns its fleet-wide id (the id on
+        :class:`Completion`).  Raises :class:`RequestShed` only after
+        EVERY eligible replica refused admission."""
+        prompt = [int(t) for t in prompt]
+        gid = self._next_gid
+        self._next_gid += 1
+        self.inc("fleet/submitted")
+        span = self._tracer.start_trace(
+            "fleet/route", request=gid, prompt_len=len(prompt))
+        tid = span.trace_id
+        roles = ("prefill",) if self._split else ("mixed",)
+        for rank, (aff, depth, i) in enumerate(
+                self._ranked(prompt, roles)):
+            rep = self.replicas[i]
+            nonce = self._nonce
+            try:
+                lid = rep.server.submit(
+                    prompt, deadline_s=deadline_s, trace_id=tid,
+                    nonce=nonce)
+            except RequestShed:
+                continue   # spill over to the next-ranked replica
+            self._nonce += 1
+            if aff > 0:
+                self.inc("fleet/routed_affinity")
+            else:
+                self.inc("fleet/routed_least_depth")
+            if rank:
+                self.inc("fleet/spillover")
+            span.end(replica=rep.name, affinity=aff, depth=depth,
+                     spillover=rank)
+            self._reqs[gid] = {
+                "prompt": prompt, "nonce": nonce, "trace_id": tid,
+                "replica": i, "local_id": lid,
+                "stage": "prefill" if self._split else "decode",
+                "deadline_s": deadline_s, "tokens": [],
+                "imports": []}
+            self._local[(i, lid)] = gid
+            self._emit("fleet_route", request=gid, replica=rep.name,
+                       affinity=aff, depth=depth, spillover=rank,
+                       trace=tid)
+            return gid
+        self.inc("fleet/shed")
+        span.end(reason="shed")
+        self._emit("fleet_shed", request=gid, trace=tid)
+        raise RequestShed(
+            "fleet: every eligible replica refused admission "
+            "(draining or at max_queue_depth)")
+
+    # -- completion plumbing -------------------------------------------
+
+    def _finish(self, gid: int, c: Completion) -> Completion:
+        """Close out a fleet request: drop pinned imports, feed the
+        fleet TTFT histogram, re-key the completion to the fleet id."""
+        req = self._reqs.pop(gid)
+        for srv, toks in req["imports"]:
+            srv.kv_import_release(toks)
+        if c.ttft_ms is not None:
+            self._metrics.observe("fleet/ttft_ms", c.ttft_ms)
+        return Completion(
+            request_id=gid, prompt=c.prompt, tokens=list(c.tokens),
+            finish_reason=c.finish_reason,
+            trace_id=c.trace_id or req["trace_id"],
+            ttft_ms=c.ttft_ms)
+
+    def _resolve(self, i: int, c: Completion) -> Optional[Completion]:
+        """Map a replica-local completion back to its fleet request;
+        None for requests this router did not place."""
+        gid = self._local.pop((i, c.request_id), None)
+        if gid is None:
+            return None
+        return self._finish(gid, c)
+
+    # -- the fleet loop ------------------------------------------------
+
+    def step(self) -> List[Completion]:
+        """One fleet tick: pump prefill→decode handoffs, give prefill
+        replicas an admission+prefill turn, step everyone else, and
+        return finished requests under their fleet ids."""
+        out: List[Completion] = []
+        if self._split:
+            self._pump_handoffs()
+        for i, rep in enumerate(self.replicas):
+            if rep.role == "prefill":
+                rep.server.prefill_step()
+            else:
+                for c in rep.server.step():
+                    comp = self._resolve(i, c)
+                    if comp is not None:
+                        out.append(comp)
+        reg = metrics.get_registry()
+        reg.set_gauge("fleet/replicas_ok",
+                      sum(1 for r in self.replicas
+                          if not r.server.draining))
+        reg.set_gauge("fleet/pending", self.pending)
+        return out
+
+    def _pump_handoffs(self) -> None:
+        """Move every finished prefill to a decode replica and retry
+        handoffs that found no decode capacity last tick."""
+        for gid in list(self._reqs):
+            req = self._reqs.get(gid)
+            if req is None:
+                continue
+            if req["stage"] == "pending_decode":
+                self._dispatch_decode(gid, req)
+                continue
+            if req["stage"] != "prefill":
+                continue
+            i = req["replica"]
+            srv = self.replicas[i].server
+            # a failed-over partial re-prefills prompt+tokens, and
+            # that full sequence is what the prompt registry holds
+            seq = req["prompt"] + req["tokens"]
+            if not srv.prompt_ready(seq):
+                continue
+            exp = srv.kv_export(seq)
+            if exp is None:
+                continue
+            pages, last = exp
+            partial = srv.preempt(req["local_id"])
+            self._local.pop((i, req["local_id"]), None)
+            if partial is not None:
+                req["tokens"] = list(partial.tokens)
+            # the gather materialises fresh buffers, so the export
+            # pins can drop as soon as it is dispatched — the data no
+            # longer depends on the source pool's pages
+            data = srv.kv_page_data(pages)
+            if self._handoff == "host":
+                data = jax.device_get(data)
+            srv.kv_export_release(pages)
+            req["kv"] = (data, last, len(pages))
+            req["stage"] = "pending_decode"
+            self.inc("fleet/handoffs")
+            self.inc("fleet/handoff_pages", len(pages))
+            span = self._tracer.start_trace(
+                "fleet/handoff", trace_id=req["trace_id"],
+                request=gid, pages=len(pages))
+            self._emit("fleet_handoff", request=gid,
+                       replica=self.replicas[i].name,
+                       pages=len(pages), trace=req["trace_id"])
+            self._dispatch_decode(gid, req)
+            span.end(placed=req["stage"] == "decode")
+
+    def _dispatch_decode(self, gid: int, req: dict) -> bool:
+        """Place a handed-off prefill on the best decode replica:
+        import its KV (falling back to plain re-prefill when the
+        peer's pool cannot host it) and re-submit under the original
+        nonce/trace.  False leaves it ``pending_decode`` for the next
+        tick."""
+        data, last, n_pages = req.get("kv", (None, None, 0))
+        roles = ("decode",) if self._split else ("mixed",)
+        seq = req["prompt"] + req["tokens"]
+        for aff, depth, i in self._ranked(seq, roles):
+            srv = self.replicas[i].server
+            imported = data is not None and srv.kv_import(
+                seq, data, last, n_pages)
+            try:
+                lid = srv.submit(
+                    req["prompt"],
+                    resume_tokens=req["tokens"] or None,
+                    deadline_s=req.get("deadline_s"),
+                    trace_id=req["trace_id"], nonce=req["nonce"])
+            except RequestShed:
+                if imported:
+                    srv.kv_import_release(seq)
+                continue
+            if imported:
+                req["imports"].append((srv, list(seq)))
+            req["replica"] = i
+            req["local_id"] = lid
+            req["stage"] = "decode"
+            req.pop("kv", None)
+            self._local[(i, lid)] = gid
+            return True
+        return False
+
+    # -- rolling restarts ----------------------------------------------
+
+    def _failover(self, gid: int,
+                  c: Completion) -> Optional[Completion]:
+        """Re-home a preempted partial on a peer, token-exactly:
+        same prompt, committed tokens, trace id and nonce.  Returns
+        the partial itself only when no peer can take it (the caller
+        surfaces it to the client)."""
+        req = self._reqs[gid]
+        req["tokens"] = list(c.tokens)
+        req.pop("kv", None)
+        span = self._tracer.start_trace(
+            "fleet/failover", trace_id=req["trace_id"], request=gid,
+            committed=len(req["tokens"]))
+        # decode peers first; in split mode a prefill replica is still
+        # a full server, so it takes the stream rather than shed it
+        # when every decode peer is down (e.g. a 1+1 rolling restart)
+        roles = ("decode", "prefill") if self._split else ("mixed",)
+        seq = req["prompt"] + req["tokens"]
+        ranked = [r for role in roles
+                  for r in self._ranked(seq, (role,))]
+        for aff, depth, i in ranked:
+            srv = self.replicas[i].server
+            try:
+                lid = srv.submit(
+                    req["prompt"],
+                    resume_tokens=req["tokens"] or None,
+                    deadline_s=req.get("deadline_s"),
+                    trace_id=req["trace_id"], nonce=req["nonce"])
+            except RequestShed:
+                continue
+            self.inc("fleet/failovers")
+            span.end(replica=self.replicas[i].name)
+            req["replica"] = i
+            req["local_id"] = lid
+            # on a prefill-role replica the stream re-enters the
+            # handoff pump once its re-prefill lands in the registry
+            req["stage"] = "prefill" \
+                if self.replicas[i].role == "prefill" else "decode"
+            self._local[(i, lid)] = gid
+            self._emit("fleet_failover", request=gid,
+                       replica=self.replicas[i].name,
+                       tokens=len(req["tokens"]),
+                       trace=req["trace_id"])
+            return None
+        span.end(reason="shed")
+        self.inc("fleet/shed")
+        self._emit("fleet_shed", request=gid, trace=req["trace_id"])
+        return self._finish(gid, c)
+
+    def restart_replica(self, idx: int,
+                        max_ticks: int = 0) -> List[Completion]:
+        """Zero-dropped-token rolling restart of one replica: drain it
+        (``/healthz`` flips 503 for that replica immediately), finish
+        or fail over every in-flight request, swap in a fresh server
+        from the factory and re-arm the fleet health endpoint.
+        Returns whatever finished during the drain (failed-over
+        partials complete later through :meth:`step`)."""
+        rep = self.replicas[idx]
+        self._emit("fleet_restart_begin", replica=rep.name,
+                   pending=rep.server.pending,
+                   occupancy=rep.server.occupancy)
+        done: List[Completion] = []
+        partials: List[Tuple[int, Completion]] = []
+        for c in rep.server.drain(max_ticks=max_ticks):
+            gid = self._local.pop((idx, c.request_id), None)
+            if gid is None:
+                continue
+            if c.finish_reason == "preempted":
+                partials.append((gid, c))
+            else:
+                done.append(self._finish(gid, c))
+        for gid, c in partials:
+            comp = self._failover(gid, c)
+            if comp is not None:
+                done.append(comp)
+        rep.server.close()
+        self.replicas[idx] = FleetReplica(
+            name=rep.name, server=self._factory(rep.name),
+            role=rep.role, restarts=rep.restarts + 1)
+        self.inc("fleet/restarts")
+        # the new server's start_from_env stole /healthz — take it back
+        self._install_endpoint()
+        self._emit("fleet_restart_end", replica=rep.name,
+                   finished=len(done), failovers=len(partials))
+        return done
+
+    def rolling_restart(self, max_ticks: int = 0) -> List[Completion]:
+        """Restart every replica in turn — the fleet keeps serving
+        throughout because each drain's partials fail over to live
+        peers before the next replica goes down."""
+        done: List[Completion] = []
+        for i in range(len(self.replicas)):
+            done.extend(self.restart_replica(i, max_ticks=max_ticks))
+        return done
+
+    # -- convenience ---------------------------------------------------
+
+    def run(self, prompts: Sequence[Sequence[int]]
+            ) -> List[Completion]:
+        """Serve a batch to completion; results in submission order."""
+        ids = [self.submit(p) for p in prompts]
+        done: Dict[int, Completion] = {}
+        while self.busy:
+            for c in self.step():
+                done[c.request_id] = c
+        return [done[i] for i in ids]
+
+    def close(self) -> None:
+        """Detach every replica's OS-level hooks. Idempotent."""
+        for rep in self.replicas:
+            rep.server.close()
+
+    def summary(self) -> dict:
+        """Fleet counters + aggregate throughput + fleet-level TTFT
+        percentiles + per-replica summaries (also emitted to the
+        flight recorder)."""
+        reps = []
+        tokens = 0
+        tick_time = 0.0
+        for rep in self.replicas:
+            s = rep.server.summary()
+            s["replica"] = rep.name
+            s["role"] = rep.role
+            s["restarts"] = rep.restarts
+            reps.append(s)
+            tokens += s["decode_tokens"]
+            tick_time += s["decode_time_sec"]
+        out = {"replicas": len(self.replicas),
+               "prefill_split": self._split,
+               "handoff": self._handoff,
+               "decode_tokens": tokens,
+               "decode_time_sec": round(tick_time, 4),
+               # replicas tick sequentially on the same host/chips, so
+               # the honest aggregate divides by SUMMED decode time
+               "tokens_per_sec": round(tokens / tick_time, 2)
+               if tick_time > 0 else 0.0,
+               **self._counts}
+        h = self._metrics.histogram("fleet/ttft_ms")
+        if h is not None and h.count:
+            out["ttft_p50_ms"] = round(h.percentile(50), 3)
+            out["ttft_p99_ms"] = round(h.percentile(99), 3)
+        self._emit("fleet_summary", **out)
+        out["per_replica"] = reps
+        return out

@@ -122,8 +122,9 @@ import numpy as np
 from ..models.gpt.generation import (
     LOOP_EXIT_BUDGET, LOOP_EXIT_FINISHED, GenerationConfig,
     _unrolled_twin, activate_slot, copy_kv_pages, decode_loop,
-    decode_step, init_page_pool, init_slot_cache, init_slot_state,
-    prefill_chunk_paged, prefill_into_slots, verify_loop, verify_step,
+    decode_step, gather_kv_pages, init_page_pool, init_slot_cache,
+    init_slot_state, prefill_chunk_paged, prefill_into_slots,
+    scatter_kv_pages, verify_loop, verify_step,
 )
 from ..observability import metrics
 from ..observability import server as obs_server
@@ -173,6 +174,10 @@ class Completion:
     #: back to ``submit(resume_tokens=..., trace_id=...)`` so the
     #: resumed request's spans link to the original timeline
     trace_id: Optional[str] = None
+    #: time-to-first-token of THIS server lifetime in ms (None when the
+    #: request never decoded here) — the fleet router aggregates these
+    #: into its own latency histogram (core/fleet.py)
+    ttft_ms: Optional[float] = None
 
 
 class GenerationServer:
@@ -264,6 +269,9 @@ class GenerationServer:
             self._prefilling: deque = deque()
             self._admit_seq = 0
             self._prefill_chunk_count = 0
+            #: prompt_key -> imported page ids pinned by kv_import
+            #: until kv_import_release (cross-server KV handoff)
+            self._imports: Dict[str, List[int]] = {}
         compute_dtype = jnp.dtype(cfg.dtype)
         if compute_dtype != jnp.float32:
             # same one-time cast as generate(): halve the per-token
@@ -438,10 +446,17 @@ class GenerationServer:
         """Number of submitted requests still waiting for a slot."""
         return len(self._queue)
 
+    @property
+    def draining(self) -> bool:
+        """True once drain mode is entered (SIGTERM or :meth:`drain`)
+        — the fleet router stops routing to a draining replica."""
+        return self._draining
+
     def submit(self, prompt: Sequence[int],
                deadline_s: Optional[float] = None,
                resume_tokens: Optional[Sequence[int]] = None,
-               trace_id: Optional[str] = None) -> int:
+               trace_id: Optional[str] = None,
+               nonce: Optional[int] = None) -> int:
         """Queue a request; returns its id. Raises ``ValueError`` when
         the prompt can never fit (``prompt + max_dec_len >
         max_position_embeddings``) — an oversized request must fail
@@ -454,14 +469,19 @@ class GenerationServer:
         (queued time included), overriding the server-wide
         ``request_ttl_s``; on expiry it completes as
         ``deadline_exceeded`` with whatever tokens it earned.
-        ``resume_tokens`` (paged servers only) re-enters a partial
-        from a drained/preempted completion: admission re-prefills
+        ``resume_tokens`` re-enters a partial from a drained/preempted
+        completion (paged OR contiguous servers): admission re-prefills
         prompt+tokens and the sampling stream resumes at the preserved
         decode count, so a greedy resume is token-exact with the
         uninterrupted run. ``trace_id`` (with an event stream) links
         the new request's spans to an earlier timeline — pass
         ``Completion.trace_id`` back with ``resume_tokens`` so a
-        drained-then-resumed request reads as ONE trace."""
+        drained-then-resumed request reads as ONE trace. ``nonce``
+        overrides the server's own per-request sampling-nonce counter:
+        a fleet router (core/fleet.py) assigns nonces in GLOBAL
+        submission order so sampled draws are replica-independent and
+        a failed-over request keeps its stream — leave it None
+        everywhere else."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -472,10 +492,6 @@ class GenerationServer:
                 f"max_position_embeddings "
                 f"{self.model.config.max_position_embeddings}")
         tokens = [int(t) for t in resume_tokens or []]
-        if tokens and not self.paged:
-            raise ValueError(
-                "resume_tokens requires a paged server (contiguous "
-                "admission prefills the prompt only)")
         if tokens and len(tokens) >= self.gen_cfg.max_dec_len:
             raise ValueError(
                 f"resume_tokens ({len(tokens)}) already meets "
@@ -497,6 +513,9 @@ class GenerationServer:
                "submit_t": time.time(),
                "deadline": time.time() + ttl
                if ttl is not None else None}
+        if nonce is not None:
+            # router-assigned: _place/_admit skip their own counter
+            req["nonce"] = int(nonce)
         self._begin_trace(req, trace_id)
         self._queue.append(req)
         return rid
@@ -559,7 +578,10 @@ class GenerationServer:
         for b in self._buckets:
             if b >= n:
                 return b
-        return self._buckets[-1]
+        # buckets cover PROMPT lengths; a resume's prompt+tokens can
+        # exceed the largest one — compile that exact shape (resumes
+        # are rare enough that a one-off shape beats a new bucket)
+        return n
 
     def _admit(self) -> None:
         """Move queued requests into free slots."""
@@ -569,19 +591,29 @@ class GenerationServer:
         while self._queue and None in self._slots:
             req = self._queue.popleft()
             slot = self._slots.index(None)
-            bucket = self._bucket_for(len(req["prompt"]))
+            # resume re-entry: prefill prompt + already-emitted tokens
+            # (same contract as paged re-admission), then restore the
+            # decode count below so the sampling stream and length
+            # budget continue exactly where the partial stopped
+            seq = req["prompt"] + req["tokens"]
+            bucket = self._bucket_for(len(seq))
             self._observe_queue_wait(req)
             self._phase(req, "serving/prefill", slot=slot)
             row = np.full((1, bucket), self.gen_cfg.pad_token_id,
                           np.int32)
-            row[0, :len(req["prompt"])] = req["prompt"]
-            nonce = self._nonce
-            self._nonce += 1
+            row[0, :len(seq)] = seq
+            if "nonce" not in req:
+                req["nonce"] = self._nonce
+                self._nonce += 1
             self._cache, self._state = prefill_into_slots(
                 self.model, self.params, self._cache, self._state,
                 jnp.asarray([slot], jnp.int32), jnp.asarray(row),
-                jnp.asarray([len(req["prompt"])], jnp.int32),
-                jnp.asarray([nonce], jnp.int32))
+                jnp.asarray([len(seq)], jnp.int32),
+                jnp.asarray([req["nonce"]], jnp.int32))
+            if req["tokens"]:
+                self._state = self._state._replace(
+                    dec_count=self._state.dec_count.at[slot].set(
+                        len(req["tokens"])))
             self._slots[slot] = req
             self._counts["admitted"] += 1
             metrics.inc("serving/admitted")
@@ -911,7 +943,9 @@ class GenerationServer:
                    trace=self._trace_id(req))
         return Completion(request_id=req["id"], prompt=req["prompt"],
                           tokens=req["tokens"], finish_reason=reason,
-                          trace_id=self._trace_id(req))
+                          trace_id=self._trace_id(req),
+                          ttft_ms=round(req["ttft"] * 1000.0, 3)
+                          if "ttft" in req else None)
 
     def preempt(self, request_id: int) -> Optional[Completion]:
         """Cancel a request (client abort / scheduler decision): evict
@@ -934,6 +968,131 @@ class GenerationServer:
                                   finish_reason="preempted",
                                   trace_id=self._trace_id(req))
         return None
+
+    # -- fleet hooks (core/fleet.py, docs/fleet_serving.md) -----------
+    #
+    # The narrow surface a FleetRouter drives: score a prompt against
+    # this replica's registries (prefix_affinity), run prefill without
+    # decoding (prefill_step, the prefill half of disaggregation), and
+    # move finished-prefill KV pages between replicas' pools
+    # (kv_export / kv_page_data -> scatter on the peer via kv_import).
+    # Everything stays host-orchestrated: the device only sees the
+    # jitted gather/scatter ops, and all refcount/registry bookkeeping
+    # lands in this server's own PageAllocator.
+
+    def prefix_affinity(self, tokens: Sequence[int]) -> int:
+        """Router scoring hook: how much of ``tokens`` this replica
+        could map from its registries without prefill — the count of
+        leading full-page prefix-registry hits, or past-the-table
+        ``max_kv_pages + 1`` for a whole-prompt registry hit (zero
+        prefill beats any partial share). 0 on contiguous servers."""
+        if not self.paged or not self._prefix_sharing:
+            return 0
+        seq = [int(t) for t in tokens]
+        if self._alloc.lookup_prompt(prompt_key(seq)) is not None:
+            return self._max_pages + 1
+        n = 0
+        for kk in page_prefix_keys(seq, self._page):
+            if self._alloc.lookup_prefix(kk) is None:
+                break
+            n += 1
+        return n
+
+    def prefill_step(self) -> None:
+        """Admission plus at most one prefill chunk, NO decode tick —
+        the drive loop of a prefill-role replica in a disaggregated
+        fleet: the router calls this until :meth:`prompt_ready`, then
+        exports the KV and hands the request to a decode replica
+        before a single token is decoded here."""
+        if not self._draining:
+            self._admit()
+        if self.paged:
+            self._prefill_pump()
+            metrics.get_registry().set_gauge(
+                "serving/pages_in_use", self._alloc.pages_in_use)
+
+    def prompt_ready(self, tokens: Sequence[int]) -> bool:
+        """True when a finished prefill of exactly ``tokens`` sits in
+        the prompt registry — i.e. :meth:`kv_export` would succeed."""
+        return bool(
+            self.paged and self._prefix_sharing and
+            self._alloc.lookup_prompt(
+                prompt_key([int(t) for t in tokens])) is not None)
+
+    def kv_export(self, tokens: Sequence[int]):
+        """Pin a finished prefill for handoff: look ``tokens`` up in
+        the prompt registry and RETAIN every page so the KV survives
+        the source request's eviction while the transfer is in
+        flight. Returns ``(pages, last_logits)`` or None on a miss;
+        the caller must :meth:`kv_export_release` the pages once the
+        peer holds a copy (or on any failure path)."""
+        if not self.paged:
+            return None
+        hit = self._alloc.lookup_prompt(
+            prompt_key([int(t) for t in tokens]))
+        if hit is None:
+            return None
+        pages, last = hit
+        for pid in pages:
+            self._alloc.retain(pid)
+        self._emit("serving_kv_export", pages=len(pages))
+        return list(pages), last
+
+    def kv_export_release(self, pages: Sequence[int]) -> None:
+        """Drop the transfer references :meth:`kv_export` took."""
+        for pid in pages:
+            self._alloc.release(int(pid))
+
+    def kv_page_data(self, pages: Sequence[int]):
+        """Device-side gather of ``pages``' contents (KV plus int8
+        scale leaves) as a cache-shaped tree — hand it to a peer's
+        :meth:`kv_import` directly (same devices) or via
+        ``jax.device_get`` (host-staged, foreign mesh)."""
+        return gather_kv_pages(self._cache,
+                               jnp.asarray(list(pages), jnp.int32))
+
+    def kv_import(self, tokens: Sequence[int], page_data,
+                  last_logits, n_pages: int) -> bool:
+        """Adopt a peer's finished prefill: allocate ``n_pages`` local
+        pages (the page-table REMAP — destination ids owe nothing to
+        the source's), scatter ``page_data`` into them, and register
+        the prompt + its full-page prefixes so the very next
+        ``submit()`` of these ``tokens`` admits with zero prefill.
+        The import itself holds one reference per page (dropped by
+        :meth:`kv_import_release`), so the registry entry outlives
+        request churn. False — caller falls back to plain re-prefill
+        — when this server is not paged/sharing, the pool cannot host
+        ``n_pages``, or the prompt is already resident."""
+        if not self.paged or not self._prefix_sharing:
+            return False
+        seq = [int(t) for t in tokens]
+        key = prompt_key(seq)
+        if self._alloc.lookup_prompt(key) is not None:
+            return False
+        if n_pages > self._max_pages or \
+                self._alloc.free_pages < n_pages:
+            return False
+        pids = [self._alloc.alloc() for _ in range(n_pages)]
+        self._cache = scatter_kv_pages(
+            self._cache, page_data, jnp.asarray(pids, jnp.int32))
+        for j, kk in enumerate(page_prefix_keys(seq, self._page)):
+            self._alloc.register_prefix(kk, pids[j])
+        self._alloc.register_prompt(
+            key, pids, np.asarray(last_logits, np.float32))
+        self._imports[key] = pids
+        self._emit("serving_kv_import", pages=n_pages)
+        return True
+
+    def kv_import_release(self, tokens: Sequence[int]) -> None:
+        """Unpin an import once the handed-off request completed (or
+        to evict a stale shared prefix): the registry entries fall
+        away with the last reference. No-op on unknown keys."""
+        if not self.paged:
+            return
+        pids = self._imports.pop(
+            prompt_key([int(t) for t in tokens]), None)
+        for pid in pids or ():
+            self._alloc.release(pid)
 
     # -- the serving loop ---------------------------------------------
 

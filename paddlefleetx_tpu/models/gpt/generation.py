@@ -1155,6 +1155,48 @@ def copy_kv_pages(cache, src: jax.Array, dst: jax.Array):
 
 
 @jax.jit
+def gather_kv_pages(cache, pids: jax.Array):
+    """Pull physical pages ``pids`` (``[k]`` int32) out of every KV
+    pool leaf — the export half of a cross-server KV handoff
+    (``core/fleet.py``). Non-pool leaves pass through untouched, so
+    the result has the cache's own tree structure and
+    :func:`scatter_kv_pages` consumes it directly; int8 pools carry
+    their fp32 ``cached_*_scale`` pages alongside automatically (the
+    same four leaf names :func:`copy_kv_pages` copies)."""
+    def g(path, leaf):
+        name = getattr(path[-1], "key", "")
+        if name in ("cached_key", "cached_value",
+                    "cached_key_scale", "cached_value_scale"):
+            ax = leaf.ndim - 4
+            sel = (slice(None),) * ax
+            return leaf[sel + (pids,)]
+        return leaf
+    return jax.tree_util.tree_map_with_path(g, cache)
+
+
+@jax.jit
+def scatter_kv_pages(cache, page_data, pids: jax.Array):
+    """Write gathered page contents into pages ``pids`` of THIS pool —
+    the import half of a cross-server KV handoff. ``page_data`` is a
+    :func:`gather_kv_pages` result: device arrays for a same-devices
+    transfer, or host-staged numpy (``jax.device_get`` of the gather)
+    when the two pools' meshes don't share devices. The destination's
+    page ids are free to differ from the source's — the host page
+    table remap happens in the importer's allocator, this op only
+    moves bytes."""
+    def s(path, pleaf, dleaf):
+        name = getattr(path[-1], "key", "")
+        if name in ("cached_key", "cached_value",
+                    "cached_key_scale", "cached_value_scale"):
+            ax = pleaf.ndim - 4
+            sel = (slice(None),) * ax
+            return pleaf.at[sel + (pids,)].set(
+                jnp.asarray(dleaf, pleaf.dtype))
+        return pleaf
+    return jax.tree_util.tree_map_with_path(s, cache, page_data)
+
+
+@jax.jit
 def activate_slot(state: SlotState, slot: jax.Array,
                   length: jax.Array, dec_count: jax.Array,
                   nonce: jax.Array, appeared_row: jax.Array,

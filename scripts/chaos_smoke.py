@@ -30,12 +30,22 @@ verify, a single flipped byte in a payload file (the fp32
 kernels) must fail manifest verification AND drop the step dir out of
 ``latest_checkpoint`` (the resume fallback path), and restoring the
 byte must verify again — proving the scale arrays are covered as
-payload, not sidecar metadata (docs/quantization.md). Run from the
-repo root:
+payload, not sidecar metadata (docs/quantization.md).
+
+With ``--fleet`` a serving leg drills the fleet's availability story
+(docs/fleet_serving.md) in-process: two paged interpret-mode
+GenerationServer replicas behind a FleetRouter serve a shared-prefix
+trace while EVERY replica is rolling-restarted mid-stream. Asserted:
+every completion is token-identical to the single-batch lockstep
+reference (zero dropped committed tokens), nothing was shed (the peer
+always had capacity), at least one request actually failed over, and
+events.jsonl ALONE reconstructs one trace id per request — with two
+``serving/request`` lifetimes bridged by a ``fleet/failover`` span
+for each failed-over stream. Run from the repo root:
 
   python scripts/chaos_smoke.py [--workdir DIR] [--steps 12]
                                 [--kill-step 7] [--save-steps 4]
-                                [--ptq]
+                                [--ptq] [--fleet]
 """
 
 import argparse
@@ -259,6 +269,127 @@ def ptq_leg(work, chaos_out, cfg_path):
         f"skipped it; restored artifact verifies\n")
 
 
+def fleet_leg(work):
+    """In-process fleet drill: rolling-restart a 2-replica fleet
+    mid-stream and prove zero token loss + trace continuity from the
+    event log alone."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("PFX_PALLAS_INTERPRET", "1")
+    sys.path.insert(0, REPO)
+    import jax
+    import jax.numpy as jnp
+
+    from paddlefleetx_tpu.core.fleet import FleetRouter
+    from paddlefleetx_tpu.core.serving import GenerationServer
+    from paddlefleetx_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddlefleetx_tpu.models.gpt.generation import (
+        GenerationConfig, generate, left_pad_batch,
+    )
+
+    vocab, eos = 96, 95
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=32, num_layers=2,
+                    num_attention_heads=4,
+                    max_position_embeddings=256,
+                    hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+    model = GPTForPretraining(cfg)
+    params = model.init({"params": jax.random.key(0)},
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    gen_cfg = GenerationConfig(max_dec_len=8,
+                               decode_strategy="greedy_search",
+                               eos_token_id=eos, pad_token_id=eos)
+
+    # the fleet workload shape: a few shared system prompts, many tails
+    rng = np.random.default_rng(2)
+    prefixes = [rng.integers(0, eos, 130).tolist() for _ in range(2)]
+    prompts = [prefixes[i % 2] + rng.integers(0, eos, 8 + i).tolist()
+               for i in range(6)]
+
+    ids_arr, mask = left_pad_batch(prompts, eos)
+    out = np.asarray(generate(model, params, jnp.asarray(ids_arr),
+                              jnp.asarray(mask), jax.random.key(0),
+                              gen_cfg))
+    ref = []
+    for row in out:
+        toks = []
+        for t in row:
+            toks.append(int(t))
+            if int(t) == eos:
+                break
+        ref.append(toks)
+
+    events = os.path.join(work, "fleet_events.jsonl")
+
+    def factory(name):
+        return GenerationServer(model, params, gen_cfg, num_slots=2,
+                                rng=jax.random.PRNGKey(7),
+                                page_size=128, pool_pages=17,
+                                prefill_chunk_pages=1,
+                                events_path=events)
+
+    fleet = FleetRouter(factory, 2, events_path=events)
+    gids = [fleet.submit(p) for p in prompts]
+    done = {}
+    for _ in range(3):                  # commit some tokens first
+        for c in fleet.step():
+            done[c.request_id] = c
+    # the drill: EVERY replica goes down in turn while serving
+    for c in fleet.rolling_restart():
+        done[c.request_id] = c
+    while fleet.busy:
+        for c in fleet.step():
+            done[c.request_id] = c
+    summ = fleet.summary()
+    fleet.close()
+
+    missing = [g for g in gids if g not in done]
+    if missing:
+        fail(f"fleet leg lost requests {missing}")
+    got = [done[g].tokens for g in gids]
+    if got != ref:
+        bad = [i for i, (a, b) in enumerate(zip(got, ref)) if a != b]
+        fail(f"fleet leg dropped committed tokens: requests {bad} "
+             f"diverged from the lockstep reference after the "
+             f"rolling restart")
+    if summ["shed"] != 0:
+        fail(f"fleet leg shed {summ['shed']} requests while the peer "
+             f"had capacity")
+    if summ["failovers"] < 1:
+        fail("fleet leg exercised no failover — the restart landed "
+             "on an idle replica, drill geometry is broken")
+    if summ["restarts"] != 2:
+        fail(f"expected 2 replica restarts, recorded "
+             f"{summ['restarts']}")
+
+    # trace continuity, reconstructed from events.jsonl ALONE
+    with open(events) as f:
+        evs = [json.loads(line) for line in f if line.strip()]
+    routes = {e["request"]: e["trace"] for e in evs
+              if e.get("event") == "fleet_route"}
+    if sorted(routes) != sorted(gids):
+        fail(f"fleet_route events cover requests {sorted(routes)}, "
+             f"expected {sorted(gids)}")
+    if len(set(routes.values())) != len(gids):
+        fail("trace ids are not unique per request")
+    begins = [e for e in evs if e.get("event") == "span_begin"]
+    for e in [e for e in evs if e.get("event") == "fleet_failover"]:
+        tid = e["trace"]
+        lives = [b for b in begins if b["name"] == "serving/request"
+                 and b["trace"] == tid]
+        bridges = [b for b in begins if b["name"] == "fleet/failover"
+                   and b["trace"] == tid]
+        if len(lives) < 2:
+            fail(f"failed-over trace {tid} shows {len(lives)} "
+                 f"serving/request lifetimes, expected >= 2")
+        if not bridges:
+            fail(f"failed-over trace {tid} has no fleet/failover span")
+    sys.stdout.write(
+        f"FLEET LEG OK: rolling restart of 2 replicas under load — "
+        f"{len(gids)} requests lockstep-exact, shed=0, "
+        f"failovers={summ['failovers']}, per-request traces "
+        f"reconstruct from {os.path.basename(events)}\n")
+
+
 def main():
     """Run the baseline/chaos/resume triple and assert continuity."""
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -269,6 +400,10 @@ def main():
     ap.add_argument("--ptq", action="store_true",
                     help="also PTQ the resumed checkpoint and drill "
                          "the int8 artifact's manifest verification")
+    ap.add_argument("--fleet", action="store_true",
+                    help="also rolling-restart an in-process "
+                         "2-replica serving fleet mid-stream and "
+                         "assert zero token loss + trace continuity")
     args = ap.parse_args()
 
     work = args.workdir or tempfile.mkdtemp(prefix="pfx_chaos_")
@@ -339,6 +474,10 @@ def main():
     # 4. optional: PTQ the resumed checkpoint, drill the artifact
     if args.ptq:
         ptq_leg(work, chaos_out, cfg_path)
+
+    # 5. optional: rolling-restart a serving fleet under load
+    if args.fleet:
+        fleet_leg(work)
 
     sys.stdout.write(
         f"CHAOS SMOKE OK: killed at step {args.kill_step}, restored "

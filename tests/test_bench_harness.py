@@ -708,6 +708,61 @@ def test_bench_serving_env_knobs_pin_trace(monkeypatch, capsys):
     assert rec2["decode_ticks"] == first_ticks
 
 
+def test_bench_fleet_runs_offline(monkeypatch, capsys):
+    """The fleet bench's tiny CPU path must execute end to end and
+    emit the pinned A/B pair — the same-chips single-server baseline
+    row first, then the 2-replica router headline with the fleet-level
+    TTFT percentiles and router counters (the same record shapes the
+    on-chip 345M run emits)."""
+    monkeypatch.setenv("PFX_BENCH_FLEET_REQUESTS", "4")
+    bench.bench_fleet()
+    lines = capsys.readouterr().out.strip().splitlines()
+    recs = [json.loads(ln) for ln in lines if ln.startswith("{")]
+    base, rec = recs[-2], recs[-1]
+    assert base["metric"] == \
+        ("gpt345m_fleet_single_server_baseline_decode"
+         "_tokens_per_sec_per_chip")
+    assert base["value"] > 0 and base["unit"] == "tokens/s"
+    # same chips: the baseline server gets the SUMMED slot count
+    assert base["slots"] == 4
+    assert rec["metric"] == bench.METRIC_BY_MODE["fleet"]
+    assert rec["metric"] == \
+        "gpt345m_fleet_2replica_decode_tokens_per_sec_per_chip"
+    assert rec["value"] > 0 and rec["unit"] == "tokens/s"
+    assert rec["replicas"] == 2 and rec["prefill_split"] is False
+    assert rec["slots_per_replica"] == 2
+    assert rec["requests"] == 4 and rec["seed"] == 0
+    # trace shape rides in both rows so the A/B is self-describing
+    assert rec["prompt_prefixes"] == base["prompt_prefixes"] == 2
+    assert rec["prefix_len"] == base["prefix_len"] == 128
+    # fleet-level TTFT percentiles (aggregated over replicas)
+    assert rec["fleet_ttft_p99_ms"] >= rec["fleet_ttft_p50_ms"] > 0
+    # enough capacity for the trace: the router shed nothing
+    assert rec["shed"] == 0
+    assert rec["baseline_single_server_tokens_per_sec"] == \
+        base["value"]
+
+
+def test_bench_fleet_knobs(monkeypatch, capsys):
+    """PFX_BENCH_FLEET_REPLICAS / PFX_BENCH_FLEET_PREFILL_SPLIT pin
+    the fleet shape and are echoed back; split mode actually moves
+    every prompt through the KV handoff path."""
+    monkeypatch.setenv("PFX_BENCH_FLEET_REPLICAS", "2")
+    monkeypatch.setenv("PFX_BENCH_FLEET_PREFILL_SPLIT", "1")
+    monkeypatch.setenv("PFX_BENCH_FLEET_REQUESTS", "3")
+    monkeypatch.setenv("PFX_BENCH_FLEET_DEC_LEN", "4")
+    bench.bench_fleet()
+    lines = capsys.readouterr().out.strip().splitlines()
+    recs = [json.loads(ln) for ln in lines if ln.startswith("{")]
+    rec = recs[-1]
+    assert rec["replicas"] == 2 and rec["prefill_split"] is True
+    assert rec["max_dec_len"] == 4 and rec["requests"] == 3
+    # warm + measured pass: every request prefilled on the prefill
+    # replica and handed its KV pages to the decode replica
+    assert rec["handoffs"] >= 3
+    assert rec["shed"] == 0 and rec["value"] > 0
+
+
 def test_bench_serving_kv_dtype_ab_record(monkeypatch, capsys):
     """PFX_BENCH_SERVING_KV_DTYPE=int8 adds ONE A/B record ahead of
     the headline: the same trace served from an int8 pool resized to

@@ -142,6 +142,103 @@ def test_register_prompt_rejects_free_pages():
         a.register_prompt("P", [p], payload=None)
 
 
+# -- cross-server handoff invariants -----------------------------------
+#
+# core/fleet.py moves a prefilled sequence between two GenerationServers
+# by (a) retaining the source pages for the duration of the export
+# (kv_export), (b) allocating fresh ids on the destination pool and
+# registering the same content keys there (kv_import), and (c) pinning
+# the imported pages until the request finishes (kv_import_release).
+# These tests replay that dance at the allocator level and run
+# ``check()`` on both sides after every phase.
+
+
+def test_export_retain_keeps_registry_alive_past_source_release():
+    src = PageAllocator(num_pages=6, page_size=2)
+    toks = [3, 1, 4, 1]  # two full pages
+    pages = [src.alloc(), src.alloc()]
+    for key, page in zip(page_prefix_keys(toks, 2), pages):
+        src.register_prefix(key, page)
+    src.register_prompt(prompt_key(toks), pages, payload="last-logits")
+    # export pins every page (what kv_export does)
+    for p in pages:
+        src.retain(p)
+    # the source request finishes and its slot is evicted
+    for p in pages:
+        assert src.release(p) is False
+    # registries must survive on the strength of the export pins alone
+    assert src.lookup_prompt(prompt_key(toks)) is not None
+    assert src.lookup_prefix(page_prefix_keys(toks, 2)[0]) == pages[0]
+    src.check()
+    # export done (gather dispatched) -> drop the pins -> all gone
+    for p in pages:
+        assert src.release(p) is True
+    assert src.lookup_prompt(prompt_key(toks)) is None
+    src.check()
+
+
+def test_import_remaps_page_ids_and_pins_until_release():
+    toks = [3, 1, 4, 1]
+    src = PageAllocator(num_pages=6, page_size=2)
+    src_pages = [src.alloc(), src.alloc()]
+    src.register_prompt(prompt_key(toks), src_pages, payload="logits")
+
+    # destination pool has different occupancy, so the same content
+    # lands on different page ids — the page table must be remapped,
+    # never copied verbatim
+    dst = PageAllocator(num_pages=8, page_size=2)
+    occupied = [dst.alloc() for _ in range(3)]
+    dst_pages = [dst.alloc() for _ in src_pages]
+    assert set(dst_pages).isdisjoint(src_pages[:1]) or \
+        dst_pages != src_pages  # ids genuinely remapped
+    for key, page in zip(page_prefix_keys(toks, 2), dst_pages):
+        dst.register_prefix(key, page)
+    dst.register_prompt(prompt_key(toks), dst_pages, payload="logits")
+    src.check()
+    dst.check()
+
+    # a consumer on the destination admits via the registry and retains
+    got_pages, payload = dst.lookup_prompt(prompt_key(toks))
+    assert got_pages == tuple(dst_pages) and payload == "logits"
+    for p in got_pages:
+        dst.retain(p)
+    # import pin drops (kv_import_release); consumer refs keep it live
+    for p in dst_pages:
+        assert dst.release(p) is False
+    assert dst.lookup_prompt(prompt_key(toks)) is not None
+    dst.check()
+    # consumer finishes -> content evaporates from the destination
+    for p in got_pages:
+        assert dst.release(p) is True
+    assert dst.lookup_prompt(prompt_key(toks)) is None
+    assert dst.lookup_prefix(page_prefix_keys(toks, 2)[0]) is None
+    for p in occupied:
+        dst.release(p)
+    dst.check()
+    # ...and the source was never perturbed by any of it
+    assert src.lookup_prompt(prompt_key(toks)) is not None
+    src.check()
+
+
+def test_import_is_idempotent_under_registry_collision():
+    # two routers racing the same prefix into one destination: the
+    # second register_prefix is a no-op (first writer wins) and both
+    # sides can release their own pages without corrupting the winner
+    toks = list(range(4))
+    key = page_prefix_keys(toks, 2)[0]
+    dst = PageAllocator(num_pages=6, page_size=2)
+    p_win, p_lose = dst.alloc(), dst.alloc()
+    dst.register_prefix(key, p_win)
+    dst.register_prefix(key, p_lose)  # ignored
+    assert dst.lookup_prefix(key) == p_win
+    assert dst.release(p_lose) is True  # loser frees its copy
+    assert dst.lookup_prefix(key) == p_win
+    dst.check()
+    dst.release(p_win)
+    assert dst.lookup_prefix(key) is None
+    dst.check()
+
+
 # -- randomized state-machine trace ------------------------------------
 
 

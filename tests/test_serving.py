@@ -1067,18 +1067,52 @@ def test_injected_admit_fail_sheds(model_and_params):
     assert srv.summary()["shed"] == 1
 
 
-def test_resume_tokens_validation(model_and_params,
-                                  paged_model_and_params):
-    model, params = model_and_params
-    srv = GenerationServer(model, params, _greedy_cfg(), num_slots=1)
-    with pytest.raises(ValueError, match="paged"):
-        srv.submit(PROMPTS[0], resume_tokens=[1, 2])
+def test_resume_tokens_validation(paged_model_and_params):
     pmodel, pparams = paged_model_and_params
     psrv = GenerationServer(pmodel, pparams, _greedy_cfg(max_dec=4),
                             num_slots=1, page_size=128, pool_pages=2,
                             prefill_chunk_pages=1)
     with pytest.raises(ValueError, match="max_dec_len"):
         psrv.submit(PROMPTS[0], resume_tokens=[1, 2, 3, 4])
+
+
+def test_unpaged_drain_restart_token_exactness(model_and_params):
+    """The fleet-failover satellite pin: resume_tokens works on a
+    CONTIGUOUS (unpaged) server too — drain mid-flight, feed every
+    preempted partial into a fresh unpaged server, and the stitched
+    completions equal the uninterrupted lockstep rows. Router
+    failover must not depend on the paged layout."""
+    model, params = model_and_params
+    gen_cfg = _greedy_cfg()
+    ref = _lockstep(model, params, PROMPTS, gen_cfg)
+    srv = GenerationServer(model, params, gen_cfg, num_slots=2)
+    ids = [srv.submit(p) for p in PROMPTS]
+    done = {}
+    for _ in range(3):                          # mid-flight drain
+        for c in srv.step():
+            done[c.request_id] = c
+    for c in srv.drain(max_ticks=0):
+        done[c.request_id] = c
+    assert set(done) == set(ids)
+    partials = [c for c in done.values()
+                if c.finish_reason == "preempted"]
+    assert partials
+    assert any(c.tokens for c in partials)      # real mid-decode state
+
+    srv2 = GenerationServer(model, params, gen_cfg, num_slots=2)
+    remap = {}
+    for c in partials:
+        remap[srv2.submit(c.prompt,
+                          resume_tokens=c.tokens or None)] = \
+            c.request_id
+    done2 = {}
+    _drain(srv2, done2)
+    final = {rid: done[rid] for rid in ids}
+    for nid, rid in remap.items():
+        final[rid] = done2[nid]
+    assert [final[i].tokens for i in ids] == ref
+    assert all(final[i].finish_reason in ("eos", "length")
+               for i in ids)
 
 
 def test_drain_returns_queued_and_inflight_partials(model_and_params):
