@@ -37,6 +37,13 @@ geometry (models/gpt/moe.py; no reference analogue — it has no MoE).
 Reported MFU counts ACTIVE FLOPs (top-2 of 8 experts ≈ 2x the dense
 FFN per token), so it is comparable to the dense number: the delta is
 the routing/dispatch overhead.
+
+``--mode pipeline`` A/Bs the explicit pipeline schedules on a pp=4
+mesh — zero-bubble (``"zb"``, deferred dW) against the same-memory
+1F1B baseline — emitting the 1F1B row then the zb headline with
+``speedup_vs_1f1b`` plus the analytic bubble-occupancy split from
+``pipeline_tick_stats`` (``PFX_BENCH_PIPELINE_*`` knobs; see
+docs/pipeline.md).
 """
 
 import argparse
@@ -67,6 +74,7 @@ METRIC_BY_MODE = {
     "generation": "gpt345m_generation_decode_tokens_per_sec",
     "serving": "gpt345m_serving_decode_tokens_per_sec_per_chip",
     "fleet": "gpt345m_fleet_2replica_decode_tokens_per_sec_per_chip",
+    "pipeline": "gpt345m_pp4_pipeline_zb_tokens_per_sec_per_chip",
     "convergence": "gpt345m_convergence_loss_at_300",
     "67b": "gpt3_6p7b_geometry_mfu",
     "longctx": "gpt345m_long_context_s8192_mfu",
@@ -1526,6 +1534,151 @@ def bench_fleet():
     fleet.close()
 
 
+def bench_pipeline():
+    """``--mode pipeline``: zero-bubble vs 1F1B schedule A/B on a
+    pipeline mesh.
+
+    Runs the explicit-schedule training step
+    (``pipelined_lm_loss_and_grad``) twice on the same pp mesh, params
+    and batch — first ``schedule="1F1B"`` (the same-memory baseline),
+    then ``schedule="zb"`` — and emits two records: the 1F1B baseline
+    row, then the zb headline carrying
+    ``baseline_1f1b_tokens_per_sec`` and ``speedup_vs_1f1b``.  Both
+    rows also report the analytic slot-occupancy split from
+    :func:`pipeline_tick_stats` (``bubble_share``); the zb row adds
+    ``bubble_fill_ratio`` — the fraction of the 1F1B bubble the
+    deferred-dW drain reclaims, >= 0.5 at the default M=8, K=4 shape
+    (at ``M < 2K-1`` the drain window is shorter than the dW backlog).
+    On lockstep SPMD — one jitted program driving every stage — the
+    wall-clock delta is muted, so the occupancy split is the honest
+    headline; see docs/pipeline.md.
+
+    Knobs: ``PFX_BENCH_PIPELINE_STEPS`` (measured steps),
+    ``PFX_BENCH_PIPELINE_MICROBATCHES`` (M; default 8)."""
+    import flax.linen as nn
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from paddlefleetx_tpu.models.gpt.model import (
+        pipelined_lm_loss_and_grad,
+    )
+    from paddlefleetx_tpu.parallel import (
+        TopologyConfig, build_mesh, make_sharding_rules,
+    )
+    from paddlefleetx_tpu.parallel.mesh import set_mesh
+    from paddlefleetx_tpu.parallel.pipeline import (
+        pipeline_tick_stats, zb_queue_bound,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    n_dev = jax.device_count()
+    pp = 4 if n_dev >= 4 else max(n_dev, 1)
+    M = int(os.environ.get("PFX_BENCH_PIPELINE_MICROBATCHES", "8"))
+    n_steps = int(os.environ.get("PFX_BENCH_PIPELINE_STEPS",
+                                 "10" if on_tpu else "2"))
+    if on_tpu:
+        cfg = _gpt345m(True)
+        batch, seq = M, 1024
+    else:  # offline smoke: the machinery, not the 345M numbers
+        cfg = GPTConfig(vocab_size=128, hidden_size=64,
+                        num_layers=2 * pp, num_attention_heads=4,
+                        max_position_embeddings=64,
+                        hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+        batch, seq = M, 32
+
+    topo = TopologyConfig(pp_degree=pp)
+    mesh = build_mesh(topo, devices=jax.devices()[:topo.world_size])
+    set_mesh(mesh)
+    rules = make_sharding_rules(topo)
+    model = GPTForPretraining(cfg)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                      jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                         jnp.int32)
+    mask = jnp.ones((batch, seq), jnp.float32)
+    variables = jax.jit(model.init)({"params": jax.random.key(0)},
+                                    ids[:1, :8])
+    logical_specs = nn.get_partition_spec(
+        jax.eval_shape(model.init, {"params": jax.random.key(0)},
+                       jnp.zeros((1, 8), jnp.int32)))
+    shardings = nn.logical_to_mesh_sharding(logical_specs, mesh,
+                                            list(rules))
+    params = jax.device_put(nn.meta.unbox(variables),
+                            nn.meta.unbox(shardings))["params"]
+    data_sharding = NamedSharding(mesh, PartitionSpec(("dp", "fsdp"),
+                                                      None))
+    ids, labels, mask = (jax.device_put(x, data_sharding)
+                         for x in (ids, labels, mask))
+
+    def _measure(schedule):
+        """Mean step seconds (after a compile+warm call) and loss."""
+        def f(p, i, l, m):
+            return pipelined_lm_loss_and_grad(
+                cfg, p, i, l, m, pp=pp, num_microbatches=M, vpp=1,
+                deterministic=True, schedule=schedule)
+
+        with mesh, nn.logical_axis_rules(list(rules)):
+            fn = jax.jit(f)
+            loss, grads = fn(params, ids, labels, mask)
+            jax.block_until_ready((loss, grads))
+            t0 = time.perf_counter()
+            for _ in range(n_steps):
+                loss, grads = fn(params, ids, labels, mask)
+            jax.block_until_ready((loss, grads))
+            dt = (time.perf_counter() - t0) / n_steps
+        return dt, float(loss)
+
+    ts_1f1b = pipeline_tick_stats(M, pp, schedule="1f1b")
+    ts_zb = pipeline_tick_stats(M, pp, schedule="zb")
+    common = {
+        "unit": "tokens/s",
+        "vs_baseline": None,   # the reference publishes no zb number
+        "pp": pp,
+        "vpp": 1,
+        "microbatches": M,
+        "batch": batch,
+        "seq_len": seq,
+        "steps": n_steps,
+    }
+
+    dt_1f1b, loss_1f1b = _measure("1F1B")
+    base_tps = batch * seq / dt_1f1b / pp
+    base_rec = {
+        "metric": "gpt345m_pp4_pipeline_1f1b_baseline_tokens_per_sec"
+                  "_per_chip",
+        "value": round(base_tps, 1),
+        **common,
+        "step_time_ms": round(dt_1f1b * 1e3, 3),
+        "bubble_share": round(ts_1f1b["bubble_ticks"]
+                              / ts_1f1b["total_slot_ticks"], 4),
+        "loss": round(loss_1f1b, 6),
+    }
+    _log_success(base_rec)
+    print(json.dumps(base_rec))
+
+    dt_zb, loss_zb = _measure("zb")
+    zb_tps = batch * seq / dt_zb / pp
+    b1, bz = ts_1f1b["bubble_ticks"], ts_zb["bubble_ticks"]
+    result = {
+        "metric": METRIC_BY_MODE["pipeline"],
+        "value": round(zb_tps, 1),
+        **common,
+        "step_time_ms": round(dt_zb * 1e3, 3),
+        "bubble_share": round(bz / ts_zb["total_slot_ticks"], 4),
+        "bubble_ticks_1f1b": b1,
+        "bubble_ticks_zb": bz,
+        "bubble_fill_ratio": round((b1 - bz) / b1, 4) if b1 else 0.0,
+        "dw_queue_bound": zb_queue_bound(M, pp),
+        "loss_delta_vs_1f1b": abs(loss_zb - loss_1f1b),
+        "baseline_1f1b_tokens_per_sec": round(base_tps, 1),
+        "speedup_vs_1f1b": round(zb_tps / base_tps, 3)
+        if base_tps > 0 else None,
+    }
+    _log_success(result)
+    print(json.dumps(result))
+
+
 def _zipf_markov_corpus(vocab: int, n_tokens: int, seq: int,
                         seed: int = 0, s: float = 1.1,
                         p_rep: float = 0.5):
@@ -1677,7 +1830,8 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--mode",
                    choices=["train", "generation", "serving", "fleet",
-                            "moe", "convergence", "67b", "longctx"],
+                            "moe", "convergence", "67b", "longctx",
+                            "pipeline"],
                    default="train")
     args = p.parse_args()
     global _active_metric
@@ -1712,6 +1866,8 @@ def main():
         bench_serving()
     elif args.mode == "fleet":
         bench_fleet()
+    elif args.mode == "pipeline":
+        bench_pipeline()
     elif args.mode == "moe":
         bench_moe()
     elif args.mode == "convergence":

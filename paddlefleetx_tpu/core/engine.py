@@ -210,8 +210,11 @@ class Engine(BasicEngine):
         #: transfer is fully hidden behind the jitted step
         self._h2d_waits = self._metrics.series("host/h2d_wait")
         #: goodput buckets: host wall time NOT spent in productive
-        #: steps (h2d waits live in the series above)
-        self._time_buckets = {"compile": 0.0, "eval": 0.0, "save": 0.0}
+        #: steps (h2d waits live in the series above). pipeline_bubble
+        #: is the analytic schedule-idle share of clean step windows
+        #: (pp > 1 only; see _build_steps)
+        self._time_buckets = {"compile": 0.0, "eval": 0.0, "save": 0.0,
+                              "pipeline_bubble": 0.0}
         self._fit_t0 = None
         self._hbm_watermark = None
         self._compile_pending = True
@@ -378,6 +381,24 @@ class Engine(BasicEngine):
                 f"(ring) attention; cp_degree must be 1 for this "
                 f"module")
         acc = 1 if self.topo.pp_degree > 1 else self.accumulate_steps
+        # analytic share of each step's wall time that is pipeline
+        # schedule idle (bubble): slot-ticks with no scheduled work
+        # over total slot-ticks of the (M, K) grid. Static per config,
+        # so clean step windows are apportioned into the
+        # pipeline_bubble goodput bucket by this fraction.
+        self._pipeline_bubble_share = 0.0
+        mcfg = getattr(getattr(self.module, "model", None), "config",
+                       None)
+        if self.topo.pp_degree > 1 and mcfg is not None:
+            from ..parallel.pipeline import pipeline_tick_stats
+            sched = {"1F1B": "1f1b", "zb": "zb"}.get(
+                getattr(mcfg, "pipeline_schedule", "1F1B"), "gpipe")
+            k_total = self.topo.pp_degree * getattr(
+                mcfg, "virtual_pp_degree", 1)
+            ts = pipeline_tick_stats(max(1, self.accumulate_steps),
+                                     k_total, schedule=sched)
+            self._pipeline_bubble_share = (
+                ts["bubble_ticks"] / ts["total_slot_ticks"])
         tx, schedule = self.tx, self.lr_schedule
         root_rng = self.root_rng
         param_shardings = self.state_shardings["params"]
@@ -620,7 +641,8 @@ class Engine(BasicEngine):
         self._finalize_vit_schedule(train_data_loader)
         del self._step_costs[:]   # per-fit summary samples (registry
         del self._h2d_waits[:]    # aliases — clear, don't rebind)
-        self._time_buckets = {"compile": 0.0, "eval": 0.0, "save": 0.0}
+        self._time_buckets = {"compile": 0.0, "eval": 0.0, "save": 0.0,
+                              "pipeline_bubble": 0.0}
         self._fit_t0 = time.time()
         self._compile_pending = True
         self._preempt_signum = None
@@ -786,6 +808,14 @@ class Engine(BasicEngine):
                         self._step_costs.append(cost)
                         self._metrics.observe("engine/step_time_ms",
                                               cost * 1000.0)
+                        # steady-state windows only (the first clean
+                        # window still holds compile, which the
+                        # summary likewise skips via costs[0])
+                        if self._pipeline_bubble_share and \
+                                len(self._step_costs) > 1:
+                            self._time_buckets["pipeline_bubble"] += (
+                                cost * self.logging_freq *
+                                self._pipeline_bubble_share)
                     if self._recorder is not None:
                         w = self._h2d_waits[-self.logging_freq:]
                         self._recorder.emit(
@@ -896,14 +926,17 @@ class Engine(BasicEngine):
             total = max(time.time() - self._fit_t0, 1e-9)
             h2d = sum(self._h2d_waits)
             b = self._time_buckets
+            bubble = b.get("pipeline_bubble", 0.0)
             productive = max(
-                total - b["compile"] - b["eval"] - b["save"] - h2d,
+                total - b["compile"] - b["eval"] - b["save"] - h2d
+                - bubble,
                 0.0)
             stats["wall_total_s"] = total
             stats["bucket_compile_s"] = b["compile"]
             stats["bucket_eval_s"] = b["eval"]
             stats["bucket_save_s"] = b["save"]
             stats["bucket_h2d_s"] = h2d
+            stats["bucket_pipeline_bubble_s"] = bubble
             stats["goodput_pct"] = 100.0 * productive / total
         if self._hbm_watermark:
             stats["hbm_bytes_in_use"] = \
@@ -977,10 +1010,11 @@ class Engine(BasicEngine):
             logger.info(
                 "  goodput: %.1f%% productive step time of %.1f s "
                 "wall (compile %.2f / eval %.2f / save %.2f / h2d "
-                "%.2f s)", stats["goodput_pct"],
+                "%.2f / pipeline_bubble %.2f s)", stats["goodput_pct"],
                 stats["wall_total_s"], stats["bucket_compile_s"],
                 stats["bucket_eval_s"], stats["bucket_save_s"],
-                stats["bucket_h2d_s"])
+                stats["bucket_h2d_s"],
+                stats.get("bucket_pipeline_bubble_s", 0.0))
         logger.info(
             "  HBM watermark: %s",
             "%s in use / %s peak of %s" % (

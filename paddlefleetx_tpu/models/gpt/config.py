@@ -45,7 +45,11 @@ class GPTConfig:
     virtual_pp_degree: int = 1
     #: pipeline schedule when pp_degree > 1. "1F1B" (reference default,
     #: bounded activation memory via the explicit fwd/bwd-interleaved
-    #: schedule) or "GPipe" (all-forwards-then-autodiff).
+    #: schedule), "zb" (zero-bubble: dX stays on the 1F1B critical
+    #: path, dW is deferred into a bounded per-stage queue and drained
+    #: during former bubble ticks — grads identical to 1F1B; see
+    #: docs/pipeline.md), or "GPipe" (all-forwards-then-autodiff).
+    #: Case-insensitive; canonicalized in __post_init__.
     pipeline_schedule: str = "1F1B"
     # TPU-specific knobs (absent in reference):
     scan_layers: bool = True              # lax.scan over layers
@@ -132,10 +136,13 @@ class GPTConfig:
             raise ValueError(
                 f"unknown recompute_granularity "
                 f"{self.recompute_granularity!r}")
-        if self.pipeline_schedule not in ("1F1B", "GPipe"):
+        canon = {"1f1b": "1F1B", "gpipe": "GPipe", "zb": "zb"}.get(
+            str(self.pipeline_schedule).lower())
+        if canon is None:
             raise ValueError(
                 f"unknown pipeline_schedule {self.pipeline_schedule!r} "
-                f"(expected '1F1B' or 'GPipe')")
+                f"(expected '1F1B', 'zb' or 'GPipe')")
+        object.__setattr__(self, "pipeline_schedule", canon)
         if self.context_parallel_algo not in ("ring", "ulysses"):
             raise ValueError(
                 f"unknown context_parallel_algo "

@@ -856,15 +856,13 @@ def _pipeline_parts(cfg: GPTConfig, input_ids, position_ids,
                     deterministic: bool, rng):
     """Shared setup for the pipelined loss paths: embedding output,
     the per-layer apply fn (remat-wrapped), final norm + tied head
-    pieces, and the split rngs."""
+    pieces, the split rngs, and whether each layer emits an aux loss
+    (MoE router aux — the pipeline schedules thread it through as an
+    explicit output with its own cotangent)."""
     if not cfg.scan_layers:
         raise ValueError("pipeline parallelism requires scan_layers=True "
                          "(stacked decoder params)")
-    if cfg.moe_num_experts:
-        raise ValueError("MoE is not supported with pipeline "
-                         "parallelism (the per-layer router aux loss "
-                         "is not plumbed through the 1F1B schedule); "
-                         "use ep x tp x dp/fsdp")
+    has_aux = bool(cfg.moe_num_experts)
     if position_ids is None:
         position_ids = jnp.broadcast_to(
             jnp.arange(input_ids.shape[-1], dtype=jnp.int32)[None, :],
@@ -885,7 +883,7 @@ def _pipeline_parts(cfg: GPTConfig, input_ids, position_ids,
         layer_apply = jax.checkpoint(
             layer_apply, policy=_remat_policy(cfg.recompute_granularity))
 
-    return emb_fwd, layer_apply, pipe_rng
+    return emb_fwd, layer_apply, pipe_rng, has_aux
 
 
 def pipelined_lm_loss(cfg: GPTConfig, params, input_ids, labels,
@@ -908,7 +906,7 @@ def pipelined_lm_loss(cfg: GPTConfig, params, input_ids, labels,
     """
     from ...parallel.pipeline import pipeline_forward
 
-    emb_fwd, layer_apply, pipe_rng = _pipeline_parts(
+    emb_fwd, layer_apply, pipe_rng, has_aux = _pipeline_parts(
         cfg, input_ids, position_ids, deterministic, rng)
     emb_params = params["gpt"]["embeddings"]
     x = emb_fwd(emb_params)
@@ -928,19 +926,24 @@ def pipelined_lm_loss(cfg: GPTConfig, params, input_ids, labels,
                                     labels_mb, mask_mb)
         return acc + nll / jnp.maximum(msum, 1.0)
 
+    # forward-only path drops the MoE router aux (pure CE — matching
+    # the non-pipelined eval criterion, which also excludes aux)
     loss_sum = pipeline_forward(
         layer_apply, params["gpt"]["decoder"], x,
         pp=pp, num_microbatches=num_microbatches, vpp=vpp,
         out_fn=head_and_loss, out_init=jnp.zeros((), jnp.float32),
-        extras=(labels, loss_mask), rng=pipe_rng)
+        extras=(labels, loss_mask), rng=pipe_rng,
+        layer_has_aux=has_aux)
     return loss_sum / num_microbatches
 
 
 def pipelined_lm_loss_and_grad(
         cfg: GPTConfig, params, input_ids, labels, loss_mask, *,
         pp: int, num_microbatches: int, vpp: int = 1, rng=None,
-        position_ids=None, deterministic: bool = True):
-    """Loss AND parameter gradients under the explicit 1F1B schedule.
+        position_ids=None, deterministic: bool = True,
+        schedule: str = "1F1B"):
+    """Loss AND parameter gradients under the explicit 1F1B (or
+    zero-bubble ``"zb"``) schedule.
 
     ``jax.grad(pipelined_lm_loss)`` differentiates through the GPipe
     scan, which stashes every microbatch's stage activations before any
@@ -956,7 +959,7 @@ def pipelined_lm_loss_and_grad(
     """
     from ...parallel.pipeline import pipeline_value_and_grad
 
-    emb_fwd, layer_apply, pipe_rng = _pipeline_parts(
+    emb_fwd, layer_apply, pipe_rng, has_aux = _pipeline_parts(
         cfg, input_ids, position_ids, deterministic, rng)
     emb_params = params["gpt"]["embeddings"]
     extra = set(params["gpt"]) - {"embeddings", "decoder", "final_norm"}
@@ -986,7 +989,8 @@ def pipelined_lm_loss_and_grad(
         layer_apply, params["gpt"]["decoder"], x,
         pp=pp, num_microbatches=num_microbatches, vpp=vpp,
         loss_and_grad=head_loss_and_grad,
-        extras=(labels, loss_mask), rng=pipe_rng)
+        extras=(labels, loss_mask), rng=pipe_rng,
+        schedule=schedule, layer_has_aux=has_aux)
 
     (demb,) = emb_pull(dx.astype(x.dtype))
     # fold the tied LM head's word-embedding gradient into the

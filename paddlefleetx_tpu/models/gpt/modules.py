@@ -145,19 +145,31 @@ class GPTModule(LanguageModule):
     def loss_and_grad(self, params, batch, rng):
         """One-pass (loss, grads) for the engine's train step.
 
-        With pp>1 under the default ``pipeline_schedule: 1F1B`` this
-        drives the explicit 1F1B schedule (bounded activation memory);
+        With pp>1 under ``pipeline_schedule: 1F1B`` (default) or
+        ``zb`` this drives the explicit schedule in
+        ``pipeline_value_and_grad`` (bounded activation memory; zb
+        additionally drains deferred weight-grads into the bubble);
         otherwise it is plain ``jax.value_and_grad`` of ``loss_fn``.
         """
         pp, m, deterministic = self._pp_setup(batch[0], train=True)
-        if pp > 1 and self.model_config.pipeline_schedule == "1F1B":
+        sched = self.model_config.pipeline_schedule
+        if pp > 1 and sched in ("1F1B", "zb"):
             from .model import pipelined_lm_loss_and_grad
             tokens, position_ids, labels, loss_mask = batch
             return pipelined_lm_loss_and_grad(
                 self.model_config, params, tokens, labels, loss_mask,
                 pp=pp, num_microbatches=m,
                 vpp=self.model_config.virtual_pp_degree, rng=rng,
-                position_ids=position_ids, deterministic=deterministic)
+                position_ids=position_ids, deterministic=deterministic,
+                schedule=sched)
+        if pp > 1 and self.model_config.moe_num_experts:
+            # GPipe trains via autodiff through pipeline_forward, which
+            # discards the router aux — refuse rather than silently
+            # train without the load-balance term
+            raise ValueError(
+                "MoE with pipeline parallelism requires "
+                "pipeline_schedule '1F1B' or 'zb' (GPipe's autodiff "
+                "path drops the router aux loss)")
         return jax.value_and_grad(
             lambda p: self.loss_fn(p, batch, rng, train=True))(params)
 

@@ -74,11 +74,14 @@ def process_model_configs(config) -> None:
                 f"divisible by cp_degree*mp_degree ({cp * mp})")
     n_experts = model.get("moe_num_experts") or 0
     if n_experts:
-        if pp > 1:
+        if pp > 1 and str(
+                model.get("pipeline_schedule", "1F1B")).lower() == \
+                "gpipe":
             raise ValueError(
-                "MoE is not supported with pipeline parallelism "
-                "(the per-layer router aux loss is not plumbed "
-                "through the 1F1B schedule); use ep x tp x dp/fsdp")
+                "MoE with pipeline parallelism requires "
+                "pipeline_schedule '1F1B' or 'zb' (GPipe trains via "
+                "autodiff through the forward-only schedule, which "
+                "drops the per-layer router aux loss)")
         ep = config.Distributed.get("ep_degree") or 1
         if n_experts % ep != 0:
             raise ValueError(

@@ -103,12 +103,16 @@ class FlightRecorder:
         first when the file would exceed ``max_bytes``."""
         if self._f is None:
             return
-        record = {"ts": round(time.time(), 3), "event": event}
-        record.update(fields)
         if self._size >= self.max_bytes and self._size > 0:
             self._rotate()
             if self._f is None:
                 return
+        # stamped AFTER any rotation: the roll writes its own
+        # recorder_rotated event, and a pre-roll stamp would order
+        # this record before it whenever the roll's fsync crosses a
+        # millisecond boundary
+        record = {"ts": round(time.time(), 3), "event": event}
+        record.update(fields)
         self._write(record)
 
     def tail(self, n: int = 10) -> List[Dict[str, Any]]:

@@ -49,6 +49,25 @@ is consumed ``2(K - 1 - k) + 1 < 2K`` ticks later, so a depth-``2K``
 ring buffer never collides. The 1F1B bubble is the same ``(K-1)``-tick
 fill/drain as GPipe's; the win is memory (the reference's motivation
 for defaulting to 1F1B).
+
+Zero-bubble schedule (``schedule="zb"``, after the ZB-H1 family of
+arXiv:2412.14374): each stage's backward splits into dX (the input
+cotangent, which stays on the critical path — the next stage's
+backward needs it one tick later) and dW (the weight gradient, which
+nothing downstream consumes until the optimizer). dX runs at the same
+tick 1F1B runs the combined backward; the dW job is pushed into a
+bounded per-slot FIFO and drained during ticks where that slot's
+backward wave is otherwise idle — virtual stage ``k`` has exactly
+``k`` such drain-bubble ticks at the end of the schedule, so its
+queue capacity is ``min(k, M)`` and every deferred dW lands in a
+formerly-empty slot-tick. The drain order is FIFO, so per-slot weight
+gradients accumulate in the same microbatch order as 1F1B and the
+results match bitwise up to XLA scheduling. Because the whole
+schedule is a static function of ``(M, K)``, the pop timetable is
+precomputed host-side (``zb_dw_schedule``) and fed to the scan as
+per-tick indices; the same host math yields the
+``pipeline/{fwd,bwd_dx,bwd_dw,bubble}_ticks`` trace-time counters
+that make the occupancy win auditable (docs/pipeline.md).
 """
 
 from __future__ import annotations
@@ -57,8 +76,10 @@ from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..observability import metrics
 from .mesh import DATA_AXES, PP_AXIS, get_mesh
 
 
@@ -126,6 +147,98 @@ def _slot_keys(base_rng: jax.Array, m_arr: jax.Array,
     return jax.vmap(key_for)(m_arr, k_arr)
 
 
+def zb_queue_bound(num_microbatches: int, num_virtual_stages: int) -> int:
+    """Upper bound on the zb per-slot dW-queue depth: virtual stage
+    ``k`` defers at most ``min(k, M)`` weight-grad jobs (it has exactly
+    ``k`` drain-bubble ticks to spend them in), so no slot ever queues
+    more than ``min(K - 1, M)`` microbatch cotangents."""
+    return min(num_virtual_stages - 1, num_microbatches)
+
+
+def zb_dw_schedule(num_microbatches: int, num_virtual_stages: int):
+    """Static dW drain timetable for the zero-bubble schedule.
+
+    Pure host math — the 1F1B tick grid is a fixed function of
+    ``(M, K)``, so *when* each deferred weight-grad job runs is decided
+    here, not inside the scan. Per virtual stage ``k`` a FIFO of
+    capacity ``min(k, M)`` receives one job at each dX tick; a job pops
+    (and its dW runs) either when the push would overflow the capacity
+    (steady state — the same tick, exactly like 1F1B, for ``k = 0``) or
+    at a tick where the slot's backward wave is idle (the former
+    drain-bubble ticks, which the deferred jobs now fill).
+
+    Returns ``(dw_m, max_depth)``: ``dw_m`` is an int ``[T, K]`` array
+    (``T = M + 2K - 1``) whose entry is the microbatch whose dW runs at
+    that (tick, virtual stage), or ``-1``; ``max_depth`` is the deepest
+    any FIFO ever got (``<= zb_queue_bound(M, K)``).
+    """
+    M, K = num_microbatches, num_virtual_stages
+    T = M + 2 * K - 1
+    dw_m = np.full((T, K), -1, np.int32)
+    max_depth = 0
+    for k in range(K):
+        cap = min(k, M)
+        fifo: list = []
+        for t in range(T):
+            m_b = t - (2 * K - 1 - k)
+            if 0 <= m_b < M:
+                fifo.append(m_b)
+                if len(fifo) > cap:
+                    dw_m[t, k] = fifo.pop(0)
+            elif fifo:
+                dw_m[t, k] = fifo.pop(0)
+            max_depth = max(max_depth, len(fifo))
+        if fifo:   # every job must drain within the schedule
+            raise AssertionError(
+                f"zb schedule leaked {len(fifo)} dW jobs at stage {k}")
+    return dw_m, max_depth
+
+
+def pipeline_tick_stats(num_microbatches: int, num_virtual_stages: int,
+                        schedule: str = "1f1b") -> dict:
+    """Analytic (slot, tick) occupancy of a pipeline schedule.
+
+    The scan runs in SPMD lockstep, so tick counts are trace-time
+    constants — this is the single source for the
+    ``pipeline/{fwd,bwd_dx,bwd_dw,bubble}_ticks`` counters and the
+    engine's ``pipeline_bubble`` goodput bucket. A slot-tick counts as
+    ``bubble`` when the slot schedules NO useful work there: no valid
+    forward, no valid dX/backward, and (zb) no drained dW job. For
+    ``M >= 2K - 1`` the zb drain fills every trailing bubble slot-tick,
+    halving ``bubble_ticks`` vs 1f1b — the fill-phase half precedes any
+    runnable job and is irreducible in a lockstep schedule.
+    """
+    M, K = num_microbatches, num_virtual_stages
+    sched = str(schedule).lower()
+    if sched == "gpipe":
+        T = M + K - 1
+        fwd = np.zeros((T, K), bool)
+        for k in range(K):
+            fwd[k:k + M, k] = True
+        return {"fwd_ticks": int(fwd.sum()), "bwd_dx_ticks": 0,
+                "bwd_dw_ticks": 0,
+                "bubble_ticks": int(T * K - fwd.sum()),
+                "total_slot_ticks": T * K}
+    if sched not in ("1f1b", "zb"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    T = M + 2 * K - 1
+    fwd = np.zeros((T, K), bool)
+    bwd = np.zeros((T, K), bool)
+    for k in range(K):
+        fwd[k:k + M, k] = True
+        bwd[2 * K - 1 - k:2 * K - 1 - k + M, k] = True
+    if sched == "zb":
+        dw = zb_dw_schedule(M, K)[0] >= 0
+    else:
+        dw = bwd   # 1f1b computes dW in the same tick as dX
+    busy = fwd | bwd | dw
+    return {"fwd_ticks": int(fwd.sum()),
+            "bwd_dx_ticks": int(bwd.sum()),
+            "bwd_dw_ticks": int(dw.sum()),
+            "bubble_ticks": int(T * K - busy.sum()),
+            "total_slot_ticks": T * K}
+
+
 def pipeline_forward(
     layer_apply: Callable[[Any, jax.Array, jax.Array], jax.Array],
     stacked_params: Any,
@@ -138,6 +251,7 @@ def pipeline_forward(
     out_init: Any = None,
     extras: Any = None,
     rng: Optional[jax.Array] = None,
+    layer_has_aux: bool = False,
 ) -> Any:
     """Run ``x`` through ``L`` stacked layers with a GPipe-scheduled
     ``pp``-stage (optionally ``vpp``-way interleaved) pipeline.
@@ -164,6 +278,10 @@ def pipeline_forward(
         fed to ``out_fn`` (labels, loss masks).
       rng: base dropout key; folded per (microbatch, virtual stage,
         layer).
+      layer_has_aux: ``layer_apply`` returns ``(h, aux_scalar)`` (MoE
+        layers: the router aux loss). This forward-only schedule
+        DISCARDS the aux — eval reports pure CE (docs/moe.md); the
+        training aux flows through ``pipeline_value_and_grad``.
 
     Returns the reducer carry, or the ``[B, ...]`` outputs when
     ``out_fn`` is None.
@@ -173,6 +291,9 @@ def pipeline_forward(
     B = x.shape[0]
     if B % M != 0:
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    ts = pipeline_tick_stats(M, K, schedule="gpipe")
+    metrics.inc("pipeline/fwd_ticks", ts["fwd_ticks"])
+    metrics.inc("pipeline/bubble_ticks", ts["bubble_ticks"])
     slot_params, Lc = _slot_params(stacked_params, S, vpp)
 
     x_mb = x.reshape(M, B // M, *x.shape[1:])
@@ -192,7 +313,8 @@ def pipeline_forward(
     def stage_fn(sp, h, key):
         def body(h, xs):
             lp, k = xs
-            return layer_apply(lp, h, k), None
+            out = layer_apply(lp, h, k)
+            return (out[0] if layer_has_aux else out), None
         h, _ = jax.lax.scan(body, h, (sp, jax.random.split(key, Lc)))
         return h
 
@@ -260,8 +382,11 @@ def pipeline_value_and_grad(
                             Tuple[jax.Array, jax.Array, Any]],
     extras: Any = None,
     rng: Optional[jax.Array] = None,
+    schedule: str = "1f1b",
+    layer_has_aux: bool = False,
 ) -> Tuple[jax.Array, Any, Any, jax.Array]:
-    """Explicit 1F1B schedule: loss AND gradients in one pass.
+    """Explicit 1F1B (or zero-bubble) schedule: loss AND gradients in
+    one pass.
 
     Unlike ``jax.grad(pipeline_forward)`` — which structurally runs
     all forwards before any backward and therefore stashes every
@@ -284,6 +409,17 @@ def pipeline_value_and_grad(
         dhead_mb)`` — per-microbatch loss, its cotangent wrt ``y_mb``,
         and the gradient pytree for any head/criterion parameters
         closed over by the caller (summed over microbatches here).
+      schedule: ``"1f1b"`` (the combined backward above) or ``"zb"``
+        (zero-bubble: dX-only vjp on the critical path, dW replayed
+        from the stashed input at the statically precomputed drain
+        tick — see the module docstring). Gradients are identical
+        between the two: the dW FIFO drains in microbatch order, so
+        even the fp32 accumulation order matches.
+      layer_has_aux: ``layer_apply`` returns ``(h, aux_scalar)`` (MoE
+        router aux loss). The aux of every valid (microbatch, virtual
+        stage) is added to ``loss_sum`` at its forward tick, and a
+        unit aux cotangent rides the matching dX/dW pulls so router
+        gradients flow through both schedules.
 
     Returns ``(loss_sum, d_stacked, dhead_sum, dx)`` where
     ``d_stacked`` matches ``stacked_params``' ``[L, ...]`` layout,
@@ -297,6 +433,18 @@ def pipeline_value_and_grad(
     B = x.shape[0]
     if B % M != 0:
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    sched = str(schedule).lower()
+    if sched not in ("1f1b", "zb"):
+        raise ValueError(
+            f"unknown pipeline schedule {schedule!r} (expected '1f1b' "
+            f"or 'zb'; GPipe routes through pipeline_forward)")
+    # trace-time occupancy counters: the tick grid is a static function
+    # of (M, K), so one inc per compilation records the whole schedule
+    ts = pipeline_tick_stats(M, K, schedule=sched)
+    metrics.inc("pipeline/fwd_ticks", ts["fwd_ticks"])
+    metrics.inc("pipeline/bwd_dx_ticks", ts["bwd_dx_ticks"])
+    metrics.inc("pipeline/bwd_dw_ticks", ts["bwd_dw_ticks"])
+    metrics.inc("pipeline/bubble_ticks", ts["bubble_ticks"])
     slot_params, Lc = _slot_params(stacked_params, S, vpp)
 
     x_mb = x.reshape(M, B // M, *x.shape[1:])
@@ -310,17 +458,52 @@ def pipeline_value_and_grad(
     def stage_fn(sp, h, key):
         def body(h, xs):
             lp, k = xs
+            if layer_has_aux:
+                h, aux = layer_apply(lp, h, k)
+                return h, aux
             return layer_apply(lp, h, k), None
-        h, _ = jax.lax.scan(body, h, (sp, jax.random.split(key, Lc)))
+        h, auxs = jax.lax.scan(body, h, (sp, jax.random.split(key, Lc)))
+        if layer_has_aux:
+            return h, jnp.sum(auxs)
         return h
 
     slot_stage = jax.vmap(jax.vmap(stage_fn))
 
+    # The combined pull (1f1b) extracts dW and dX from one backward;
+    # the zb pulls split them — dX on the critical path, dW replayed
+    # later from the stashed input. With layer_has_aux the aux
+    # cotangent (1.0 on valid work, else 0.0) rides along so router
+    # aux gradients flow at exactly the ticks the matching dX/dW run.
     def slot_vjp(sp, h, key, g):
         _, pull = jax.vjp(lambda p, hh: stage_fn(p, hh, key), sp, h)
         return pull(g)
 
+    def slot_vjp_aux(sp, h, key, g, a_ct):
+        _, pull = jax.vjp(lambda p, hh: stage_fn(p, hh, key), sp, h)
+        return pull((g, a_ct))
+
+    def slot_dx(sp, h, key, g):
+        _, pull = jax.vjp(lambda hh: stage_fn(sp, hh, key), h)
+        return pull(g)[0]
+
+    def slot_dx_aux(sp, h, key, g, a_ct):
+        _, pull = jax.vjp(lambda hh: stage_fn(sp, hh, key), h)
+        return pull((g, a_ct))[0]
+
+    def slot_dw(sp, h, key, g):
+        _, pull = jax.vjp(lambda p: stage_fn(p, h, key), sp)
+        return pull(g)[0]
+
+    def slot_dw_aux(sp, h, key, g, a_ct):
+        _, pull = jax.vjp(lambda p: stage_fn(p, h, key), sp)
+        return pull((g, a_ct))[0]
+
     slot_backward = jax.vmap(jax.vmap(slot_vjp))
+    slot_backward_aux = jax.vmap(jax.vmap(slot_vjp_aux))
+    slot_backward_dx = jax.vmap(jax.vmap(slot_dx))
+    slot_backward_dx_aux = jax.vmap(jax.vmap(slot_dx_aux))
+    slot_backward_dw = jax.vmap(jax.vmap(slot_dw))
+    slot_backward_dw_aux = jax.vmap(jax.vmap(slot_dw_aux))
 
     # zero templates for the loss head's outputs
     y_abs = jax.ShapeDtypeStruct(mb_shape, x.dtype)
@@ -350,13 +533,21 @@ def pipeline_value_and_grad(
 
     k_arr = jnp.arange(K)
 
-    def tick(carry, t):
-        """One 1F1B clock: forward wave + backward wave + grad
-        accumulation in a single step."""
-        fstate, b_out, dy_prev, stash, loss_sum, dparams, dhead, dx = \
-            carry
+    def _gather_ring(ring, depths):
+        """Per-slot dynamic read of a ``[vpp, S, depth, ...]`` ring."""
+        return jax.vmap(jax.vmap(
+            lambda st, d: jax.lax.dynamic_index_in_dim(
+                st, d, 0, keepdims=False)))(ring,
+                                            depths.reshape(vpp, S))
 
-        # ---- forward wave -------------------------------------------
+    def _accumulate(dparams, dp, mask):
+        return jax.tree.map(
+            lambda acc, g: acc + jnp.where(
+                mask.reshape(mask.shape + (1,) * (g.ndim - 2)),
+                g.astype(jnp.float32), 0.0),
+            dparams, dp)
+
+    def _forward_wave(fstate, stash, loss_sum, t):
         inp = jax.lax.dynamic_index_in_dim(
             x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False)
         # .at[0, 0].set is a two-dim-index scatter; with dim 1 sharded
@@ -371,10 +562,17 @@ def pipeline_value_and_grad(
                            P(None, PP_AXIS, None, DATA_AXES))
         m_f = jnp.clip(t - k_arr, 0, M - 1)
         f_keys = _slot_keys(base_rng, m_f, K).reshape(vpp, S)
-        processed = slot_stage(slot_params, fstate, f_keys)
+        if layer_has_aux:
+            processed, aux_f = slot_stage(slot_params, fstate, f_keys)
+            valid_f = jnp.logical_and(t - k_arr >= 0, t - k_arr < M)
+            loss_sum = loss_sum + jnp.sum(
+                jnp.where(valid_f.reshape(vpp, S), aux_f, 0.0))
+        else:
+            processed = slot_stage(slot_params, fstate, f_keys)
         processed = _constrain(processed, P(None, PP_AXIS, DATA_AXES))
+        return processed, stash, loss_sum
 
-        # ---- loss head on the freshly finished microbatch -----------
+    def _loss_head(processed, t, loss_sum, dhead):
         m_l = t - (K - 1)
         y_last = processed[-1, -1]
         ex = jax.tree.map(
@@ -393,47 +591,139 @@ def pipeline_value_and_grad(
                                                  no_loss, None)
         loss_sum = loss_sum + loss_mb
         dhead = jax.tree.map(jnp.add, dhead, dhead_mb)
+        return loss_sum, dy_new, dhead
 
-        # ---- backward wave ------------------------------------------
-        m_b = t - (2 * K - 1 - k_arr)
-        valid_b = jnp.logical_and(m_b >= 0, m_b < M)
-        g_in = _retreat(b_out, dy_prev, vpp)
-        g_in = _constrain(g_in, P(None, PP_AXIS, DATA_AXES))
-        depth = (t - (2 * K - 1) + 2 * k_arr) % D  # forward-tick slot
-        x_in = jax.vmap(jax.vmap(
-            lambda st, d: jax.lax.dynamic_index_in_dim(
-                st, d, 0, keepdims=False)))(
-            stash, depth.reshape(vpp, S))
-        b_keys = _slot_keys(base_rng, jnp.clip(m_b, 0, M - 1),
-                            K).reshape(vpp, S)
-        dp, dh = slot_backward(slot_params, x_in, b_keys,
-                               g_in.astype(x.dtype))
-        mask = valid_b.reshape(vpp, S)
-        dparams = jax.tree.map(
-            lambda acc, g: acc + jnp.where(
-                mask.reshape(mask.shape + (1,) * (g.ndim - 2)),
-                g.astype(jnp.float32), 0.0),
-            dparams, dp)
-        b_out_new = _constrain(dh.astype(jnp.float32),
-                               P(None, PP_AXIS, DATA_AXES))
-
+    def _dx_capture(dx, dh, t):
         # cotangent wrt the pipeline input, for the embedding backward
         m_b0 = t - (2 * K - 1)
-        dx = jax.lax.cond(
+        return jax.lax.cond(
             jnp.logical_and(m_b0 >= 0, m_b0 < M),
             lambda d: jax.lax.dynamic_update_index_in_dim(
                 d, dh[0, 0].astype(jnp.float32),
                 jnp.clip(m_b0, 0, M - 1), 0),
             lambda d: d, dx)
 
-        fstate = _advance(processed, vpp)
-        return (fstate, b_out_new, dy_new, stash, loss_sum, dparams,
-                dhead, dx), None
+    if sched == "1f1b":
+        def tick(carry, t):
+            """One 1F1B clock: forward wave + combined backward wave
+            (dW and dX in a single pull)."""
+            fstate, b_out, dy_prev, stash, loss_sum, dparams, dhead, \
+                dx = carry
+            processed, stash, loss_sum = _forward_wave(
+                fstate, stash, loss_sum, t)
+            loss_sum, dy_new, dhead = _loss_head(
+                processed, t, loss_sum, dhead)
 
-    carry0 = (fstate0, bstate0, dy0, stash0, loss0, dparams0, dhead0,
-              dx0)
-    (_, _, _, _, loss_sum, dparams, dhead, dx), _ = jax.lax.scan(
-        tick, carry0, jnp.arange(M + 2 * K - 1))
+            # ---- backward wave --------------------------------------
+            m_b = t - (2 * K - 1 - k_arr)
+            valid_b = jnp.logical_and(m_b >= 0, m_b < M)
+            g_in = _retreat(b_out, dy_prev, vpp)
+            g_in = _constrain(g_in, P(None, PP_AXIS, DATA_AXES))
+            depth = (t - (2 * K - 1) + 2 * k_arr) % D  # fwd-tick slot
+            x_in = _gather_ring(stash, depth)
+            b_keys = _slot_keys(base_rng, jnp.clip(m_b, 0, M - 1),
+                                K).reshape(vpp, S)
+            g_cast = g_in.astype(x.dtype)
+            if layer_has_aux:
+                dp, dh = slot_backward_aux(
+                    slot_params, x_in, b_keys, g_cast,
+                    valid_b.astype(jnp.float32).reshape(vpp, S))
+            else:
+                dp, dh = slot_backward(slot_params, x_in, b_keys,
+                                       g_cast)
+            dparams = _accumulate(dparams, dp, valid_b.reshape(vpp, S))
+            b_out_new = _constrain(dh.astype(jnp.float32),
+                                   P(None, PP_AXIS, DATA_AXES))
+            dx = _dx_capture(dx, dh, t)
+
+            fstate = _advance(processed, vpp)
+            return (fstate, b_out_new, dy_new, stash, loss_sum,
+                    dparams, dhead, dx), None
+
+        carry0 = (fstate0, bstate0, dy0, stash0, loss0, dparams0,
+                  dhead0, dx0)
+        (_, _, _, _, loss_sum, dparams, dhead, dx), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(M + 2 * K - 1))
+    else:
+        # ---- zero-bubble: dX on the critical path, dW drained at the
+        # statically precomputed tick (module docstring) --------------
+        dw_np, _ = zb_dw_schedule(M, K)
+        dw_rows = jnp.asarray(dw_np.reshape(len(dw_np), vpp, S))
+        # cotangent ring: the dW queue holds at most min(k, M) + 1
+        # entries per slot (<= K), indexed m % K; row K is scratch so
+        # masked writes never clobber a live entry
+        gstash0 = _constrain(
+            jnp.zeros((vpp, S, K + 1) + mb_shape, x.dtype),
+            P(None, PP_AXIS, None, DATA_AXES))
+
+        def tick(carry, xs):
+            """One zb clock: forward wave + dX wave + dW drain."""
+            t, dw_m = xs
+            fstate, b_out, dy_prev, stash, gstash, loss_sum, dparams, \
+                dhead, dx = carry
+            processed, stash, loss_sum = _forward_wave(
+                fstate, stash, loss_sum, t)
+            loss_sum, dy_new, dhead = _loss_head(
+                processed, t, loss_sum, dhead)
+
+            # ---- dX wave (critical path) ----------------------------
+            m_b = t - (2 * K - 1 - k_arr)
+            valid_b = jnp.logical_and(m_b >= 0, m_b < M)
+            g_in = _retreat(b_out, dy_prev, vpp)
+            g_in = _constrain(g_in, P(None, PP_AXIS, DATA_AXES))
+            depth = (t - (2 * K - 1) + 2 * k_arr) % D
+            x_in = _gather_ring(stash, depth)
+            b_keys = _slot_keys(base_rng, jnp.clip(m_b, 0, M - 1),
+                                K).reshape(vpp, S)
+            g_cast = g_in.astype(x.dtype)
+            if layer_has_aux:
+                dh = slot_backward_dx_aux(
+                    slot_params, x_in, b_keys, g_cast,
+                    valid_b.astype(jnp.float32).reshape(vpp, S))
+            else:
+                dh = slot_backward_dx(slot_params, x_in, b_keys,
+                                      g_cast)
+            b_out_new = _constrain(dh.astype(jnp.float32),
+                                   P(None, PP_AXIS, DATA_AXES))
+            dx = _dx_capture(dx, dh, t)
+
+            # enqueue the cotangent for the deferred dW. The write
+            # happens before the drain read on purpose: the k=0 slot
+            # (capacity 0) pops the entry it pushed this very tick.
+            gdepth = jnp.where(valid_b, jnp.clip(m_b, 0, M - 1) % K, K)
+            gstash = jax.vmap(jax.vmap(
+                lambda gs, d, gg:
+                jax.lax.dynamic_update_index_in_dim(gs, gg, d, 0)))(
+                gstash, gdepth.reshape(vpp, S), g_cast)
+            gstash = _constrain(gstash,
+                                P(None, PP_AXIS, None, DATA_AXES))
+
+            # ---- dW drain at the precomputed tick -------------------
+            dw_flat = dw_m.reshape(K)
+            valid_w = dw_flat >= 0
+            w_m = jnp.clip(dw_flat, 0, M - 1)
+            # forward of mb m at slot k ran at tick m + k, so its
+            # stashed input lives at ring depth (m + k) % D
+            x_w = _gather_ring(stash, (w_m + k_arr) % D)
+            g_w = _gather_ring(gstash, jnp.where(valid_w, w_m % K, K))
+            w_keys = _slot_keys(base_rng, w_m, K).reshape(vpp, S)
+            if layer_has_aux:
+                dp = slot_backward_dw_aux(
+                    slot_params, x_w, w_keys, g_w,
+                    valid_w.astype(jnp.float32).reshape(vpp, S))
+            else:
+                dp = slot_backward_dw(slot_params, x_w, w_keys, g_w)
+            dparams = _accumulate(dparams, dp, valid_w.reshape(vpp, S))
+
+            fstate = _advance(processed, vpp)
+            return (fstate, b_out_new, dy_new, stash, gstash,
+                    loss_sum, dparams, dhead, dx), None
+
+        carry0 = (fstate0, bstate0, dy0, stash0, gstash0, loss0,
+                  dparams0, dhead0, dx0)
+        (_, _, _, _, _, loss_sum, dparams, dhead, dx), _ = \
+            jax.lax.scan(tick, carry0,
+                         (jnp.arange(M + 2 * K - 1), dw_rows))
 
     d_stacked = jax.tree.map(
         lambda g, p: g.reshape(p.shape).astype(p.dtype),

@@ -287,21 +287,31 @@ def test_moe_generation_decodes():
     assert ((out >= 0) & (out < 64)).all()
 
 
-def test_moe_pp_rejected():
+def test_moe_pp_gpipe_rejected():
+    """MoE + pp trains through the explicit 1F1B/zb schedules (the
+    stage scan threads the router aux loss, docs/pipeline.md); only
+    GPipe is refused — autodiff through the forward-only schedule
+    would silently drop the aux loss."""
     from paddlefleetx_tpu.utils.config import AttrDict
     from paddlefleetx_tpu.models.language_utils import (
         process_model_configs,
     )
-    cfg = AttrDict({
-        "Global": AttrDict({"local_batch_size": 8,
-                            "micro_batch_size": 4}),
-        "Model": AttrDict({"hidden_size": 32, "num_layers": 4,
-                           "moe_num_experts": 4}),
-        "Distributed": AttrDict({"pp_degree": 2, "mp_degree": 1,
-                                 "dp_degree": 1}),
-    })
+
+    def _cfg(**model_kw):
+        return AttrDict({
+            "Global": AttrDict({"local_batch_size": 8,
+                                "micro_batch_size": 4}),
+            "Model": AttrDict({"hidden_size": 32, "num_layers": 4,
+                               "moe_num_experts": 4, **model_kw}),
+            "Distributed": AttrDict({"pp_degree": 2, "mp_degree": 1,
+                                     "dp_degree": 1}),
+        })
+
     with pytest.raises(ValueError, match="MoE.*pipeline"):
-        process_model_configs(cfg)
+        process_model_configs(_cfg(pipeline_schedule="GPipe"))
+    # the default (1F1B) and zb schedules compose with MoE
+    process_model_configs(_cfg())
+    process_model_configs(_cfg(pipeline_schedule="zb"))
 
 
 def test_ep_must_divide_experts():
