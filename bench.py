@@ -53,6 +53,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 sys.path.insert(0, ".")
@@ -79,6 +80,11 @@ METRIC_BY_MODE = {
     "67b": "gpt3_6p7b_geometry_mfu",
     "longctx": "gpt345m_long_context_s8192_mfu",
 }
+# guards the reporting globals below (_active_metric, _recorder,
+# _phase): the backend-init watchdog thread builds failure records
+# from them while the main thread advances them, so each side takes
+# this lock for its reads/writes (snapshot under it, emit outside)
+_state_lock = threading.Lock()
 # which metric a failure is reported against — set from --mode so a
 # crashed `--mode moe` run cannot blame the pretrain headline number
 _active_metric = HEADLINE_METRIC
@@ -101,8 +107,10 @@ _recorder = None
 
 def _emit_event(event: str, **fields):
     """Durable lifecycle event; no-op when the recorder is off."""
-    if _recorder is not None:
-        _recorder.emit(event, **fields)
+    with _state_lock:
+        rec = _recorder
+    if rec is not None:
+        rec.emit(event, **fields)
 
 
 def _kill_child() -> str:
@@ -188,19 +196,21 @@ UNIT_BY_METRIC = {
 
 
 def _failure_record(kind: str, detail: str) -> str:
-    _emit_event("failure", kind=kind, phase=_phase,
+    with _state_lock:
+        phase, metric, recorder = _phase, _active_metric, _recorder
+    _emit_event("failure", kind=kind, phase=phase,
                 detail=detail[-500:])
     rec = {
-        "metric": _active_metric, "value": None,
-        "unit": UNIT_BY_METRIC.get(_active_metric, "tokens/s"),
+        "metric": metric, "value": None,
+        "unit": UNIT_BY_METRIC.get(metric, "tokens/s"),
         "vs_baseline": None, "error_kind": kind,
         "error": detail[-2000:],
     }
-    if _recorder is not None:
+    if recorder is not None:
         # the run's last recorded breadcrumbs ride inside the failure
         # record, so the driver-side report shows WHAT the bench was
         # doing when it died without needing the builder's disk
-        rec["recorder_tail"] = _recorder.tail(8)
+        rec["recorder_tail"] = recorder.tail(8)
     return json.dumps(rec)
 
 
@@ -315,7 +325,8 @@ def wait_for_backend() -> dict:
     anything else (ImportError, ValueError...) reports ``exception``
     (code bug)."""
     global _phase
-    _phase = "backend probing"
+    with _state_lock:
+        _phase = "backend probing"
     _install_sigterm_reporter()
     budget = float(os.environ.get("PFX_BENCH_MAX_WAIT", "10800"))
     probe_timeout = float(os.environ.get("PFX_BENCH_PROBE_TIMEOUT", "300"))
@@ -1835,7 +1846,8 @@ def main():
                    default="train")
     args = p.parse_args()
     global _active_metric
-    _active_metric = METRIC_BY_MODE[args.mode]
+    with _state_lock:
+        _active_metric = METRIC_BY_MODE[args.mode]
     # the CLIs' hook: PFX_CPU_DEVICES forces the CPU platform through
     # jax.config (site customization may pin another platform that
     # ignores the JAX_PLATFORMS env var)
@@ -1850,8 +1862,9 @@ def main():
         # the gap, and a hung init is invisible to _run_guarded)
         _init_main_backend()
         global _phase
-        _phase = "measurement"
-        _emit_event("phase", phase=_phase, mode=args.mode)
+        with _state_lock:
+            _phase = "measurement"
+        _emit_event("phase", phase="measurement", mode=args.mode)
     # persistent compile cache: the unrolled 24-layer configs take
     # minutes to compile cold; repeated bench runs (and the perf-CI
     # driver) should pay that once per program, not per run
@@ -1888,9 +1901,11 @@ def _run_guarded():
     failure JSON instead of a bare traceback."""
     global _recorder
     from paddlefleetx_tpu.observability.recorder import FlightRecorder
-    _recorder = FlightRecorder(os.path.join(
+    flight = FlightRecorder(os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "bench_log",
         "events.jsonl"))
+    with _state_lock:
+        _recorder = flight
     _emit_event("bench_start", argv=sys.argv[1:],
                 reexec=os.environ.get("PFX_BENCH_REEXEC", "0"))
     try:

@@ -11,8 +11,12 @@ Options:
                           codestyle/pfxlint/baseline.txt)
     --no-baseline         report baselined findings too
     --write-baseline      rewrite the baseline from current findings
+    --format FMT          output format: ``text`` (default) or
+                          ``github`` (Actions ``::error`` annotations
+                          that render inline on PRs)
     --list-rules          print rule ids and exit
-    --stats               print reachability/suppression statistics
+    --stats               print reachability/suppression statistics,
+                          including per-rule inline-suppression counts
 """
 
 from __future__ import annotations
@@ -48,6 +52,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     use_baseline = True
     write_baseline = False
     stats = False
+    fmt = "text"
     paths: List[str] = []
 
     known = set(rule_codes())
@@ -57,7 +62,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         if a == "--list-rules":
             print("\n".join(rule_codes()))
             return 0
-        if a in ("--select", "--ignore", "--baseline", "--root"):
+        if a in ("--select", "--ignore", "--baseline", "--root",
+                 "--format"):
             if i + 1 >= len(args):
                 return _usage(f"{a} needs a value")
             val = args[i + 1]
@@ -73,6 +79,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     return _usage(f"unknown rule id(s): {sorted(bad)}")
             elif a == "--baseline":
                 baseline_path = val
+            elif a == "--format":
+                if val not in ("text", "github"):
+                    return _usage(f"unknown format {val!r}")
+                fmt = val
             else:
                 root = val
             i += 2
@@ -111,7 +121,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     for f in result.findings:
-        print(f)
+        if fmt == "github":
+            # one annotation per finding; message must stay one line
+            msg = f.message.replace("\n", " ")
+            print(f"::error file={f.path},line={f.line},"
+                  f"title={f.code}::{msg}")
+        else:
+            print(f)
     if result.unused_baseline:
         print(f"pfxlint: note: {len(result.unused_baseline)} stale "
               f"baseline fingerprint(s) no longer fire — prune them:",
@@ -123,6 +139,8 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"{len(result.baselined)} baselined, "
               f"{len(result.suppressed)} suppressed inline",
               file=sys.stderr)
+        for code, n in sorted(result.suppression_counts().items()):
+            print(f"pfxlint: suppressed[{code}]={n}", file=sys.stderr)
     if result.findings:
         print(f"pfxlint: {len(result.findings)} finding(s) "
               f"(suppress inline with '# pfxlint: disable=ID' or "

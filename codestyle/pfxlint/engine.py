@@ -30,6 +30,7 @@ import re
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from . import callgraph
+from . import threadgraph
 
 #: directories never scanned, wherever they appear
 EXCLUDE_DIRS = {
@@ -97,6 +98,7 @@ class LintContext:
         self.root = root
         self.callgraph = callgraph.build(
             {f.path: f.tree for f in py_files})
+        self.threadgraph = threadgraph.build(self.callgraph)
 
     def file(self, path: str) -> Optional[SourceFile]:
         for f in self.py_files:
@@ -248,6 +250,15 @@ class LintResult:
     @property
     def exit_code(self) -> int:
         return 1 if self.findings else 0
+
+    def suppression_counts(self) -> Dict[str, int]:
+        """Inline suppressions per rule code — the creep metric
+        ``--stats`` prints so a quietly growing pile of disables is
+        visible in CI logs."""
+        out: Dict[str, int] = {}
+        for f in self.suppressed:
+            out[f.code] = out.get(f.code, 0) + 1
+        return out
 
 
 def _all_rules():

@@ -41,12 +41,15 @@ def resolve_call(ctx, fn, call: ast.Call):
     return ctx.callgraph.resolve_dotted(mod, dotted)
 
 
-from . import (counters, docstrings, fallbacks, host_sync,   # noqa: E402
-               knobs, nondeterminism, silent_except, tracer_branch)
+from . import (counters, docstrings, donation, fallbacks,   # noqa: E402
+               host_sync, knobs, locks, nondeterminism, races,
+               silent_except, tracer_branch, tracer_escape)
 
 #: ordered registry; docs/static_analysis.md mirrors this table
 ALL_RULES = [
     host_sync, nondeterminism, tracer_branch,
+    donation, tracer_escape,
+    races, locks,
     counters, knobs, fallbacks, silent_except, docstrings,
 ]
 

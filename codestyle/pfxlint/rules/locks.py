@@ -1,0 +1,197 @@
+"""PFX302 / PFX303 — lock-order inversion and blocking under a lock.
+
+PFX302 (static deadlock smell): somewhere lock A is held while lock B
+is acquired, and somewhere else B is held while A is acquired. With
+two threads running those paths concurrently each can hold one lock
+and wait forever on the other. Acquisition pairs come from the
+thread graph's lock-scope walk, with caller-held locks inherited
+(``helper()`` called under A that takes B contributes the (A, B)
+pair). Re-acquiring a non-reentrant ``threading.Lock`` that is
+already held — directly or through a helper only ever called with it
+held — self-deadlocks and is reported on the same code.
+
+PFX303 (blocking call while holding a lock): a lock region should be
+a few loads and stores, never I/O or an unbounded wait. Flagged while
+any lock is held:
+
+- resolved blocking callables — ``time.sleep``, ``jax.device_get``,
+  ``jax.block_until_ready``, ``select.select``, ``subprocess.*``,
+  ``socket.create_connection``;
+- blocking METHODS by name, gated on the argument shape that
+  distinguishes them from innocent namesakes: ``.get()`` / ``.join()``
+  / ``.result()`` / ``.shutdown()`` with zero positional args (a
+  ``dict.get(key)`` or ``",".join(xs)`` never blocks), ``.wait(...)``
+  / ``.put(...)`` / ``.recv(...)`` / ``.accept()`` / ``.connect(...)``
+  / ``.sendall(...)`` / ``.serve_forever()`` / ``.block_until_ready()``
+  with any arity;
+- one call level deep: a call made under a lock into an in-tree
+  function that itself contains a direct blocking call.
+
+``Condition.wait`` on the condition's OWN lock is the correct wait
+idiom (it releases while waiting) and is exempt. ``flush``/``fsync``
+are deliberately NOT in the set: a durable-log writer that fsyncs
+under its lock is a design choice, not a deadlock.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..engine import Finding
+
+CODES = ("PFX302", "PFX303")
+
+_BLOCKING_GDOTS = {
+    "time.sleep", "jax.device_get", "jax.block_until_ready",
+    "select.select", "socket.create_connection",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output",
+}
+
+#: method name -> required positional-arg predicate (None = any)
+_BLOCKING_METHODS = {
+    "get": 0, "join": 0, "result": 0, "shutdown": 0,
+    "accept": 0, "serve_forever": 0, "join_thread": 0,
+    "wait": None, "wait_for": None, "put": None, "recv": None,
+    "recv_into": None, "connect": None, "sendall": None,
+    "block_until_ready": None, "wait_until_finished": None,
+}
+
+
+def _short(lock: str) -> str:
+    return lock.split(":", 1)[-1]
+
+
+def _blocking_what(op) -> str:
+    """Why a call op is considered blocking, or '' when it is not."""
+    if op.node is None:
+        return ""
+    if op.gdot in _BLOCKING_GDOTS:
+        return op.gdot
+    if op.attr in _BLOCKING_METHODS:
+        arity = _BLOCKING_METHODS[op.attr]
+        if arity is None or op.n_pos == arity:
+            return f".{op.attr}()"
+    return ""
+
+
+def _is_condition_wait(tg, op) -> bool:
+    """``cond.wait()`` where ``cond`` is a registered Condition —
+    the one blocking-under-lock shape that is the POINT of the
+    lock."""
+    if op.attr not in ("wait", "wait_for") or op.node is None:
+        return False
+    recv = op.node.func.value if isinstance(op.node.func,
+                                            ast.Attribute) else None
+    if recv is None:
+        return False
+    key = tg._access_key(op.fn, recv)
+    if key is not None:
+        return tg.lock_kinds.get(key[0]) == "Condition"
+    # module-global / function-local conditions: _access_key needs a
+    # walk env for bare names, so resolve against the lock table the
+    # same way the lock-scope walker does
+    if isinstance(recv, ast.Name):
+        for cand in (f"{op.fn.modname}:{recv.id}",
+                     f"{op.fn.qualname}.{recv.id}"):
+            if cand in tg.lock_kinds:
+                return tg.lock_kinds[cand] == "Condition"
+    return False
+
+
+def _check_302(ctx) -> List[Finding]:
+    tg = ctx.threadgraph
+    pairs = tg.lock_pairs()
+    findings: List[Finding] = []
+    seen = set()
+    for (a, b), (fq, line) in sorted(pairs.items()):
+        fn = tg.graph.functions.get(fq)
+        path = fn.path if fn else "?"
+        if a == b:
+            if tg.lock_kinds.get(a) == "Lock":
+                findings.append(Finding(
+                    path=path, line=line, code="PFX302",
+                    message=(
+                        f"`{_short(a)}` is acquired while already "
+                        f"held (directly or through a helper only "
+                        f"called with it held) — a non-reentrant "
+                        f"Lock self-deadlocks here; use RLock or "
+                        f"hoist the lock out of the helper"),
+                    key=f"reacquire:{a}"))
+            continue
+        if (b, a) not in pairs or (b, a) in seen:
+            continue
+        seen.add((a, b))
+        ofq, oline = pairs[(b, a)]
+        ofn = tg.graph.functions.get(ofq)
+        findings.append(Finding(
+            path=path, line=line, code="PFX302",
+            message=(
+                f"inconsistent lock order: `{_short(a)}` is held "
+                f"while acquiring `{_short(b)}` here, but "
+                f"{ofn.path if ofn else '?'}:{oline} acquires "
+                f"`{_short(a)}` while holding `{_short(b)}` — two "
+                f"threads on these paths deadlock; pick one global "
+                f"order"),
+            key=f"order:{min(a, b)}<>{max(a, b)}"))
+    return findings
+
+
+def _check_303(ctx) -> List[Finding]:
+    tg = ctx.threadgraph
+    findings: List[Finding] = []
+    emitted = set()
+    # functions with a direct blocking call, for the one-level check
+    direct_block = {}
+    for op in tg.calls:
+        what = _blocking_what(op)
+        if what and not _is_condition_wait(tg, op):
+            direct_block.setdefault(op.fn.qualname, (what, op.lineno))
+    for op in tg.calls:
+        if not op.locks:
+            continue
+        what = _blocking_what(op)
+        if what and not _is_condition_wait(tg, op):
+            fkey = (op.fn.qualname, what)
+            if fkey in emitted:
+                continue
+            emitted.add(fkey)
+            findings.append(Finding(
+                path=op.fn.path, line=op.lineno, code="PFX303",
+                message=(
+                    f"blocking call {what} while holding "
+                    f"`{_lock_list(op.locks)}` — move the wait out "
+                    f"of the lock region (snapshot under the lock, "
+                    f"block outside it)"),
+                key=f"{op.fn.qualname}:{what}"))
+            continue
+        # one level deep: a locked call into a blocking helper
+        for t in op.targets:
+            hit = direct_block.get(t)
+            if hit is None:
+                continue
+            inner_what, inner_line = hit
+            fkey = (op.fn.qualname, t, inner_what)
+            if fkey in emitted:
+                continue
+            emitted.add(fkey)
+            tinfo = tg.graph.functions.get(t)
+            findings.append(Finding(
+                path=op.fn.path, line=op.lineno, code="PFX303",
+                message=(
+                    f"call into `{t.split(':', 1)[-1]}` while "
+                    f"holding `{_lock_list(op.locks)}` blocks: it "
+                    f"calls {inner_what} at "
+                    f"{tinfo.path if tinfo else '?'}:{inner_line} — "
+                    f"release the lock before the call"),
+                key=f"{op.fn.qualname}->{t}:{inner_what}"))
+    return findings
+
+
+def _lock_list(locks) -> str:
+    return ", ".join(sorted(_short(k) for k in locks))
+
+
+def check(ctx) -> List[Finding]:
+    return _check_302(ctx) + _check_303(ctx)

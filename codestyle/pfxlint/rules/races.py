@@ -1,0 +1,106 @@
+"""PFX301 — unguarded shared-state write across thread contexts.
+
+The classic data race: an instance attribute or module global is
+touched from two different thread contexts (main loop + watchdog
+thread, main loop + an HTTP scrape thread, ...), at least one of the
+conflicting accesses is a write, and the two accesses share NO common
+lock. The thread-entry graph (``threadgraph.py``) provides the
+context attribution and the per-access held-lock sets (including
+locks inherited from always-locked callers).
+
+What does NOT fire:
+
+- accesses inside ``__init__`` / ``__post_init__`` on the object's
+  own attributes — they happen-before any thread can hold the object;
+- the lock objects themselves (``self._lock`` is shared by design);
+- two accesses that can only run on the SAME context;
+- guarded pairs: every cross-context conflicting pair shares a lock.
+
+The finding anchors on a write when one is unguarded (that is the
+line to wrap in ``with lock:``) and names the witness contexts.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..engine import Finding
+
+CODES = ("PFX301",)
+
+
+def _conflicts(a, b, ctx_of) -> bool:
+    """Whether two accesses of one key can race: different contexts,
+    a write involved, no common lock."""
+    if not (a.write or b.write):
+        return False
+    if a.locks & b.locks:
+        return False
+    ca, cb = ctx_of(a.fn.qualname), ctx_of(b.fn.qualname)
+    if a is b:
+        return a.write and len(ca) >= 2 and not a.locks
+    for c1 in ca:
+        for c2 in cb:
+            if c1 != c2:
+                return True
+    return False
+
+
+def check(ctx) -> List[Finding]:
+    """PFX301 over every shared state key the thread graph recorded.
+
+    Args:
+        ctx: the lint context (thread graph already built).
+
+    Returns:
+        One finding per racy state key, anchored on an unguarded
+        write.
+    """
+    tg = ctx.threadgraph
+    by_key: Dict[str, list] = {}
+    for acc in tg.accesses:
+        if acc.in_init:
+            continue
+        by_key.setdefault(acc.key, []).append(acc)
+
+    findings: List[Finding] = []
+    for key in sorted(by_key):
+        accs = sorted(by_key[key],
+                      key=lambda a: (a.fn.path, a.lineno, not a.write))
+        hit = None
+        for i, a in enumerate(accs):
+            for b in accs[i:]:
+                if _conflicts(a, b, tg.contexts_of):
+                    hit = (a, b)
+                    break
+            if hit:
+                break
+        if hit is None:
+            continue
+        a, b = hit
+        # anchor on the unguarded write of the pair when there is one
+        anchor = a if (a.write and not a.locks) else \
+            (b if (b.write and not b.locks) else (a if a.write else b))
+        other = b if anchor is a else a
+        ctxs = sorted(tg.contexts_of(anchor.fn.qualname)
+                      | tg.contexts_of(other.fn.qualname))
+        where = "" if other is anchor else (
+            f"; also touched at {other.fn.path}:{other.lineno}"
+            f" ({'write' if other.write else 'read'}"
+            + (f" under {_lock_names(other.locks)}" if other.locks
+               else ", no lock") + ")")
+        findings.append(Finding(
+            path=anchor.fn.path, line=anchor.lineno, code="PFX301",
+            message=(
+                f"`{anchor.display}` is "
+                f"{'written' if anchor.write else 'read'} without a "
+                f"common lock across thread contexts "
+                f"{{{', '.join(ctxs)}}}{where} — guard every access "
+                f"with one lock or hand the reader an immutable "
+                f"snapshot"),
+            key=key))
+    return findings
+
+
+def _lock_names(locks) -> str:
+    return ", ".join(sorted(k.split(":", 1)[-1] for k in locks))

@@ -1,0 +1,193 @@
+"""PFX105 — a tracer escapes the trace through self/global/closure.
+
+Inside a jit-traced function every array argument is a tracer — an
+abstract value that only means something DURING this trace. Storing
+one somewhere that outlives the trace::
+
+    self._last_logits = logits        # on a method under jit
+    _CACHE[key] = hidden              # module global
+    captured.append(attn)             # closure cell / outer list
+
+leaks it: the next read outside the trace raises
+``UnexpectedTracerError`` (or retraces against a stale abstract
+value). This is jax's #1 footgun for stateful-looking code migrated
+from the eager world (the paper's Paddle layers carry exactly this
+kind of member-variable habit).
+
+Flagged in every jit-reachable function, using the call graph's
+``tracer_params`` (sound for direct jit roots, annotation-gated for
+transitive ones) with linear intraprocedural taint through local
+assignments:
+
+- ``self.X = <tainted>`` / ``self.X += <tainted>``;
+- a store to a ``global``- or ``nonlocal``-declared name;
+- an in-place mutator (``.append`` / ``.update`` / ...) on ``self.X``
+  or a global, with a tainted argument.
+
+Shape/dtype projections (``x.shape``, ``len(x)``) launder the taint —
+they are concrete at trace time and safe to store.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..engine import Finding
+from . import own_nodes
+
+CODES = ("PFX105",)
+
+_SAFE_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+_MUTATORS = {"append", "appendleft", "extend", "insert", "add",
+             "update", "setdefault", "put", "put_nowait"}
+
+
+def _tainted(expr: ast.AST, taint: Set[str]) -> bool:
+    """Whether an expression mentions a tainted name outside
+    shape/dtype context."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and \
+                node.attr in _SAFE_ATTRS:
+            continue
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Load) and node.id in taint:
+            # laundered when the ONLY use is under a safe attribute
+            if not _under_safe_attr(expr, node):
+                return True
+    return False
+
+
+def _under_safe_attr(root: ast.AST, name_node: ast.Name) -> bool:
+    """Whether ``name_node`` appears as ``name.shape``-style inside
+    ``root`` (its direct parent is a safe attribute access)."""
+    for node in ast.walk(root):
+        if isinstance(node, ast.Attribute) and \
+                node.attr in _SAFE_ATTRS and node.value is name_node:
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "len" and \
+                    node.args and node.args[0] is name_node:
+                return True
+    return False
+
+
+def check(ctx) -> List[Finding]:
+    """PFX105 over every jit-reachable function with tracer params.
+
+    Args:
+        ctx: the lint context (call graph already built).
+
+    Returns:
+        One finding per escaping store, deduplicated by fingerprint.
+    """
+    findings: List[Finding] = []
+    for fn in ctx.callgraph.reachable_functions():
+        taint = set(fn.tracer_params)
+        if not taint:
+            continue
+        declared: Set[str] = set()
+        for node in own_nodes(fn.node):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                declared.update(node.names)
+        # line-ordered linear pass so taint flows through locals
+        stmts = sorted(own_nodes(fn.node),
+                       key=lambda n: (getattr(n, "lineno", 0),
+                                      getattr(n, "col_offset", 0)))
+        for node in stmts:
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                value = node.value
+                if value is None or not _tainted(value, taint):
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    for leaf in _target_leaves(tgt):
+                        if isinstance(leaf, ast.Name):
+                            if leaf.id in declared:
+                                findings.append(_escape(
+                                    fn, node.lineno, leaf.id,
+                                    "a global/nonlocal binding"))
+                            else:
+                                taint.add(leaf.id)
+                        elif isinstance(leaf, ast.Attribute) and \
+                                _is_selfish(leaf.value):
+                            findings.append(_escape(
+                                fn, node.lineno,
+                                f"self.{leaf.attr}",
+                                "an attribute that outlives the "
+                                "trace"))
+                        elif isinstance(leaf, ast.Subscript):
+                            base = leaf.value
+                            if isinstance(base, ast.Attribute) and \
+                                    _is_selfish(base.value):
+                                findings.append(_escape(
+                                    fn, node.lineno,
+                                    f"self.{base.attr}[...]",
+                                    "an attribute that outlives the "
+                                    "trace"))
+                            elif isinstance(base, ast.Name) and \
+                                    base.id in declared:
+                                findings.append(_escape(
+                                    fn, node.lineno,
+                                    f"{base.id}[...]",
+                                    "a global container"))
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS:
+                args_tainted = any(_tainted(a, taint)
+                                   for a in node.args) or \
+                    any(_tainted(kw.value, taint)
+                        for kw in node.keywords)
+                if not args_tainted:
+                    continue
+                recv = node.func.value
+                if isinstance(recv, ast.Attribute) and \
+                        _is_selfish(recv.value):
+                    findings.append(_escape(
+                        fn, node.lineno,
+                        f"self.{recv.attr}.{node.func.attr}(...)",
+                        "an attribute that outlives the trace"))
+                elif isinstance(recv, ast.Name) and \
+                        recv.id in declared:
+                    findings.append(_escape(
+                        fn, node.lineno,
+                        f"{recv.id}.{node.func.attr}(...)",
+                        "a global container"))
+    # de-duplicate by fingerprint, keep first line
+    seen = set()
+    out = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line)):
+        if f.fingerprint() in seen:
+            continue
+        seen.add(f.fingerprint())
+        out.append(f)
+    return out
+
+
+def _target_leaves(tgt: ast.AST):
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        for e in tgt.elts:
+            yield from _target_leaves(e)
+    elif isinstance(tgt, ast.Starred):
+        yield from _target_leaves(tgt.value)
+    else:
+        yield tgt
+
+
+def _is_selfish(expr: ast.AST) -> bool:
+    return isinstance(expr, ast.Name) and expr.id in ("self", "cls")
+
+
+def _escape(fn, line: int, what: str, where: str) -> Finding:
+    return Finding(
+        path=fn.path, line=line, code="PFX105",
+        message=(
+            f"tracer-typed value stored into `{what}` — {where}; "
+            f"inside a traced function this leaks the tracer and "
+            f"raises UnexpectedTracerError on the next read; return "
+            f"the value instead of storing it"),
+        key=f"{fn.qualname}:{what}")

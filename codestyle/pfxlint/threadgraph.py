@@ -1,0 +1,1245 @@
+"""Thread-entry graph + lock-scope analysis for the PFX3xx rules.
+
+The jit call graph (``callgraph.py``) answers "can this run under a
+trace?". This module answers the concurrency twin: "can this run on a
+non-main thread, and which locks are held when it touches shared
+state?". It is built once per lint run from the same parsed ASTs.
+
+Thread roots
+    - ``threading.Thread(target=...)`` / ``threading.Timer(_, fn)``
+      targets — resolved through bare names, ``self.method`` bound
+      methods, attributes holding callbacks, and lambdas (the calls
+      inside a lambda target become roots themselves);
+    - every method of an in-tree ``BaseHTTPRequestHandler`` /
+      ``socketserver`` handler subclass (``ThreadingHTTPServer`` runs
+      each request on its own thread).
+
+Reachability
+    BFS from the roots along resolved call edges. Resolution goes
+    beyond the jit graph's: a light type-inference fixpoint tracks
+    which in-tree class each attribute / global / parameter / local /
+    return value can hold (constructor calls, annotations including
+    ``Optional[C]`` / ``List[C]`` element types, call-site argument
+    flow), so ``self._recorder.emit(...)`` resolves through
+    ``self._recorder = FlightRecorder(...)`` three calls away, and a
+    callback-flow pass tracks function references through the same
+    channels, so ``health=self._health_state`` stored by
+    ``MetricsServer.set_health`` marks ``_health_state`` as running on
+    the HTTP threads that invoke ``self._health()``. ``@property``
+    getters are call edges on attribute reads. Functions with no
+    in-tree caller that are not thread roots seed the ``main``
+    context.
+
+Lock scopes
+    Intraprocedurally per function: ``with self._lock:`` blocks,
+    bare ``lk.acquire()`` .. ``lk.release()`` regions (including the
+    ``try/finally`` idiom). Locks are identified by where they live
+    (``Class._lock`` attribute, module global, function local) —
+    instance identity is abstracted away, which is sound for the
+    one-lock-per-object idiom this repo uses. Helpers only ever
+    called with a lock held inherit it: the effective lock set of a
+    function is its local set plus the INTERSECTION over all in-tree
+    call sites of the locks held there (a meet-over-callers fixpoint;
+    thread roots and callback-invoked functions contribute the empty
+    set, since something outside the scanned tree can call them
+    bare).
+
+Known-unsound patterns (documented in docs/static_analysis.md):
+    - object-graph aliasing: a list handed out by a method and mutated
+      through the alias is invisible (accesses are tracked per
+      attribute/global, not per object);
+    - ``ProcessPoolExecutor.submit`` targets are NOT thread roots on
+      purpose — separate processes share no memory;
+    - two threads spawned from the SAME target function merge into
+      one context, so a function racing only with itself on a global
+      is missed unless some other context also touches the state;
+    - element types of containers filled outside ``append`` / literal
+      / annotation forms are unknown, so calls through them do not
+      resolve.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import CallGraph, FunctionInfo, ModuleIndex, _dotted_from
+
+#: constructors that define a lock object (leaf name after resolution)
+_LOCK_FACTORIES = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "threading.Semaphore": "Semaphore",
+    "threading.BoundedSemaphore": "Semaphore",
+}
+
+#: constructors of internally-synchronized objects — mutating these
+#: through their own methods (Event.set/clear, Queue.put/get) is safe
+#: from any thread, so their state keys are exempt from PFX301 the
+#: same way lock objects are (REBINDING one is still an object-
+#: identity swap the analysis deliberately ignores — documented
+#: known-unsound in docs/static_analysis.md)
+_THREADSAFE_FACTORIES = {
+    "threading.Event": "Event",
+    "queue.Queue": "Queue",
+    "queue.SimpleQueue": "Queue",
+    "queue.LifoQueue": "Queue",
+    "queue.PriorityQueue": "Queue",
+}
+
+#: thread-spawning callables whose function argument runs off-main
+_THREAD_FACTORIES = {"threading.Thread", "threading.Timer"}
+
+#: stdlib handler base classes whose methods run per-request threads
+_HANDLER_BASES = {
+    "http.server.BaseHTTPRequestHandler",
+    "http.server.SimpleHTTPRequestHandler",
+    "socketserver.BaseRequestHandler",
+    "socketserver.StreamRequestHandler",
+    "socketserver.DatagramRequestHandler",
+}
+
+#: method names that mutate their receiver in place
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "remove", "pop",
+    "popleft", "popitem", "clear", "add", "discard", "update",
+    "setdefault", "sort", "reverse", "put", "put_nowait",
+}
+
+#: typing wrappers whose subscript passes the inner type through
+_ANN_PASSTHROUGH = {"Optional", "Union", "Final", "ClassVar",
+                    "Annotated"}
+#: typing containers whose subscript names the ELEMENT type
+_ANN_CONTAINERS = {"List", "list", "Sequence", "Set", "set",
+                   "FrozenSet", "Tuple", "tuple", "Iterable",
+                   "Iterator", "Deque", "deque"}
+#: typing mappings whose VALUE slot names the element type
+_ANN_MAPPINGS = {"Dict", "dict", "Mapping", "MutableMapping",
+                 "DefaultDict", "OrderedDict"}
+
+#: constructor/init-ish methods whose own-attribute writes happen
+#: before any thread can observe the object
+_INIT_METHODS = {"__init__", "__post_init__", "__new__"}
+
+
+@dataclasses.dataclass
+class Access:
+    """One read or write of a tracked shared-state location."""
+
+    key: str               # "mod:Class.attr" or "mod:NAME" (global)
+    display: str           # short human name ("Class.attr")
+    fn: FunctionInfo
+    write: bool
+    lineno: int
+    locks: FrozenSet[str]  # effective lock keys held (incl. inherited)
+    in_init: bool          # happens-before any thread start
+
+
+@dataclasses.dataclass
+class CallOp:
+    """One call site, with the locks held around it."""
+
+    fn: FunctionInfo
+    node: Optional[ast.Call]    # None for synthesized property reads
+    gdot: Optional[str]         # resolved global dotted name, if any
+    attr: Optional[str]         # method name when func is Attribute
+    n_pos: int                  # positional argument count
+    targets: Tuple[str, ...]    # resolved in-tree callee qualnames
+    lineno: int
+    locks: FrozenSet[str]       # effective lock keys held
+
+
+@dataclasses.dataclass
+class Acquisition:
+    """One lock acquisition with the locks already held there."""
+
+    fn: FunctionInfo
+    lock: str
+    held: FrozenSet[str]        # effective locks held at acquire time
+    lineno: int
+
+
+class ThreadGraph:
+    """The built artifact rules consume; see module docstring."""
+
+    def __init__(self, graph: CallGraph):
+        self.graph = graph
+        #: qualname -> set of context labels ("main", "thread:<qual>",
+        #: "http:<class key>")
+        self.contexts: Dict[str, Set[str]] = {}
+        #: root qualname -> context label it anchors
+        self.thread_roots: Dict[str, str] = {}
+        #: lock key -> factory leaf name ("Lock", "RLock", ...)
+        self.lock_kinds: Dict[str, str] = {}
+        #: state key -> kind for internally-synchronized objects
+        #: (Event, Queue); exempt from race tracking, NOT lockable
+        self.safe_kinds: Dict[str, str] = {}
+        self.accesses: List[Access] = []
+        self.calls: List[CallOp] = []
+        self.acquisitions: List[Acquisition] = []
+        #: inference maps, keyed ("attr", class_key, name) /
+        #: ("glob", mod, name) / ("param", fnqual, name) /
+        #: ("local", fnqual, name) / ("ret", fnqual)
+        self.types: Dict[Tuple, Set[str]] = {}
+        self.elems: Dict[Tuple, Set[str]] = {}
+        self.fnrefs: Dict[Tuple, Set[str]] = {}
+        #: (class_key, attr) -> getter qualname for @property methods
+        self.properties: Dict[Tuple[str, str], str] = {}
+        self._module_globals: Dict[str, Set[str]] = {}
+        self._edges_cache: Dict[str, Set[str]] = {}
+        self._build()
+
+    # -- public lookups -------------------------------------------------
+    def contexts_of(self, qualname: str) -> Set[str]:
+        """Thread contexts a function can run on (``{"main"}`` for
+        anything the analysis could not place — conservative: a lone
+        context produces no cross-thread findings)."""
+        return self.contexts.get(qualname) or {"main"}
+
+    def accesses_for(self, key: str) -> List[Access]:
+        return [a for a in self.accesses if a.key == key]
+
+    # -- construction ---------------------------------------------------
+    def _build(self):
+        for m in self.graph.modules.values():
+            self._module_globals[m.modname] = _module_assigned_names(
+                m.tree)
+        self._collect_properties()
+        self._infer_fixpoint()
+        self._collect_locks()
+        self._walk_all_functions()
+        self._find_thread_roots()
+        self._propagate_contexts()
+        self._inherit_caller_locks()
+
+    def _collect_properties(self):
+        for m in self.graph.modules.values():
+            for qual, info in m.functions.items():
+                if not info.class_name:
+                    continue
+                for deco in getattr(info.node, "decorator_list", []):
+                    d = _dotted_from(deco)
+                    if d in ("property", "functools.cached_property",
+                             "cached_property"):
+                        ck = f"{m.modname}:{info.class_name}"
+                        self.properties[(ck, info.node.name)] = \
+                            info.qualname
+
+    # -- type / callback inference --------------------------------------
+    def _infer_fixpoint(self):
+        for m in self.graph.modules.values():
+            self._infer_class_fields(m)
+        for _ in range(10):
+            before = (sum(len(v) for v in self.types.values()),
+                      sum(len(v) for v in self.elems.values()),
+                      sum(len(v) for v in self.fnrefs.values()))
+            for m in self.graph.modules.values():
+                self._infer_module_level(m)
+                for info in m.functions.values():
+                    self._infer_function(m, info)
+            after = (sum(len(v) for v in self.types.values()),
+                     sum(len(v) for v in self.elems.values()),
+                     sum(len(v) for v in self.fnrefs.values()))
+            if after == before:
+                break
+
+    def _infer_class_fields(self, m: ModuleIndex):
+        """Class-body ``AnnAssign`` fields (dataclass fields, class
+        attributes) seed attribute types/element types once."""
+
+        def walk(body, scope: List[str]):
+            """Collect annotated class-body fields, tracking the
+            qualname scope the ModuleIndex convention uses."""
+            for st in body:
+                if isinstance(st, ast.ClassDef):
+                    cq = ".".join(scope + [st.name])
+                    ck = f"{m.modname}:{cq}"
+                    for f in st.body:
+                        if isinstance(f, ast.AnnAssign) and \
+                                isinstance(f.target, ast.Name):
+                            t, e = self._ann_types(m, f.annotation)
+                            self._grow(self.types,
+                                       ("attr", ck, f.target.id), t)
+                            self._grow(self.elems,
+                                       ("attr", ck, f.target.id), e)
+                    walk(st.body, scope + [st.name])
+                elif isinstance(st, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    walk(st.body, scope + [st.name + ".<locals>"])
+
+        walk(m.tree.body, [])
+
+    def _infer_module_level(self, m: ModuleIndex):
+        for st in m.tree.body:
+            if isinstance(st, (ast.Assign, ast.AnnAssign)):
+                self._infer_assign(m, None, st)
+
+    def _infer_function(self, m: ModuleIndex, fn: FunctionInfo):
+        # annotations seed param types
+        for p, ann in fn.annotations.items():
+            if ann is not None:
+                t, e = self._ann_types(m, ann)
+                self._grow(self.types, ("param", fn.qualname, p), t)
+                self._grow(self.elems, ("param", fn.qualname, p), e)
+        gl = _global_decls(fn.node)
+        for st in _own_statements(fn.node):
+            if isinstance(st, (ast.Assign, ast.AnnAssign)):
+                self._infer_assign(m, fn, st, gl)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                if isinstance(st.target, ast.Name):
+                    self._grow(
+                        self.types, self._name_dest(fn, st.target.id, gl),
+                        self._elems_of(fn, st.iter))
+            elif isinstance(st, ast.Return) and st.value is not None:
+                self._grow(self.types, ("ret", fn.qualname),
+                           self._types_of(fn, st.value))
+                self._grow(self.elems, ("ret", fn.qualname),
+                           self._elems_of(fn, st.value))
+                self._grow(self.fnrefs, ("ret", fn.qualname),
+                           self._fnrefs_of(fn, st.value))
+            # call-site argument flow into callee params
+            for node in ast.walk(st):
+                if isinstance(node, ast.Call):
+                    self._infer_call(m, fn, node)
+
+    def _infer_assign(self, m: ModuleIndex, fn: Optional[FunctionInfo],
+                      st, gl: Set[str] = frozenset()):
+        value = st.value
+        targets = st.targets if isinstance(st, ast.Assign) else \
+            [st.target]
+        ann = getattr(st, "annotation", None)
+        ann_t: Set[str] = set()
+        ann_e: Set[str] = set()
+        if ann is not None:
+            ann_t, ann_e = self._ann_types(m, ann)
+        v_t = self._types_of(fn, value, m) if value is not None else set()
+        v_e = self._elems_of(fn, value, m) if value is not None else set()
+        v_f = self._fnrefs_of(fn, value, m) if value is not None else set()
+        for tgt in targets:
+            # tuple unpack: match elementwise when the RHS is a tuple
+            if isinstance(tgt, ast.Tuple) and \
+                    isinstance(value, ast.Tuple) and \
+                    len(tgt.elts) == len(value.elts):
+                for te, ve in zip(tgt.elts, value.elts):
+                    fake = ast.Assign(targets=[te], value=ve)
+                    self._infer_assign(m, fn, fake, gl)
+                continue
+            dest = self._dest_key(m, fn, tgt, gl)
+            if dest is None:
+                # subscript store feeds the container's element types
+                if isinstance(tgt, ast.Subscript):
+                    ek = self._expr_key_dest(m, fn, tgt.value, gl)
+                    if ek is not None:
+                        self._grow(self.elems, ek, v_t)
+                continue
+            self._grow(self.types, dest, v_t | ann_t)
+            self._grow(self.elems, dest, v_e | ann_e)
+            self._grow(self.fnrefs, dest, v_f)
+
+    def _infer_call(self, m: ModuleIndex, fn: FunctionInfo,
+                    call: ast.Call):
+        targets = self.resolve_call(fn, call)
+        for tq in targets:
+            tinfo = self.graph.functions.get(tq)
+            if tinfo is None:
+                continue
+            params = [p for p in tinfo.params if p not in ("self", "cls")]
+            bound_as_method = isinstance(call.func, ast.Attribute) or \
+                tinfo.node.name == "__init__"
+            plist = params if bound_as_method else \
+                [p for p in tinfo.params]
+            # positional
+            for i, arg in enumerate(call.args):
+                if isinstance(arg, ast.Starred) or i >= len(plist):
+                    break
+                self._bind_param(fn, tinfo, plist[i], arg)
+            # keywords
+            for kw in call.keywords:
+                if kw.arg and kw.arg in tinfo.params:
+                    self._bind_param(fn, tinfo, kw.arg, kw.value)
+
+    def _bind_param(self, fn: FunctionInfo, target: FunctionInfo,
+                    pname: str, arg: ast.AST):
+        self._grow(self.types, ("param", target.qualname, pname),
+                   self._types_of(fn, arg))
+        self._grow(self.elems, ("param", target.qualname, pname),
+                   self._elems_of(fn, arg))
+        self._grow(self.fnrefs, ("param", target.qualname, pname),
+                   self._fnrefs_of(fn, arg))
+
+    def _ann_types(self, m: ModuleIndex,
+                   ann: Optional[ast.AST]
+                   ) -> Tuple[Set[str], Set[str]]:
+        """Annotation AST -> (in-tree class types, element types).
+        Understands ``Optional[C]`` / ``Union`` passthrough,
+        ``List[C]``-style containers, and ``Dict[K, C]`` values."""
+        if ann is None:
+            return set(), set()
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return set(), set()
+        if isinstance(ann, ast.Subscript):
+            base = _dotted_from(ann.value)
+            leaf = base.split(".")[-1] if base else ""
+            inner = ann.slice
+            if leaf in _ANN_PASSTHROUGH:
+                if isinstance(inner, ast.Tuple):
+                    t: Set[str] = set()
+                    e: Set[str] = set()
+                    for el in inner.elts:
+                        it, ie = self._ann_types(m, el)
+                        t |= it
+                        e |= ie
+                    return t, e
+                return self._ann_types(m, inner)
+            if leaf in _ANN_CONTAINERS:
+                elts = inner.elts if isinstance(inner, ast.Tuple) \
+                    else [inner]
+                e = set()
+                for el in elts:
+                    e |= self._ann_types(m, el)[0]
+                return set(), e
+            if leaf in _ANN_MAPPINGS and isinstance(inner, ast.Tuple) \
+                    and len(inner.elts) == 2:
+                return set(), self._ann_types(m, inner.elts[1])[0]
+            return set(), set()
+        dotted = _dotted_from(ann)
+        if dotted is None or dotted == "None":
+            return set(), set()
+        ck = self.graph._class_key(m, self.graph.resolve_dotted(
+            m, dotted))
+        return ({ck} if ck else set()), set()
+
+    @staticmethod
+    def _grow(table: Dict[Tuple, Set[str]], key: Tuple,
+              vals: Set[str]):
+        if vals:
+            table.setdefault(key, set()).update(vals)
+
+    def _name_dest(self, fn: FunctionInfo, name: str,
+                   gl: Set[str]) -> Tuple:
+        if name in gl:
+            return ("glob", fn.modname, name)
+        return ("local", fn.qualname, name)
+
+    def _dest_key(self, m: ModuleIndex, fn: Optional[FunctionInfo],
+                  tgt: ast.AST, gl: Set[str]) -> Optional[Tuple]:
+        if isinstance(tgt, ast.Name):
+            if fn is None:
+                return ("glob", m.modname, tgt.id)
+            return self._name_dest(fn, tgt.id, gl)
+        if isinstance(tgt, ast.Attribute) and fn is not None:
+            for ck in self._self_types(fn, tgt.value):
+                return ("attr", ck, tgt.attr)
+        return None
+
+    def _expr_key_dest(self, m: ModuleIndex,
+                       fn: Optional[FunctionInfo], expr: ast.AST,
+                       gl: Set[str]) -> Optional[Tuple]:
+        """Key of a container-valued expr for element-type feeding."""
+        return self._dest_key(m, fn, expr, gl)
+
+    def _self_types(self, fn: FunctionInfo,
+                    expr: ast.AST) -> List[str]:
+        """Class keys an attribute RECEIVER can hold (``self`` / typed
+        expr), ordered deterministically."""
+        if isinstance(expr, ast.Name) and expr.id in ("self", "cls") \
+                and fn.class_name:
+            return [f"{fn.modname}:{fn.class_name}"]
+        return sorted(self._types_of(fn, expr))
+
+    # -- expression evaluation ------------------------------------------
+    def _types_of(self, fn: Optional[FunctionInfo], expr: ast.AST,
+                  m: Optional[ModuleIndex] = None) -> Set[str]:
+        if expr is None:
+            return set()
+        mod = m or (self.graph.modules.get(fn.modname) if fn else None)
+        if isinstance(expr, ast.Call):
+            out: Set[str] = set()
+            dotted = _dotted_from(expr.func)
+            if dotted is not None and mod is not None:
+                gdot = self.graph.resolve_dotted(mod, dotted)
+                ck = self.graph._class_key(mod, gdot)
+                if ck:
+                    return {ck}
+            for tq in self.resolve_call(fn, expr) if fn else ():
+                out |= self.types.get(("ret", tq), set())
+            return out
+        if isinstance(expr, ast.Name):
+            if expr.id in ("self", "cls") and fn and fn.class_name:
+                return {f"{fn.modname}:{fn.class_name}"}
+            return self._lookup_name(fn, expr.id, self.types)
+        if isinstance(expr, ast.Attribute):
+            out = set()
+            if fn is not None:
+                for ck in self._self_types(fn, expr.value):
+                    out |= self.types.get(("attr", ck, expr.attr),
+                                          set())
+            return out
+        if isinstance(expr, ast.Subscript):
+            return self._elems_of(fn, expr.value, m)
+        if isinstance(expr, ast.IfExp):
+            return self._types_of(fn, expr.body, m) | \
+                self._types_of(fn, expr.orelse, m)
+        if isinstance(expr, ast.BoolOp):
+            out = set()
+            for v in expr.values:
+                out |= self._types_of(fn, v, m)
+            return out
+        if isinstance(expr, ast.Await):
+            return self._types_of(fn, expr.value, m)
+        if isinstance(expr, ast.NamedExpr):
+            return self._types_of(fn, expr.value, m)
+        return set()
+
+    def _elems_of(self, fn: Optional[FunctionInfo], expr: ast.AST,
+                  m: Optional[ModuleIndex] = None) -> Set[str]:
+        if expr is None:
+            return set()
+        if isinstance(expr, (ast.List, ast.Tuple, ast.Set)):
+            out: Set[str] = set()
+            for e in expr.elts:
+                out |= self._types_of(fn, e, m)
+            return out
+        if isinstance(expr, ast.ListComp):
+            return self._types_of(fn, expr.elt, m)
+        if isinstance(expr, ast.Name):
+            return self._lookup_name(fn, expr.id, self.elems)
+        if isinstance(expr, ast.Attribute) and fn is not None:
+            out = set()
+            for ck in self._self_types(fn, expr.value):
+                out |= self.elems.get(("attr", ck, expr.attr), set())
+            return out
+        if isinstance(expr, ast.Call) and fn is not None:
+            out = set()
+            for tq in self.resolve_call(fn, expr):
+                out |= self.elems.get(("ret", tq), set())
+            return out
+        if isinstance(expr, ast.IfExp):
+            return self._elems_of(fn, expr.body, m) | \
+                self._elems_of(fn, expr.orelse, m)
+        if isinstance(expr, ast.BoolOp):
+            out = set()
+            for v in expr.values:
+                out |= self._elems_of(fn, v, m)
+            return out
+        return set()
+
+    def _fnrefs_of(self, fn: Optional[FunctionInfo], expr: ast.AST,
+                   m: Optional[ModuleIndex] = None) -> Set[str]:
+        if expr is None:
+            return set()
+        mod = m or (self.graph.modules.get(fn.modname) if fn else None)
+        if isinstance(expr, (ast.IfExp,)):
+            return self._fnrefs_of(fn, expr.body, m) | \
+                self._fnrefs_of(fn, expr.orelse, m)
+        if isinstance(expr, ast.BoolOp):
+            out: Set[str] = set()
+            for v in expr.values:
+                out |= self._fnrefs_of(fn, v, m)
+            return out
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            # a direct function/method reference first
+            if mod is not None:
+                hit = self.graph._resolve_fn_arg(mod, fn, expr)
+                if hit is not None:
+                    return {hit.qualname}
+            if isinstance(expr, ast.Name):
+                return self._lookup_name(fn, expr.id, self.fnrefs)
+            if isinstance(expr, ast.Attribute) and fn is not None:
+                out = set()
+                for ck in self._self_types(fn, expr.value):
+                    out |= self.fnrefs.get(("attr", ck, expr.attr),
+                                           set())
+                return out
+        if isinstance(expr, ast.Call) and fn is not None:
+            # functools.partial(f, ...) and friends: first arg
+            dotted = _dotted_from(expr.func)
+            if dotted and mod is not None:
+                gdot = self.graph.resolve_dotted(mod, dotted)
+                if gdot in ("functools.partial", "partial") and \
+                        expr.args:
+                    return self._fnrefs_of(fn, expr.args[0], m)
+            out = set()
+            for tq in self.resolve_call(fn, expr):
+                out |= self.fnrefs.get(("ret", tq), set())
+            return out
+        return set()
+
+    def _lookup_name(self, fn: Optional[FunctionInfo], name: str,
+                     table: Dict[Tuple, Set[str]]) -> Set[str]:
+        """Name lookup through local -> param -> enclosing-function
+        locals (the ``outer = self`` closure idiom) -> module
+        global."""
+        if fn is None:
+            return set()
+        out = table.get(("local", fn.qualname, name), set()) | \
+            table.get(("param", fn.qualname, name), set())
+        if out:
+            return set(out)
+        for enc in _enclosing_function_quals(fn.qualname):
+            hit = table.get(("local", enc, name), set()) | \
+                table.get(("param", enc, name), set())
+            if hit:
+                return set(hit)
+        return set(table.get(("glob", fn.modname, name), set()))
+
+    # -- call resolution ------------------------------------------------
+    def resolve_call(self, fn: Optional[FunctionInfo],
+                     call: ast.Call) -> List[str]:
+        """In-tree callee qualnames a call site can land on (possibly
+        several through callback sets; empty when external)."""
+        if fn is None:
+            return []
+        mod = self.graph.modules.get(fn.modname)
+        if mod is None:
+            return []
+        dotted = _dotted_from(call.func)
+        if dotted is not None:
+            gdot = self.graph.resolve_dotted(mod, dotted)
+            ck = self.graph._class_key(mod, gdot)
+            if ck:
+                cmod, cqual = ck.split(":", 1)
+                init = self.graph._method_on(
+                    self.graph.modules[cmod], cqual, "__init__")
+                return [init.qualname] if init else []
+            hit = self.graph._resolve_fn_arg(mod, fn, call.func)
+            if hit is not None:
+                return [hit.qualname]
+        if isinstance(call.func, ast.Attribute):
+            meth = call.func.attr
+            out: Set[str] = set()
+            for ck in self._self_types(fn, call.func.value):
+                cmod, cqual = ck.split(":", 1)
+                m = self.graph.modules.get(cmod)
+                if m is None:
+                    continue
+                hit = self.graph._method_on(m, cqual, meth)
+                if hit is not None:
+                    out.add(hit.qualname)
+                else:
+                    # a stored callback invoked through an attribute
+                    out |= self.fnrefs.get(("attr", ck, meth), set())
+            return sorted(out)
+        if isinstance(call.func, ast.Name):
+            refs = self._lookup_name(fn, call.func.id, self.fnrefs)
+            if refs:
+                return sorted(refs)
+        return []
+
+    # -- locks ----------------------------------------------------------
+    def _collect_locks(self):
+        """Register every attribute/global/local assigned from a
+        ``threading.Lock()``-family constructor."""
+        for m in self.graph.modules.values():
+            for st in m.tree.body:
+                self._lock_from_assign(m, None, st, frozenset())
+            for fn in m.functions.values():
+                gl = _global_decls(fn.node)
+                for st in _own_statements(fn.node):
+                    self._lock_from_assign(m, fn, st, gl)
+
+    def _lock_from_assign(self, m: ModuleIndex,
+                          fn: Optional[FunctionInfo], st,
+                          gl: Set[str]):
+        if not isinstance(st, (ast.Assign, ast.AnnAssign)):
+            return
+        value = st.value
+        kind = self._lock_kind(m, value)
+        table = self.lock_kinds
+        if kind is None:
+            kind = self._safe_kind(m, value)
+            table = self.safe_kinds
+        if kind is None:
+            return
+        targets = st.targets if isinstance(st, ast.Assign) else \
+            [st.target]
+        for tgt in targets:
+            dest = self._dest_key(m, fn, tgt, gl)
+            if dest is None:
+                continue
+            table[_state_key(dest)] = kind
+
+    def _lock_kind(self, m: ModuleIndex,
+                   value: Optional[ast.AST]) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        dotted = _dotted_from(value.func)
+        if dotted is None:
+            return None
+        return _LOCK_FACTORIES.get(self.graph.resolve_dotted(m, dotted))
+
+    def _safe_kind(self, m: ModuleIndex,
+                   value: Optional[ast.AST]) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        dotted = _dotted_from(value.func)
+        if dotted is None:
+            return None
+        return _THREADSAFE_FACTORIES.get(
+            self.graph.resolve_dotted(m, dotted))
+
+    def _lock_key_of(self, env: "_WalkEnv",
+                     expr: ast.AST) -> Optional[str]:
+        """The registered lock key an expression denotes, if any."""
+        key = self._access_key(env.fn, expr, env)
+        if key is not None and key[0] in self.lock_kinds:
+            return key[0]
+        # function-local lock objects (rare but cheap to honor)
+        if isinstance(expr, ast.Name):
+            local_key = f"{env.fn.qualname}.{expr.id}"
+            if local_key in self.lock_kinds:
+                return local_key
+        return None
+
+    # -- per-function walk ----------------------------------------------
+    def _walk_all_functions(self):
+        for m in self.graph.modules.values():
+            for fn in m.functions.values():
+                self._walk_fn(fn)
+
+    def _walk_fn(self, fn: FunctionInfo):
+        gl = _global_decls(fn.node)
+        locals_ = _local_names(fn.node, gl) | set(fn.params)
+        in_init = fn.node.name in _INIT_METHODS and \
+            fn.class_name is not None
+        env = _WalkEnv(fn, gl, locals_, in_init)
+        self._walk_block(list(getattr(fn.node, "body", [])), [], env)
+
+    def _walk_block(self, stmts: Sequence[ast.stmt],
+                    held: List[str], env: "_WalkEnv"):
+        held = list(held)
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                entered: List[str] = []
+                for item in st.items:
+                    lk = self._lock_key_of(env, item.context_expr)
+                    if lk is not None:
+                        self._record_acquire(env, lk, held + entered,
+                                             item.context_expr.lineno)
+                        entered.append(lk)
+                    else:
+                        self._collect(item.context_expr,
+                                      held + entered, env)
+                self._walk_block(st.body, held + entered, env)
+                continue
+            acq = self._acquire_release(env, st)
+            if acq is not None:
+                lk, is_acquire = acq
+                if is_acquire:
+                    self._record_acquire(env, lk, held, st.lineno)
+                    held.append(lk)
+                elif lk in held:
+                    held.remove(lk)
+                continue
+            if isinstance(st, ast.Try):
+                self._walk_block(st.body, held, env)
+                for h in st.handlers:
+                    self._walk_block(h.body, held, env)
+                self._walk_block(st.orelse, held, env)
+                self._walk_block(st.finalbody, held, env)
+                # l.acquire(); try: ... finally: l.release() — the
+                # release in finalbody ends the region after the Try
+                for rel in self._releases_in(env, st.finalbody):
+                    if rel in held:
+                        held.remove(rel)
+                continue
+            if isinstance(st, (ast.If,)):
+                self._collect(st.test, held, env)
+                self._walk_block(st.body, held, env)
+                self._walk_block(st.orelse, held, env)
+                continue
+            if isinstance(st, (ast.For, ast.AsyncFor)):
+                self._collect(st.iter, held, env)
+                self._collect(st.target, held, env)
+                self._walk_block(st.body, held, env)
+                self._walk_block(st.orelse, held, env)
+                continue
+            if isinstance(st, ast.While):
+                self._collect(st.test, held, env)
+                self._walk_block(st.body, held, env)
+                self._walk_block(st.orelse, held, env)
+                continue
+            self._collect(st, held, env)
+
+    def _acquire_release(self, env: "_WalkEnv",
+                         st: ast.stmt) -> Optional[Tuple[str, bool]]:
+        """``lk.acquire()`` / ``lk.release()`` statement -> (key,
+        is_acquire)."""
+        if not (isinstance(st, ast.Expr)
+                and isinstance(st.value, ast.Call)
+                and isinstance(st.value.func, ast.Attribute)
+                and st.value.func.attr in ("acquire", "release")):
+            return None
+        lk = self._lock_key_of(env, st.value.func.value)
+        if lk is None:
+            return None
+        return lk, st.value.func.attr == "acquire"
+
+    def _releases_in(self, env: "_WalkEnv",
+                     stmts: Sequence[ast.stmt]) -> List[str]:
+        out = []
+        for st in stmts:
+            ar = self._acquire_release(env, st)
+            if ar is not None and not ar[1]:
+                out.append(ar[0])
+        return out
+
+    def _record_acquire(self, env: "_WalkEnv", lock: str,
+                        held: Sequence[str], lineno: int):
+        self.acquisitions.append(Acquisition(
+            env.fn, lock, frozenset(held), lineno))
+
+    def _collect(self, node: ast.AST, held: Sequence[str],
+                 env: "_WalkEnv"):
+        """Record accesses and call sites inside one statement/expr,
+        skipping nested defs."""
+        fheld = frozenset(held)
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+            if isinstance(n, ast.Call):
+                self._record_call(n, fheld, env)
+                # receiver-mutating method == a write
+                if isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in _MUTATORS:
+                    key = self._access_key(env.fn, n.func.value, env)
+                    if key is not None:
+                        self._record_access(key, True, n.lineno,
+                                            fheld, env)
+                continue
+            if isinstance(n, (ast.Attribute, ast.Name)):
+                key = self._access_key(env.fn, n, env)
+                if key is None:
+                    continue
+                write = isinstance(getattr(n, "ctx", None),
+                                   (ast.Store, ast.Del))
+                self._record_access(key, write, n.lineno, fheld, env)
+                if not write and isinstance(n, ast.Attribute):
+                    self._maybe_property_call(n, fheld, env)
+                continue
+            if isinstance(n, ast.Subscript):
+                if isinstance(getattr(n, "ctx", None),
+                              (ast.Store, ast.Del)):
+                    key = self._access_key(env.fn, n.value, env)
+                    if key is not None:
+                        self._record_access(key, True, n.lineno,
+                                            fheld, env)
+
+    def _maybe_property_call(self, n: ast.Attribute,
+                             fheld: FrozenSet[str], env: "_WalkEnv"):
+        """An attribute read hitting an in-tree @property is a call
+        edge into the getter."""
+        for ck in self._self_types(env.fn, n.value):
+            getter = self.properties.get((ck, n.attr))
+            if getter:
+                self.calls.append(CallOp(
+                    env.fn, None, None, n.attr, 0, (getter,),
+                    n.lineno, fheld))
+
+    def _record_call(self, call: ast.Call, fheld: FrozenSet[str],
+                     env: "_WalkEnv"):
+        fn = env.fn
+        mod = self.graph.modules.get(fn.modname)
+        dotted = _dotted_from(call.func)
+        gdot = self.graph.resolve_dotted(mod, dotted) \
+            if (dotted and mod) else None
+        attr = call.func.attr if isinstance(call.func, ast.Attribute) \
+            else None
+        targets = tuple(self.resolve_call(fn, call))
+        self.calls.append(CallOp(fn, call, gdot, attr, len(call.args),
+                                 targets, call.lineno, fheld))
+
+    def _access_key(self, fn: FunctionInfo, expr: ast.AST,
+                    env: Optional["_WalkEnv"] = None
+                    ) -> Optional[Tuple[str, str]]:
+        """(state key, display name) for a tracked location, else
+        None."""
+        if isinstance(expr, ast.Attribute):
+            for ck in self._self_types(fn, expr.value):
+                key = f"{ck}.{expr.attr}"
+                disp = f"{ck.split(':', 1)[1]}.{expr.attr}"
+                return key, disp
+            return None
+        if isinstance(expr, ast.Name):
+            if env is None:
+                return None
+            name = expr.id
+            if name in ("self", "cls") or name in env.locals:
+                return None
+            if name not in env.globals and \
+                    name not in self._module_globals.get(
+                        fn.modname, set()):
+                return None
+            mod = self.graph.modules.get(fn.modname)
+            if mod is not None and name in mod.aliases:
+                return None
+            if _enclosing_locals(self, fn, name):
+                return None
+            key = f"{fn.modname}:{name}"
+            return key, f"{fn.modname}.{name}"
+        return None
+
+    def _record_access(self, key: Tuple[str, str], write: bool,
+                       lineno: int, fheld: FrozenSet[str],
+                       env: "_WalkEnv"):
+        k, disp = key
+        if k in self.lock_kinds or k in self.safe_kinds:
+            return     # locks and Event/Queue are shared by design
+        own_class = f"{env.fn.modname}:{env.fn.class_name}" \
+            if env.fn.class_name else None
+        in_init = env.in_init and own_class is not None and \
+            k.startswith(own_class + ".")
+        self.accesses.append(Access(k, disp, env.fn, write, lineno,
+                                    fheld, in_init))
+
+    # -- thread roots & contexts ----------------------------------------
+    def _find_thread_roots(self):
+        for m in self.graph.modules.values():
+            # handler subclasses: every method runs per-request
+            for cqual in m.classes:
+                if self._is_handler_class(m, cqual):
+                    ck = f"{m.modname}:{cqual}"
+                    for qual, info in m.functions.items():
+                        if info.class_name == cqual:
+                            self.thread_roots.setdefault(
+                                info.qualname, f"http:{ck}")
+            # Thread / Timer spawn sites
+            for fn in m.functions.values():
+                for st in _own_statements(fn.node):
+                    for node in ast.walk(st):
+                        if isinstance(node, ast.Call):
+                            self._root_from_spawn(m, fn, node)
+
+    def _is_handler_class(self, m: ModuleIndex, cqual: str) -> bool:
+        seen: Set[Tuple[str, str]] = set()
+        stack = [(m, cqual)]
+        while stack:
+            mm, cq = stack.pop()
+            if (mm.modname, cq) in seen:
+                continue
+            seen.add((mm.modname, cq))
+            for b in mm.classes.get(cq, []):
+                gdot = self.graph.resolve_dotted(mm, b)
+                if gdot in _HANDLER_BASES:
+                    return True
+                key = self.graph._class_key(mm, gdot)
+                if key:
+                    bmod, bqual = key.split(":", 1)
+                    stack.append((self.graph.modules[bmod], bqual))
+        return False
+
+    def _root_from_spawn(self, m: ModuleIndex, fn: FunctionInfo,
+                         call: ast.Call):
+        dotted = _dotted_from(call.func)
+        if dotted is None:
+            return
+        gdot = self.graph.resolve_dotted(m, dotted)
+        if gdot not in _THREAD_FACTORIES:
+            return
+        target_expr = None
+        if gdot == "threading.Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    target_expr = kw.value
+            if target_expr is None and call.args:
+                # Thread(group, target, ...) positional form
+                if len(call.args) >= 2:
+                    target_expr = call.args[1]
+        else:   # Timer(interval, function)
+            for kw in call.keywords:
+                if kw.arg == "function":
+                    target_expr = kw.value
+            if target_expr is None and len(call.args) >= 2:
+                target_expr = call.args[1]
+        if target_expr is None:
+            return
+        if isinstance(target_expr, ast.Lambda):
+            # calls inside the lambda body run on the new thread
+            for n in ast.walk(target_expr.body):
+                if isinstance(n, ast.Call):
+                    for tq in self.resolve_call(fn, n):
+                        self.thread_roots.setdefault(
+                            tq, f"thread:{tq}")
+            return
+        for tq in sorted(self._fnrefs_of(fn, target_expr, m)):
+            self.thread_roots.setdefault(tq, f"thread:{tq}")
+
+    def _edges(self, qual: str) -> Set[str]:
+        """Outgoing resolved call edges of a function (cached):
+        resolved calls + property getters + constructor ``__init__`` +
+        one level of nested defs."""
+        cached = self._edges_cache.get(qual)
+        if cached is not None:
+            return cached
+        out: Set[str] = set()
+        fn = self.graph.functions.get(qual)
+        if fn is not None:
+            for op in self._calls_by_fn().get(qual, ()):
+                out.update(op.targets)
+            base = qual.split(":", 1)[1] + ".<locals>."
+            m = self.graph.modules.get(fn.modname)
+            if m is not None:
+                for info in m.functions.values():
+                    sub = info.qualname.split(":", 1)[1]
+                    if sub.startswith(base):
+                        out.add(info.qualname)
+        self._edges_cache[qual] = out
+        return out
+
+    def _calls_by_fn(self) -> Dict[str, List[CallOp]]:
+        if not hasattr(self, "_calls_index"):
+            idx: Dict[str, List[CallOp]] = {}
+            for op in self.calls:
+                idx.setdefault(op.fn.qualname, []).append(op)
+            self._calls_index = idx
+        return self._calls_index
+
+    def _propagate_contexts(self):
+        # threaded contexts from the roots
+        queue: List[Tuple[str, str]] = []
+
+        def mark(qual: str, ctx: str):
+            have = self.contexts.setdefault(qual, set())
+            if ctx not in have:
+                have.add(ctx)
+                queue.append((qual, ctx))
+
+        for qual, ctx in self.thread_roots.items():
+            mark(qual, ctx)
+        while queue:
+            qual, ctx = queue.pop()
+            for t in self._edges(qual):
+                mark(t, ctx)
+
+        # main context: seeded by functions nothing in-tree calls
+        # (entry points) and module-level call targets
+        callers: Dict[str, Set[str]] = {}
+        for qual in self.graph.functions:
+            for t in self._edges(qual):
+                callers.setdefault(t, set()).add(qual)
+        seeds: Set[str] = set()
+        for qual in self.graph.functions:
+            if qual in self.thread_roots:
+                continue
+            if not callers.get(qual):
+                seeds.add(qual)
+        for m in self.graph.modules.values():
+            for st in m.tree.body:
+                if isinstance(st, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                for n in ast.walk(st):
+                    if isinstance(n, ast.Call):
+                        dotted = _dotted_from(n.func)
+                        if dotted is None:
+                            continue
+                        hit = self.graph._resolve_fn_arg(m, None,
+                                                         n.func)
+                        if hit is not None and \
+                                hit.qualname not in self.thread_roots:
+                            seeds.add(hit.qualname)
+        for s in sorted(seeds):
+            mark(s, "main")
+        while queue:
+            qual, ctx = queue.pop()
+            for t in self._edges(qual):
+                if t not in self.thread_roots:
+                    mark(t, ctx)
+
+    # -- caller lock inheritance ----------------------------------------
+    def _inherit_caller_locks(self):
+        """Meet-over-callers lock inheritance: a helper only ever
+        called with lock L held is guarded by L. Thread roots and
+        callback-invoked functions meet with the empty set (they can
+        be entered bare)."""
+        universe = frozenset(self.lock_kinds)
+        sites: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+        for op in self.calls:
+            for t in op.targets:
+                sites.setdefault(t, []).append(
+                    (op.fn.qualname, op.locks))
+        callback_targets: Set[str] = set()
+        for refs in self.fnrefs.values():
+            callback_targets |= refs
+        eff: Dict[str, FrozenSet[str]] = {}
+        for qual in self.graph.functions:
+            if qual in self.thread_roots or \
+                    qual in callback_targets or qual not in sites:
+                eff[qual] = frozenset()
+            else:
+                eff[qual] = universe
+        for _ in range(30):
+            changed = False
+            for qual, slist in sites.items():
+                if eff.get(qual) == frozenset() and (
+                        qual in self.thread_roots
+                        or qual in callback_targets):
+                    continue
+                if qual not in eff:
+                    continue
+                met: Optional[FrozenSet[str]] = None
+                for caller, locks in slist:
+                    here = locks | eff.get(caller, frozenset())
+                    met = here if met is None else (met & here)
+                if qual in self.thread_roots or \
+                        qual in callback_targets:
+                    met = frozenset()
+                if met is not None and met != eff[qual]:
+                    eff[qual] = met
+                    changed = True
+            if not changed:
+                break
+        self.inherited_locks = {q: l for q, l in eff.items() if l}
+        # fold inherited locks into every recorded access / call /
+        # acquisition of the affected functions
+        for a in self.accesses:
+            extra = eff.get(a.fn.qualname)
+            if extra:
+                a.locks = a.locks | extra
+        for op in self.calls:
+            extra = eff.get(op.fn.qualname)
+            if extra:
+                op.locks = op.locks | extra
+        for acq in self.acquisitions:
+            extra = eff.get(acq.fn.qualname)
+            if extra:
+                acq.held = acq.held | extra
+
+    # -- derived views for the rules ------------------------------------
+    def lock_pairs(self) -> Dict[Tuple[str, str],
+                                 Tuple[str, int]]:
+        """(outer, inner) lock-order pairs with one witness
+        ``(function qualname, line)`` each."""
+        pairs: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for acq in self.acquisitions:
+            for outer in acq.held:
+                pairs.setdefault((outer, acq.lock),
+                                 (acq.fn.qualname, acq.lineno))
+        return pairs
+
+
+@dataclasses.dataclass
+class _WalkEnv:
+    """Per-function state threaded through the lock-scope walk."""
+
+    fn: FunctionInfo
+    globals: Set[str]
+    locals: Set[str]
+    in_init: bool
+
+
+def _state_key(dest: Tuple) -> str:
+    """Inference dest key -> flat state key string."""
+    if dest[0] == "attr":
+        return f"{dest[1]}.{dest[2]}"
+    if dest[0] == "glob":
+        return f"{dest[1]}:{dest[2]}"
+    # local locks: scoped by the owning function
+    return f"{dest[1]}.{dest[2]}"
+
+
+def _module_assigned_names(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for st in tree.body:
+        if isinstance(st, ast.Assign):
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(st, (ast.AnnAssign, ast.AugAssign)):
+            if isinstance(st.target, ast.Name):
+                out.add(st.target.id)
+    return out
+
+
+def _global_decls(fn_node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for st in _own_statements(fn_node):
+        for n in ast.walk(st):
+            if isinstance(n, ast.Global):
+                out.update(n.names)
+    return out
+
+
+def _local_names(fn_node: ast.AST, gl: Set[str]) -> Set[str]:
+    """Names assigned in the function body (minus declared globals)."""
+    out: Set[str] = set()
+    for st in _own_statements(fn_node):
+        for n in ast.walk(st):
+            if isinstance(n, ast.Name) and \
+                    isinstance(n.ctx, ast.Store):
+                out.add(n.id)
+            elif isinstance(n, (ast.For, ast.AsyncFor)) and \
+                    isinstance(n.target, ast.Name):
+                out.add(n.target.id)
+            elif isinstance(n, ast.ExceptHandler) and n.name:
+                out.add(n.name)
+            elif isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    if isinstance(item.optional_vars, ast.Name):
+                        out.add(item.optional_vars.id)
+            elif isinstance(n, (ast.ListComp, ast.SetComp,
+                                ast.DictComp, ast.GeneratorExp)):
+                for gen in n.generators:
+                    for t in ast.walk(gen.target):
+                        if isinstance(t, ast.Name):
+                            out.add(t.id)
+    return out - gl
+
+
+def _own_statements(fn_node: ast.AST):
+    """Statements lexically inside one function, nested defs
+    skipped."""
+    stack = list(getattr(fn_node, "body", []))
+    while stack:
+        st = stack.pop()
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            continue
+        yield st
+        for field in ("body", "orelse", "finalbody"):
+            stack.extend(getattr(st, field, []))
+        for h in getattr(st, "handlers", []):
+            stack.extend(h.body)
+
+
+def _enclosing_function_quals(qualname: str) -> List[str]:
+    """Enclosing function qualnames of a nested def / nested-class
+    method (``mod:A.__init__.<locals>._H.do_GET`` ->
+    [``mod:A.__init__``])."""
+    mod, _, qual = qualname.partition(":")
+    out = []
+    parts = qual.split(".<locals>.")
+    for cut in range(len(parts) - 1, 0, -1):
+        out.append(f"{mod}:{'.<locals>.'.join(parts[:cut])}")
+    return out
+
+
+def _enclosing_locals(tg: ThreadGraph, fn: FunctionInfo,
+                      name: str) -> Set[str]:
+    """Whether ``name`` is a local of an enclosing function (closure
+    variable) — returns a set for truthiness at the call site."""
+    for enc in _enclosing_function_quals(fn.qualname):
+        einfo = tg.graph.functions.get(enc)
+        if einfo is None:
+            continue
+        gl = _global_decls(einfo.node)
+        if name in _local_names(einfo.node, gl) | set(einfo.params):
+            return {name}
+    return set()
+
+
+def build(graph: CallGraph) -> ThreadGraph:
+    """Build the thread graph over an existing jit call graph."""
+    return ThreadGraph(graph)

@@ -55,6 +55,7 @@ decoding, under any routing interleaving, with or without failover
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -120,6 +121,12 @@ class FleetRouter:
         self._factory = server_factory
         self._split = prefill_replicas > 0
         self._handoff = handoff
+        # /healthz runs on the metrics server's request threads while
+        # restart_replica swaps list entries on the main thread: the
+        # swap and the handler's list copy serialize on this lock
+        # (per-replica health then comes from each server's own
+        # thread-safe health_snapshot(), outside it)
+        self._health_lock = threading.Lock()
         self.replicas: List[FleetReplica] = []
         for i in range(num_replicas):
             role = "mixed" if not self._split else (
@@ -187,14 +194,18 @@ class FleetRouter:
     def _health_state(self) -> dict:
         """Fleet ``/healthz``: per-replica drain state plus the
         aggregate — ``ok`` while at least one replica admits, which is
-        exactly the rolling-restart availability story."""
+        exactly the rolling-restart availability story. Runs on HTTP
+        threads: copies the replica list under the fleet health lock,
+        then reads each server's published snapshot."""
+        with self._health_lock:
+            live = list(self.replicas)
         reps = []
-        for rep in self.replicas:
-            s = rep.server
+        for rep in live:
+            snap = rep.server.health_snapshot()
             reps.append({"name": rep.name, "role": rep.role,
-                         "status": "draining" if s.draining else "ok",
-                         "occupancy": s.occupancy,
-                         "pending": s.pending,
+                         "status": snap["status"],
+                         "occupancy": snap["occupancy"],
+                         "pending": snap["pending"],
                          "restarts": rep.restarts})
         ok = sum(1 for r in reps if r["status"] == "ok")
         return {"status": "ok" if ok else "draining",
@@ -486,9 +497,11 @@ class FleetRouter:
             if comp is not None:
                 done.append(comp)
         rep.server.close()
-        self.replicas[idx] = FleetReplica(
+        fresh = FleetReplica(
             name=rep.name, server=self._factory(rep.name),
             role=rep.role, restarts=rep.restarts + 1)
+        with self._health_lock:
+            self.replicas[idx] = fresh
         self.inc("fleet/restarts")
         # the new server's start_from_env stole /healthz — take it back
         self._install_endpoint()

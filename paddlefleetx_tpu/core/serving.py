@@ -110,6 +110,7 @@ from __future__ import annotations
 
 import dataclasses as _dc
 import signal
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass
@@ -344,6 +345,15 @@ class GenerationServer:
         self._recorder = FlightRecorder(events_path) if events_path \
             else None
         self._tracer = Tracer(self._recorder)
+        # /healthz is answered on the metrics server's per-request
+        # threads while the main loop mutates queue/slot state, so the
+        # payload is an immutable snapshot the main loop republishes
+        # (_refresh_health) at its choke points; HTTP threads read the
+        # snapshot under _health_lock and never touch live state
+        self._health_lock = threading.Lock()
+        self._health_snapshot = {
+            "status": "ok", "slots": num_slots, "occupancy": 0,
+            "pending": 0, "ticks": 0}
         # live /metrics + drain-aware /healthz when PFX_METRICS_PORT
         # is set; a no-op otherwise (docs/observability.md)
         self._metrics_server = obs_server.start_from_env(
@@ -383,14 +393,31 @@ class GenerationServer:
         if self._recorder is not None:
             self._recorder.emit(event, **fields)
 
+    def _refresh_health(self) -> None:
+        """Rebuild the ``/healthz`` payload from live state — main
+        thread only — and publish it under the health lock. Called at
+        the loop's choke points (submit, step end, drain entry,
+        SIGTERM), so the served payload is at most one step stale."""
+        payload = {"status": "draining" if self._draining else "ok",
+                   "slots": self.num_slots,
+                   "occupancy": self.occupancy,
+                   "pending": self.pending, "ticks": self._ticks}
+        with self._health_lock:
+            self._health_snapshot = payload
+
     def _health_state(self) -> dict:
         """The ``/healthz`` payload: ``status`` flips to ``draining``
         the moment drain mode is entered (SIGTERM or :meth:`drain`),
         which answers HTTP 503 — the load balancer's stop-routing
-        signal."""
-        return {"status": "draining" if self._draining else "ok",
-                "slots": self.num_slots, "occupancy": self.occupancy,
-                "pending": self.pending, "ticks": self._ticks}
+        signal. Runs on HTTP threads: serves the last published
+        snapshot, never live serving state."""
+        with self._health_lock:
+            return dict(self._health_snapshot)
+
+    def health_snapshot(self) -> dict:
+        """Thread-safe view of this server's health (the fleet router
+        builds its own ``/healthz`` payload from these)."""
+        return self._health_state()
 
     # -- per-request tracing (docs/observability.md) ------------------
     #
@@ -518,6 +545,7 @@ class GenerationServer:
             req["nonce"] = int(nonce)
         self._begin_trace(req, trace_id)
         self._queue.append(req)
+        self._refresh_health()
         return rid
 
     def _shed(self, reason: str) -> int:
@@ -536,6 +564,7 @@ class GenerationServer:
         partials (mirroring the Engine's save-on-preemption
         contract)."""
         self._draining = True
+        self._refresh_health()
         self._emit("serving_drain_start", signum=signum,
                    pending=self.pending, occupancy=self.occupancy)
 
@@ -1107,7 +1136,9 @@ class GenerationServer:
         ticks in a single fused device program (:meth:`_step_loop`) —
         same committed tokens, T× fewer host round-trips."""
         if self._loop_ticks > 1:
-            return self._step_loop()
+            out = self._step_loop()
+            self._refresh_health()
+            return out
         step_t0 = time.time()
         expired = self._expire_deadlines()
         if self._faults is not None:
@@ -1464,6 +1495,7 @@ class GenerationServer:
         reg.set_gauge("serving/slot_occupancy", self.occupancy)
         self._metrics.observe("serving/host_roundtrip_ms",
                               (time.time() - step_t0) * 1000.0)
+        self._refresh_health()
         return expired + done
 
     def drain(self, max_ticks: Optional[int] = None
@@ -1478,6 +1510,7 @@ class GenerationServer:
         lost."""
         if not self._draining:
             self._draining = True
+            self._refresh_health()
             self._emit("serving_drain_start", signum=None,
                        pending=self.pending, occupancy=self.occupancy)
         out: List[Completion] = self._flush_queue()
@@ -1492,6 +1525,7 @@ class GenerationServer:
         # a pool-exhaustion preempt during the tick loop requeues to
         # the (no longer admitting) queue — hand those back too
         out.extend(self._flush_queue())
+        self._refresh_health()
         self._emit("serving_drain_end", completions=len(out),
                    ticks=ticks)
         return out

@@ -46,10 +46,12 @@ def prometheus_text(registries: Iterable[Any]) -> str:
     """Text exposition of the given registries, merged.
 
     Args:
-        registries: live ``MetricsRegistry`` objects (NOT snapshots —
-            histograms export their bucket arrays). Counter/timer
-            values merge by summation, gauges last-wins, histograms
-            first-wins.
+        registries: live ``MetricsRegistry`` objects. Each is read
+            exactly once through ``snapshot()`` — the one
+            lock-protected cross-thread read — so the HTTP serving
+            thread never touches live tables or bucket arrays the
+            main loop is mutating. Counter/timer values merge by
+            summation, gauges last-wins, histograms first-wins.
 
     Returns:
         The exposition body, one ``# TYPE`` comment + samples per
@@ -58,7 +60,7 @@ def prometheus_text(registries: Iterable[Any]) -> str:
     counters: Dict[str, float] = {}
     gauges: Dict[str, float] = {}
     timers: Dict[str, float] = {}
-    hists: Dict[str, Any] = {}
+    hists: Dict[str, Dict[str, Any]] = {}
     for reg in registries:
         snap = reg.snapshot()
         for k, v in snap["counters"].items():
@@ -68,7 +70,7 @@ def prometheus_text(registries: Iterable[Any]) -> str:
                 gauges[k] = float(v)
         for k, v in snap["timers"].items():
             timers[k] = timers.get(k, 0.0) + v
-        for k, h in reg.histograms().items():
+        for k, h in snap["histograms"].items():
             hists.setdefault(k, h)
     lines: List[str] = []
     for name, val in sorted(counters.items()):
@@ -82,12 +84,13 @@ def prometheus_text(registries: Iterable[Any]) -> str:
         lines += [f"# TYPE {m} counter", f"{m} {_fmt(val)}"]
     for name, h in sorted(hists.items()):
         m = _metric_name(name)
+        count = h.get("count", 0)
         lines.append(f"# TYPE {m} histogram")
-        for upper, cum in h.cumulative():
+        for upper, cum in h.get("buckets", []):
             lines.append(f'{m}_bucket{{le="{_fmt(upper)}"}} {cum}')
-        lines.append(f'{m}_bucket{{le="+Inf"}} {h.count}')
-        lines.append(f"{m}_sum {_fmt(h.sum)}")
-        lines.append(f"{m}_count {h.count}")
+        lines.append(f'{m}_bucket{{le="+Inf"}} {count}')
+        lines.append(f"{m}_sum {_fmt(h.get('sum', 0.0))}")
+        lines.append(f"{m}_count {count}")
     return "\n".join(lines) + "\n"
 
 

@@ -159,10 +159,12 @@ class LogHistogram:
             yield self.bounds(idx)[1], cum
 
     def snapshot(self) -> Dict[str, Any]:
-        """Point-in-time summary dict (count/sum/min/max + p50/p90/p99),
-        the shape the registry snapshot and ``/vars`` export."""
+        """Point-in-time summary dict (count/sum/min/max + p50/p90/p99
+        + cumulative ``buckets``), the shape the registry snapshot,
+        ``/vars``, and the Prometheus exporter consume — exporters on
+        other threads read this copy, never the live bucket arrays."""
         if self._count == 0:
-            return {"count": 0, "sum": 0.0}
+            return {"count": 0, "sum": 0.0, "buckets": []}
         return {
             "count": self._count,
             "sum": round(self._sum, 6),
@@ -171,4 +173,6 @@ class LogHistogram:
             "p50": round(self.quantile(0.50), 6),
             "p90": round(self.quantile(0.90), 6),
             "p99": round(self.quantile(0.99), 6),
+            "buckets": [[upper, cum]
+                        for upper, cum in self.cumulative()],
         }

@@ -29,6 +29,7 @@ same PFX201/PFX202 contract as the counters.
 
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
@@ -38,10 +39,20 @@ from .histogram import LogHistogram
 
 class MetricsRegistry:
     """Counters / gauges / timers / series / histograms in plain
-    dicts."""
+    dicts, guarded by one lock.
+
+    Thread model: the watchdog thread (``core/resilience.py``) and the
+    metrics HTTP server's per-request threads
+    (``observability/server.py``) read and increment registries the
+    main loop mutates, so every table access goes through
+    ``self._lock``. The ``enabled`` fast path stays OUTSIDE the lock —
+    it is a GIL-atomic boolean read and the only thing the hot path
+    pays when telemetry is off (the bench-harness test pins that
+    overhead below 1%)."""
 
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
+        self._lock = threading.Lock()
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, Any] = {}
         self._timers: Dict[str, float] = {}
@@ -52,25 +63,30 @@ class MetricsRegistry:
     def inc(self, name: str, n: float = 1) -> None:
         if not self.enabled:
             return
-        self._counters[name] = self._counters.get(name, 0) + n
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
 
     def counter(self, name: str) -> float:
-        return self._counters.get(name, 0)
+        with self._lock:
+            return self._counters.get(name, 0)
 
     # -- gauges --------------------------------------------------------
     def set_gauge(self, name: str, value: Any) -> None:
         if not self.enabled:
             return
-        self._gauges[name] = value
+        with self._lock:
+            self._gauges[name] = value
 
     def gauge(self, name: str, default: Any = None) -> Any:
-        return self._gauges.get(name, default)
+        with self._lock:
+            return self._gauges.get(name, default)
 
     # -- timers --------------------------------------------------------
     def add_time(self, name: str, seconds: float) -> None:
         if not self.enabled:
             return
-        self._timers[name] = self._timers.get(name, 0.0) + seconds
+        with self._lock:
+            self._timers[name] = self._timers.get(name, 0.0) + seconds
 
     @contextmanager
     def timer(self, name: str):
@@ -84,15 +100,19 @@ class MetricsRegistry:
             self.inc(name + "/calls")
 
     def timed(self, name: str) -> float:
-        return self._timers.get(name, 0.0)
+        with self._lock:
+            return self._timers.get(name, 0.0)
 
     # -- series --------------------------------------------------------
     def series(self, name: str) -> List[float]:
         """The mutable sample list registered under ``name`` (created
         on first use). Callers append/clear the returned list directly
         — an alias, not a copy — so absorbing an existing ad-hoc list
-        costs nothing on the appending path."""
-        return self._series.setdefault(name, [])
+        costs nothing on the appending path. The alias is main-thread
+        state: cross-thread readers must use ``snapshot()``, which
+        copies under the registry lock."""
+        with self._lock:
+            return self._series.setdefault(name, [])
 
     # -- histograms ----------------------------------------------------
     def observe(self, name: str, value: float) -> None:
@@ -101,45 +121,54 @@ class MetricsRegistry:
         the percentile-series counterpart of ``inc``."""
         if not self.enabled:
             return
-        h = self._hists.get(name)
-        if h is None:
-            h = self._hists[name] = LogHistogram()
-        h.observe(value)
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = LogHistogram()
+            h.observe(value)
 
     def histogram(self, name: str) -> Optional[LogHistogram]:
-        """The live histogram registered under ``name``, or None."""
-        return self._hists.get(name)
+        """The live histogram registered under ``name``, or None.
+        Like ``series()``, the returned object is main-thread state —
+        exporters on other threads read ``snapshot()`` instead."""
+        with self._lock:
+            return self._hists.get(name)
 
     def histograms(self) -> Dict[str, LogHistogram]:
-        """Shallow copy of the name -> histogram table (the Prometheus
-        exporter walks the live bucket arrays through this)."""
-        return dict(self._hists)
+        """Shallow copy of the name -> histogram table. The histogram
+        objects are live — cross-thread consumers (the Prometheus
+        exporter) must use ``snapshot()["histograms"]``."""
+        with self._lock:
+            return dict(self._hists)
 
     # -- lifecycle -----------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
         """Point-in-time copy: ``{"counters", "gauges", "timers",
         "series", "histograms"}`` (series copied shallowly, histograms
-        as summary dicts)."""
-        return {
-            "counters": dict(self._counters),
-            "gauges": dict(self._gauges),
-            "timers": dict(self._timers),
-            "series": {k: list(v) for k, v in self._series.items()},
-            "histograms": {k: h.snapshot()
-                           for k, h in self._hists.items()},
-        }
+        as summary dicts). The one safe cross-thread read."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "timers": dict(self._timers),
+                "series": {k: list(v)
+                           for k, v in self._series.items()},
+                "histograms": {k: h.snapshot()
+                               for k, h in self._hists.items()},
+            }
 
     def reset(self) -> None:
         """Zero everything; registered series are cleared IN PLACE so
         aliases handed out by ``series()`` stay live (histograms
         likewise reset in place, not dropped)."""
-        self._counters.clear()
-        self._gauges.clear()
-        self._timers.clear()
-        for v in self._series.values():
-            del v[:]
-        for h in self._hists.values():
-            h.reset()
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._timers.clear()
+            for v in self._series.values():
+                del v[:]
+            for h in self._hists.values():
+                h.reset()
 
 
 #: process-global dispatch-counter registry; disabled until the engine
@@ -152,7 +181,11 @@ def get_registry() -> MetricsRegistry:
 
 
 def set_enabled(flag: bool) -> None:
-    _global.enabled = bool(flag)
+    # benign race by design: `enabled` is a GIL-atomic boolean the hot
+    # path reads WITHOUT the registry lock (that unlocked read is the
+    # entire disabled-cost budget); a racing reader sees the old value
+    # for at most one sample, which telemetry tolerates
+    _global.enabled = bool(flag)   # pfxlint: disable=PFX301
 
 
 def inc(name: str, n: float = 1) -> None:
@@ -160,7 +193,7 @@ def inc(name: str, n: float = 1) -> None:
     telemetry is disabled."""
     if not _global.enabled:
         return
-    _global._counters[name] = _global._counters.get(name, 0) + n
+    _global.inc(name, n)
 
 
 def observe(name: str, value: float) -> None:

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -47,12 +48,19 @@ class FlightRecorder:
     """Append-only JSONL event log that survives crashes: every
     ``emit`` is flushed and fsynced, so the last record is on disk
     even if the process is SIGKILLed right after. Size-capped: the
-    stream rolls once to ``<path>.1`` at ``max_bytes``."""
+    stream rolls once to ``<path>.1`` at ``max_bytes``.
+
+    Thread-safe: the watchdog thread emits stall events into the same
+    recorder the main loop writes, so ``emit``/``close`` serialize on
+    ``self._lock`` — without it a rotation racing an emit can write
+    through a closed handle (``_write``/``_rotate`` run only inside
+    that region and need no lock of their own)."""
 
     def __init__(self, path: str, max_bytes: Optional[int] = None):
         self.path = path
         self.max_bytes = int(max_bytes) if max_bytes else \
             _max_bytes_from_env()
+        self._lock = threading.Lock()
         self._f = None
         self._size = 0
         try:
@@ -101,30 +109,32 @@ class FlightRecorder:
     def emit(self, event: str, **fields: Any) -> None:
         """Append one event line, durably (flush + fsync), rotating
         first when the file would exceed ``max_bytes``."""
-        if self._f is None:
-            return
-        if self._size >= self.max_bytes and self._size > 0:
-            self._rotate()
+        with self._lock:
             if self._f is None:
                 return
-        # stamped AFTER any rotation: the roll writes its own
-        # recorder_rotated event, and a pre-roll stamp would order
-        # this record before it whenever the roll's fsync crosses a
-        # millisecond boundary
-        record = {"ts": round(time.time(), 3), "event": event}
-        record.update(fields)
-        self._write(record)
+            if self._size >= self.max_bytes and self._size > 0:
+                self._rotate()
+                if self._f is None:
+                    return
+            # stamped AFTER any rotation: the roll writes its own
+            # recorder_rotated event, and a pre-roll stamp would order
+            # this record before it whenever the roll's fsync crosses
+            # a millisecond boundary
+            record = {"ts": round(time.time(), 3), "event": event}
+            record.update(fields)
+            self._write(record)
 
     def tail(self, n: int = 10) -> List[Dict[str, Any]]:
         return read_tail(self.path, n)
 
     def close(self) -> None:
-        if self._f is not None:
-            try:
-                self._f.close()
-            except OSError:
-                pass
-            self._f = None
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
 
 
 def _read_lines(path: Optional[str]) -> List[str]:

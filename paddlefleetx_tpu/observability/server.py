@@ -49,6 +49,11 @@ class MetricsServer:
                  registries: Optional[List[Any]] = None,
                  health: Optional[Callable[[], Dict[str, Any]]] = None,
                  events_path: Optional[str] = None):
+        # the wiring tables are written by the main thread (attach
+        # calls after construction) and read by per-request threads,
+        # so both sides go through _cb_lock; handlers snapshot under
+        # it and do their (blocking) socket IO outside it
+        self._cb_lock = threading.Lock()
         self._registries: List[Any] = [metrics_mod.get_registry()]
         for reg in registries or []:
             self.add_registry(reg)
@@ -85,17 +90,20 @@ class MetricsServer:
 
     def add_registry(self, reg: Any) -> None:
         """Attach another live registry to /metrics and /vars."""
-        if reg is not None and reg not in self._registries:
-            self._registries.append(reg)
+        with self._cb_lock:
+            if reg is not None and reg not in self._registries:
+                self._registries.append(reg)
 
     def set_health(self, fn: Callable[[], Dict[str, Any]]) -> None:
         """Install the /healthz payload provider (dict with a
         ``status`` key; anything but ``"ok"`` answers 503)."""
-        self._health = fn
+        with self._cb_lock:
+            self._health = fn
 
     def set_events_path(self, path: str) -> None:
         """Point /trace at an events.jsonl stream."""
-        self._events_path = path
+        with self._cb_lock:
+            self._events_path = path
 
     def close(self) -> None:
         """Stop serving and release the port. Idempotent."""
@@ -117,32 +125,39 @@ class MetricsServer:
 
     def _handle(self, handler) -> None:
         path = handler.path.split("?", 1)[0]
+        # snapshot the wiring under the lock, then render and answer
+        # outside it — _respond blocks on the client socket and must
+        # never do so while holding _cb_lock
+        with self._cb_lock:
+            registries = list(self._registries)
+            health = self._health
+            events_path = self._events_path
         try:
             if path == "/metrics":
                 self._respond(
                     handler, 200,
-                    export.prometheus_text(self._registries),
+                    export.prometheus_text(registries),
                     "text/plain; version=0.0.4; charset=utf-8")
             elif path == "/vars":
                 snap = export.merge_snapshots(
-                    r.snapshot() for r in self._registries)
+                    r.snapshot() for r in registries)
                 self._respond(handler, 200,
                               json.dumps(snap, default=str),
                               "application/json")
             elif path == "/healthz":
-                payload = self._health() if self._health is not None \
+                payload = health() if health is not None \
                     else {"status": "ok"}
                 code = 200 if payload.get("status") == "ok" else 503
                 self._respond(handler, code, json.dumps(payload),
                               "application/json")
             elif path == "/trace":
-                if not self._events_path:
+                if not events_path:
                     self._respond(handler, 404,
                                   '{"error": "no events stream"}',
                                   "application/json")
                     return
                 trace = export.chrome_trace(
-                    read_events(self._events_path))
+                    read_events(events_path))
                 self._respond(handler, 200,
                               json.dumps(trace, default=str),
                               "application/json")
@@ -214,9 +229,12 @@ def start_from_env(registry: Any = None,
 
 def stop() -> None:
     """Shut the singleton down (tests; long-lived runs just exit —
-    the serving thread is a daemon)."""
+    the serving thread is a daemon). The singleton swap happens under
+    ``_lock`` but the actual shutdown — which BLOCKS until the serve
+    loop exits — runs outside it, so a request thread that needs the
+    module lock can finish and the loop can drain."""
     global _server
     with _lock:
-        if _server is not None:
-            _server.close()
-            _server = None
+        doomed, _server = _server, None
+    if doomed is not None:
+        doomed.close()

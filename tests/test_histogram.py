@@ -72,7 +72,7 @@ def test_empty_and_nonfinite():
     h = LogHistogram()
     assert h.count == 0
     assert h.quantile(0.5) == 0.0
-    assert h.snapshot() == {"count": 0, "sum": 0.0}
+    assert h.snapshot() == {"count": 0, "sum": 0.0, "buckets": []}
     h.observe(float("nan"))
     h.observe(float("inf"))
     h.observe(float("-inf"))

@@ -495,3 +495,474 @@ def test_cli_list_rules_and_clean_exit():
     assert main(["--list-rules"]) == 0
     assert main(["--root", REPO]) == 0
     assert main(["--root", REPO, "--select", "NOPE"]) == 2
+
+
+# -- jit dataflow: PFX104 use-after-donation ---------------------------
+
+DONATE_MOD = MOD + (
+    "import jax\n"
+    "def train_step(state, batch):\n"
+    '    """Step."""\n'
+    "    return state, 1.0\n"
+    "class Engine:\n"
+    '    """E."""\n'
+    "    def __init__(self):\n"
+    "        self._step = jax.jit(train_step, donate_argnums=(0,))\n")
+
+
+def test_pfx104_read_after_donation_fires():
+    src = DONATE_MOD + (
+        "    def bad(self, state, batch):\n"
+        '        """Loses the rebind."""\n'
+        "        m = self._step(state, batch)\n"
+        "        return state.params, m\n")
+    assert _codes({"paddlefleetx_tpu/a.py": src},
+                  {"PFX104"}) == ["PFX104"]
+
+
+def test_pfx104_rebind_on_call_statement_is_clean():
+    src = DONATE_MOD + (
+        "    def good(self, state, batch):\n"
+        '        """The rebind idiom."""\n'
+        "        state, m = self._step(state, batch)\n"
+        "        return state.params, m\n")
+    assert _codes({"paddlefleetx_tpu/a.py": src}, {"PFX104"}) == []
+
+
+def test_pfx104_partial_decorator_form():
+    src = MOD + (
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, donate_argnums=(0,))\n"
+        "def step(state, batch):\n"
+        '    """Step."""\n'
+        "    return state\n"
+        "def drive(state, batch):\n"
+        '    """Caller."""\n'
+        "    out = step(state, batch)\n"
+        "    return state, out\n")
+    assert _codes({"paddlefleetx_tpu/a.py": src},
+                  {"PFX104"}) == ["PFX104"]
+
+
+# -- jit dataflow: PFX105 tracer escape --------------------------------
+
+def test_pfx105_store_to_self_fires():
+    src = MOD + (
+        "import jax\n"
+        "class Model:\n"
+        '    """M."""\n'
+        "    @jax.jit\n"
+        "    def step(self, x):\n"
+        '        """Traced."""\n'
+        "        y = x * 2\n"
+        "        self._cache = y\n"
+        "        return y\n")
+    assert _codes({"paddlefleetx_tpu/a.py": src},
+                  {"PFX105"}) == ["PFX105"]
+
+
+def test_pfx105_global_container_fires():
+    src = MOD + (
+        "import jax\n"
+        "_CACHE = {}\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        '    """Traced."""\n'
+        "    global _CACHE\n"
+        "    _CACHE['y'] = x + 1\n"
+        "    return x\n")
+    assert _codes({"paddlefleetx_tpu/a.py": src},
+                  {"PFX105"}) == ["PFX105"]
+
+
+def test_pfx105_shape_store_and_untraced_are_clean():
+    src = MOD + (
+        "import jax\n"
+        "class Model:\n"
+        '    """M."""\n'
+        "    @jax.jit\n"
+        "    def step(self, x):\n"
+        '        """Shape is concrete at trace time."""\n'
+        "        self._shape = x.shape\n"
+        "        self._n = len(x)\n"
+        "        return x\n"
+        "    def eager(self, x):\n"
+        '        """Not traced: storing is fine."""\n'
+        "        self._last = x\n"
+        "        return x\n")
+    assert _codes({"paddlefleetx_tpu/a.py": src}, {"PFX105"}) == []
+
+
+# -- thread-entry graph ------------------------------------------------
+
+def test_thread_root_from_target_bound_method():
+    src = MOD + (
+        "import threading\n"
+        "class Server:\n"
+        '    """S."""\n'
+        "    def start(self):\n"
+        '        """Spawn."""\n'
+        "        t = threading.Thread(target=self._run, daemon=True)\n"
+        "        t.start()\n"
+        "    def _run(self):\n"
+        '        """Body."""\n'
+    )
+    tg = _ctx({"paddlefleetx_tpu/a.py": src}).threadgraph
+    q = "paddlefleetx_tpu.a:Server._run"
+    assert q in tg.thread_roots
+    assert any(c.startswith("thread:") for c in tg.contexts_of(q))
+
+
+def test_thread_root_from_lambda_target_and_timer():
+    src = MOD + (
+        "import threading\n"
+        "def work():\n"
+        '    """Body."""\n'
+        "def tick():\n"
+        '    """Timer body."""\n'
+        "def main():\n"
+        '    """Main."""\n'
+        "    threading.Thread(target=lambda: work()).start()\n"
+        "    threading.Timer(1.0, tick).start()\n")
+    tg = _ctx({"paddlefleetx_tpu/a.py": src}).threadgraph
+    assert "paddlefleetx_tpu.a:work" in tg.thread_roots
+    assert "paddlefleetx_tpu.a:tick" in tg.thread_roots
+    assert "main" in tg.contexts_of("paddlefleetx_tpu.a:main")
+
+
+def test_http_handler_methods_are_roots_and_callbacks_flow():
+    src = MOD + (
+        "import threading\n"
+        "from http.server import BaseHTTPRequestHandler, "
+        "ThreadingHTTPServer\n"
+        "class Srv:\n"
+        '    """S."""\n'
+        "    def __init__(self):\n"
+        "        self._health = None\n"
+        "        outer = self\n"
+        "        class _H(BaseHTTPRequestHandler):\n"
+        '            """H."""\n'
+        "            def do_GET(self):\n"
+        '                """Handle."""\n'
+        "                outer._handle(self)\n"
+        "        self._httpd = ThreadingHTTPServer(('', 0), _H)\n"
+        "    def set_health(self, fn):\n"
+        '        """Install."""\n'
+        "        self._health = fn\n"
+        "    def _handle(self, h):\n"
+        '        """Dispatch."""\n'
+        "        if self._health is not None:\n"
+        "            return self._health()\n"
+        "class App:\n"
+        '    """A."""\n'
+        "    def __init__(self):\n"
+        "        self.ticks = 0\n"
+        "        srv = Srv()\n"
+        "        srv.set_health(self._health_state)\n"
+        "    def _health_state(self):\n"
+        '        """Callback."""\n'
+        "        return {'ticks': self.ticks}\n"
+        "    def step(self):\n"
+        '        """Main loop."""\n'
+        "        self.ticks += 1\n")
+    ctx = _ctx({"paddlefleetx_tpu/a.py": src})
+    tg = ctx.threadgraph
+    # handler method is a root with an http context label
+    assert any(q.endswith("._H.do_GET") for q in tg.thread_roots)
+    # the callback registered through set_health inherits that context
+    cb = tg.contexts_of("paddlefleetx_tpu.a:App._health_state")
+    assert any(c.startswith("http:") for c in cb)
+    # and the unlocked shared counter is a PFX301 race
+    keys = {f.key for f in run_rules(ctx, select={"PFX301"})}
+    assert "paddlefleetx_tpu.a:App.ticks" in keys
+
+
+# -- lock scopes -------------------------------------------------------
+
+RACE_MOD = MOD + (
+    "import threading\n"
+    "class Server:\n"
+    '    """S."""\n'
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self.count = 0\n"
+    "        self.status = 'idle'\n"
+    "        threading.Thread(target=self._run).start()\n")
+
+
+def test_pfx301_with_block_guard_is_clean_unguarded_fires():
+    src = RACE_MOD + (
+        "    def _run(self):\n"
+        '        """Thread body."""\n'
+        "        with self._lock:\n"
+        "            self.count += 1\n"
+        "        self.status = 'ran'\n"
+        "    def read(self):\n"
+        '        """Main side."""\n'
+        "        with self._lock:\n"
+        "            c = self.count\n"
+        "        return c, self.status\n")
+    findings = run_rules(_ctx({"paddlefleetx_tpu/a.py": src}),
+                         select={"PFX301"})
+    assert [f.key for f in findings] == \
+        ["paddlefleetx_tpu.a:Server.status"]
+
+
+def test_pfx301_try_finally_acquire_release_scopes():
+    src = MOD + (
+        "import threading\n"
+        "lk = threading.Lock()\n"
+        "state = 0\n"
+        "bad = 0\n"
+        "def worker():\n"
+        '    """Thread body."""\n'
+        "    global state, bad\n"
+        "    lk.acquire()\n"
+        "    try:\n"
+        "        state = 1\n"
+        "    finally:\n"
+        "        lk.release()\n"
+        "    bad = 1\n"
+        "def main():\n"
+        '    """Main."""\n'
+        "    global state, bad\n"
+        "    threading.Thread(target=worker).start()\n"
+        "    lk.acquire()\n"
+        "    try:\n"
+        "        state = 2\n"
+        "    finally:\n"
+        "        lk.release()\n"
+        "    bad = 2\n")
+    findings = run_rules(_ctx({"paddlefleetx_tpu/a.py": src}),
+                         select={"PFX301"})
+    assert [f.key for f in findings] == ["paddlefleetx_tpu.a:bad"]
+
+
+def test_pfx301_nested_locks_share_common_guard():
+    src = MOD + (
+        "import threading\n"
+        "a = threading.Lock()\n"
+        "b = threading.Lock()\n"
+        "x = 0\n"
+        "def worker():\n"
+        '    """Holds a then b."""\n'
+        "    global x\n"
+        "    with a:\n"
+        "        with b:\n"
+        "            x = 1\n"
+        "def main():\n"
+        '    """Holds only b — still a common lock."""\n'
+        "    global x\n"
+        "    threading.Thread(target=worker).start()\n"
+        "    with b:\n"
+        "        x = 2\n")
+    assert _codes({"paddlefleetx_tpu/a.py": src}, {"PFX301"}) == []
+
+
+def test_pfx301_init_writes_and_event_objects_exempt():
+    src = MOD + (
+        "import threading\n"
+        "class Dog:\n"
+        '    """Watchdog."""\n'
+        "    def __init__(self):\n"
+        "        self._stop = threading.Event()\n"
+        "        self.name = 'dog'\n"
+        "        threading.Thread(target=self._run).start()\n"
+        "    def _run(self):\n"
+        '        """Thread body: Event methods are internally '
+        'locked."""\n'
+        "        while not self._stop.wait(0.1):\n"
+        "            pass\n"
+        "    def stop(self):\n"
+        '        """Main side."""\n'
+        "        self._stop.set()\n"
+        "        self._stop.clear()\n")
+    assert _codes({"paddlefleetx_tpu/a.py": src}, {"PFX301"}) == []
+
+
+def test_helper_inherits_caller_locks_meet_over_callers():
+    src = RACE_MOD + (
+        "    def _run(self):\n"
+        '        """Thread body."""\n'
+        "        with self._lock:\n"
+        "            self._bump()\n"
+        "    def _bump(self):\n"
+        '        """Only ever called under the lock."""\n'
+        "        self.count += 1\n"
+        "    def read(self):\n"
+        '        """Main side."""\n'
+        "        with self._lock:\n"
+        "            return self.count\n")
+    assert _codes({"paddlefleetx_tpu/a.py": src}, {"PFX301"}) == []
+
+
+# -- PFX302 / PFX303 ---------------------------------------------------
+
+def test_pfx302_lock_order_inversion_fires():
+    src = MOD + (
+        "import threading\n"
+        "a = threading.Lock()\n"
+        "b = threading.Lock()\n"
+        "def one():\n"
+        '    """a -> b."""\n'
+        "    with a:\n"
+        "        with b:\n"
+        "            pass\n"
+        "def two():\n"
+        '    """b -> a."""\n'
+        "    with b:\n"
+        "        with a:\n"
+        "            pass\n")
+    findings = run_rules(_ctx({"paddlefleetx_tpu/a.py": src}),
+                         select={"PFX302"})
+    assert len(findings) == 1 and findings[0].key.startswith("order:")
+
+
+def test_pfx302_consistent_order_is_clean():
+    src = MOD + (
+        "import threading\n"
+        "a = threading.Lock()\n"
+        "b = threading.Lock()\n"
+        "def one():\n"
+        '    """a -> b."""\n'
+        "    with a:\n"
+        "        with b:\n"
+        "            pass\n"
+        "def two():\n"
+        '    """Also a -> b."""\n'
+        "    with a:\n"
+        "        with b:\n"
+        "            pass\n")
+    assert _codes({"paddlefleetx_tpu/a.py": src}, {"PFX302"}) == []
+
+
+def test_pfx303_blocking_call_under_lock_fires():
+    src = MOD + (
+        "import queue\n"
+        "import threading\n"
+        "_q = queue.Queue()\n"
+        "_lock = threading.Lock()\n"
+        "def drain():\n"
+        '    """Blocks the lock on queue IO."""\n'
+        "    with _lock:\n"
+        "        return _q.get()\n")
+    assert _codes({"paddlefleetx_tpu/a.py": src},
+                  {"PFX303"}) == ["PFX303"]
+
+
+def test_pfx303_condition_wait_is_exempt():
+    src = MOD + (
+        "import threading\n"
+        "_cv = threading.Condition()\n"
+        "def waiter():\n"
+        '    """Condition.wait releases the lock — its whole '
+        'job."""\n'
+        "    with _cv:\n"
+        "        _cv.wait()\n")
+    assert _codes({"paddlefleetx_tpu/a.py": src}, {"PFX303"}) == []
+
+
+# -- real-tree gates for the new substrate -----------------------------
+
+THREAD_CODES = {"PFX104", "PFX105", "PFX301", "PFX302", "PFX303"}
+
+
+def test_real_tree_clean_under_new_rules():
+    res = run_lint(REPO, select=THREAD_CODES)
+    msgs = "\n".join(str(f) for f in res.findings)
+    assert res.findings == [], f"thread/dataflow findings:\n{msgs}"
+
+
+def test_tests_and_scripts_clean_under_portable_rules():
+    res = run_lint(REPO, paths=["tests", "scripts"],
+                   select={"PFX101", "PFX102", "PFX103"}
+                   | THREAD_CODES)
+    msgs = "\n".join(str(f) for f in res.findings)
+    assert res.findings == [], f"tests/scripts findings:\n{msgs}"
+
+
+def test_serving_health_lock_mutation_trips_gate():
+    """Deleting the lock guard around the health-snapshot write in
+    core/serving.py must fail the suite — the PFX301 mutation pin."""
+    srv = open(os.path.join(REPO, "paddlefleetx_tpu", "core",
+                            "serving.py"), encoding="utf-8").read()
+    obs = open(os.path.join(REPO, "paddlefleetx_tpu",
+                            "observability", "server.py"),
+               encoding="utf-8").read()
+    sources = {"paddlefleetx_tpu/core/serving.py": srv,
+               "paddlefleetx_tpu/observability/server.py": obs}
+    assert run_rules(_ctx(sources), select={"PFX301"}) == []
+    mutated = srv.replace("with self._health_lock:", "if True:")
+    assert mutated != srv, "serving.py lost its _health_lock guard?"
+    sources["paddlefleetx_tpu/core/serving.py"] = mutated
+    keys = {f.key for f in run_rules(_ctx(sources),
+                                     select={"PFX301"})}
+    assert any("_health_snapshot" in k for k in keys), keys
+
+
+def test_metrics_registry_lock_mutation_trips_gate():
+    """Same pin for the registry: dropping its lock re-races the
+    watchdog/HTTP readers against the main loop."""
+    met = open(os.path.join(REPO, "paddlefleetx_tpu",
+                            "observability", "metrics.py"),
+               encoding="utf-8").read()
+    obs = open(os.path.join(REPO, "paddlefleetx_tpu",
+                            "observability", "server.py"),
+               encoding="utf-8").read()
+    exp = open(os.path.join(REPO, "paddlefleetx_tpu",
+                            "observability", "export.py"),
+               encoding="utf-8").read()
+    res = open(os.path.join(REPO, "paddlefleetx_tpu", "core",
+                            "resilience.py"), encoding="utf-8").read()
+    sources = {"paddlefleetx_tpu/observability/metrics.py": met,
+               "paddlefleetx_tpu/observability/server.py": obs,
+               "paddlefleetx_tpu/observability/export.py": exp,
+               "paddlefleetx_tpu/core/resilience.py": res}
+    mutated = met.replace("with self._lock:", "if True:")
+    assert mutated != met
+    sources["paddlefleetx_tpu/observability/metrics.py"] = mutated
+    findings = run_rules(_ctx(sources), select={"PFX301"})
+    assert any("MetricsRegistry" in f.message for f in findings)
+
+
+# -- CLI: --format github and --stats suppression counts ---------------
+
+def test_cli_github_format_emits_error_annotations(tmp_path, capsys):
+    root = tmp_path
+    (root / "codestyle").mkdir()
+    (root / "bad.py").write_text(
+        '"""Fixture."""\n'
+        "import time\n"
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        '    """Traced."""\n'
+        "    return x * time.time()\n")
+    from codestyle.pfxlint.__main__ import main
+    rc = main(["--root", str(root), "--no-baseline",
+               "--select", "PFX102", "--format", "github"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "::error file=bad.py," in out
+    assert "title=PFX102::" in out
+    assert main(["--format", "nope"]) == 2
+
+
+def test_real_tree_suppression_counts_pinned():
+    """The only inline PFX301 suppression is the documented `enabled`
+    fast-path flag in observability/metrics.py; growth here means a
+    new unjustified disable crept in."""
+    res = run_lint(REPO)
+    counts = res.suppression_counts()
+    assert counts.get("PFX301") == 1, counts
+    # and every suppressed thread finding lives where documented
+    where = {f.path for f in res.suppressed if f.code == "PFX301"}
+    assert where == {"paddlefleetx_tpu/observability/metrics.py"}
+
+
+def test_cli_stats_prints_per_rule_suppressions(capsys):
+    from codestyle.pfxlint.__main__ import main
+    assert main(["--root", REPO, "--stats"]) == 0
+    err = capsys.readouterr().err
+    assert "pfxlint: suppressed[PFX301]=1" in err
