@@ -1138,9 +1138,20 @@ def bench_serving():
     KV-cache knobs ``PFX_BENCH_SERVING_PAGED`` / ``_PAGE_SIZE`` /
     ``_POOL_PAGES``, the speculative A/B knobs
     ``PFX_BENCH_SERVING_SPEC`` / ``_SPEC_TOKENS``, the int8-KV A/B
-    knob ``PFX_BENCH_SERVING_KV_DTYPE``, and the
-    device-resident-decode sweep knob
+    knob ``PFX_BENCH_SERVING_KV_DTYPE``, the hierarchical-cache A/B
+    knobs ``PFX_BENCH_SERVING_TIERED`` / ``_HOST_POOL_MB`` /
+    ``_TURNS``, and the device-resident-decode sweep knob
     ``PFX_BENCH_SERVING_LOOP_TICKS`` (below).
+
+    Tiered-cache A/B: unless ``PFX_BENCH_SERVING_TIERED=0`` (paged
+    mode only), a seeded multi-turn conversational trace — shared
+    system prompt, per-user growing histories, submitted one turn
+    per wave — whose KV footprint is a multiple of the HBM pool is
+    served tiered (``host_pool_bytes`` from ``_HOST_POOL_MB``, small
+    pool) and untiered (unlimited pool), emitting a ``_tiered``
+    record with prefix-hit rate, prefill chunks and TTFT p50/p99 for
+    both arms plus spill/rehydrate counts (docs/inference.md,
+    "Hierarchical KV cache").
 
     int8-KV A/B: with ``PFX_BENCH_SERVING_KV_DTYPE=int8`` (paged mode
     only) the same trace and slot count are ALSO served with
@@ -1298,6 +1309,124 @@ def bench_serving():
         }
         _log_success(t_rec)
         print(json.dumps(t_rec))
+
+    # Tiered-cache A/B (PFX_BENCH_SERVING_TIERED, default on in paged
+    # mode): a seeded multi-turn conversational trace — one shared
+    # system prompt, per-user histories that grow every turn — whose
+    # total KV footprint is a multiple of the HBM pool, served twice:
+    # tiered (host_pool_bytes spill tier, docs/inference.md
+    # "Hierarchical KV cache") on a deliberately small pool, and
+    # untiered on an unlimited pool as the reference. Turns are
+    # submitted as waves, so between turns every conversation's pages
+    # drop to refcount zero and the tiered arm spills them; the next
+    # turn's registry hit rehydrates instead of re-prefilling, which
+    # is the whole bet — the record carries prefix-hit rate, prefill
+    # chunks and TTFT p50/p99 for BOTH arms plus the spill/rehydrate
+    # counts. Emitted before the headline (pinned last-two contract).
+    tiered_on = bool(int(os.environ.get("PFX_BENCH_SERVING_TIERED",
+                                        "1")))
+    if tiered_on and paged:
+        host_mb = int(os.environ.get(
+            "PFX_BENCH_SERVING_HOST_POOL_MB", "64"))
+        turns = max(1, int(os.environ.get(
+            "PFX_BENCH_SERVING_TURNS", "3")))
+        if cfg.max_position_embeddings >= 512:
+            t_cfg, t_model, t_params = cfg, model, params
+        else:
+            # the smoke config's 1-page capacity can't hold a
+            # conversation — rebuild at 512 so histories span pages
+            t_cfg = dataclasses.replace(cfg,
+                                        max_position_embeddings=512)
+            t_model = GPTForPretraining(t_cfg)
+            t_params = jax.jit(t_model.init)(
+                {"params": jax.random.key(0)},
+                jnp.zeros((1, 8), jnp.int32))["params"]
+        t_dec = min(dec_len, 16)
+        n_users = max(2, n_requests // turns)
+        t_slots = max(2, min(num_slots, n_users))
+        crng = np.random.default_rng(seed)
+        system = crng.integers(
+            0, t_cfg.vocab_size - 2, page_size + 2).tolist()
+        hist = [list(system) for _ in range(n_users)]
+        waves = []
+        room = t_cfg.max_position_embeddings - t_dec - 8
+        for _ in range(turns):
+            wave = []
+            for u in range(n_users):
+                msg = crng.integers(
+                    0, t_cfg.vocab_size - 2,
+                    int(crng.integers(24, 49))).tolist()
+                if len(hist[u]) + len(msg) + 16 > room:
+                    hist[u] = list(system)  # context-window reset
+                hist[u] = hist[u] + msg
+                wave.append(list(hist[u]))
+                # seeded stand-in for the assistant reply the next
+                # turn's history would carry
+                hist[u] = hist[u] + crng.integers(
+                    0, t_cfg.vocab_size - 2, 16).tolist()
+            waves.append(wave)
+        footprint = sum(-(-(len(w[-1]) + t_dec) // page_size)
+                        for w in zip(*waves))
+        cap_pages_t = -(-t_cfg.max_position_embeddings // page_size)
+        tiered_pool = max(cap_pages_t + 1, footprint // 2)
+        t_gen = GenerationConfig(
+            max_dec_len=t_dec, decode_strategy="sampling", top_k=50,
+            top_p=0.75, eos_token_id=t_cfg.vocab_size - 1,
+            pad_token_id=t_cfg.vocab_size - 1)
+
+        def _serve_conv(pool, host_bytes):
+            srv = GenerationServer(
+                t_model, t_params, t_gen, num_slots=t_slots,
+                rng=jax.random.key(seed + 1), page_size=page_size,
+                pool_pages=pool, prefill_chunk_pages=1,
+                prefix_sharing=True,
+                **({"host_pool_bytes": host_bytes}
+                   if host_bytes else {}))
+            for wave in waves:
+                srv.run(wave)
+            s = srv.summary()
+            srv.close()
+            return s
+
+        def _hit_rate(s):
+            hits = s.get("prefix_hits", 0) + s.get("prompt_hits", 0)
+            return round(hits / max(hits + s.get("prefill_chunks", 0),
+                                    1), 3)
+
+        t_sum = _serve_conv(tiered_pool, host_mb << 20)
+        u_sum = _serve_conv(footprint + t_slots * cap_pages_t + 1,
+                            None)
+        t_time = t_sum.get("decode_time_sec", 0.0)
+        tier_rec = {
+            "metric": METRIC_BY_MODE["serving"] + "_tiered",
+            "value": round(t_sum["decode_tokens"] / t_time
+                           if t_time > 0 else 0.0, 1),
+            "unit": "tokens/s",
+            "vs_baseline": None,
+            "users": n_users,
+            "turns": turns,
+            "seed": seed,
+            "page_size": page_size,
+            "max_dec_len": t_dec,
+            "host_pool_mb": host_mb,
+            "hbm_pool_pages": tiered_pool,
+            "host_pages_cap": t_sum.get("host_pages_cap", 0),
+            "kv_footprint_pages": footprint,
+            "spills": t_sum.get("spills", 0),
+            "rehydrates": t_sum.get("rehydrates", 0),
+            "host_evictions": t_sum.get("host_evictions", 0),
+            "prefill_chunks": t_sum.get("prefill_chunks", 0),
+            "prefill_chunks_untiered": u_sum.get("prefill_chunks", 0),
+            "prefix_hit_rate": _hit_rate(t_sum),
+            "prefix_hit_rate_untiered": _hit_rate(u_sum),
+            "ttft_p50_ms": t_sum.get("ttft_p50_ms", 0.0),
+            "ttft_p99_ms": t_sum.get("ttft_p99_ms", 0.0),
+            "ttft_p50_ms_untiered": u_sum.get("ttft_p50_ms", 0.0),
+            "ttft_p99_ms_untiered": u_sum.get("ttft_p99_ms", 0.0),
+            "rehydrate_p99_ms": t_sum.get("rehydrate_p99_ms", 0.0),
+        }
+        _log_success(tier_rec)
+        print(json.dumps(tier_rec))
 
     # int8-KV A/B (PFX_BENCH_SERVING_KV_DTYPE=int8): the SAME trace
     # and slot count served from a page pool holding the SAME device

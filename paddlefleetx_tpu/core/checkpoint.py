@@ -33,6 +33,7 @@ import shutil
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from ..utils.log import logger
@@ -159,6 +160,84 @@ def verify_checkpoint(path: str) -> Optional[str]:
         if actual != digest:
             return f"content hash mismatch on {rel}"
     return None
+
+
+def save_prefix_store(path: str, store: Dict[str, Any]) -> str:
+    """Persist a serving prefix store
+    (``GenerationServer.export_prefix_store``) as a committed-last
+    directory: page bytes as one ``.npz``, registry structure as
+    JSON, then the :func:`write_manifest` rename commit — a torn
+    write leaves no manifest and :func:`load_prefix_store` refuses
+    it. Returns the manifest path."""
+    os.makedirs(path, exist_ok=True)
+    # overwrite-in-place safety: decommit any stale manifest FIRST so
+    # a crash mid-rewrite cannot leave a marker attesting to half-new
+    # bytes (same discipline as save_checkpoint)
+    stale = os.path.join(path, MANIFEST_NAME)
+    if os.path.exists(stale):
+        os.remove(stale)
+    arrays: Dict[str, Any] = {}
+    pages_index: Dict[str, int] = {}
+    for hpid, leaves in store.get("pages", {}).items():
+        pages_index[str(int(hpid))] = len(leaves)
+        for i, leaf in enumerate(leaves):
+            arrays[f"p{int(hpid)}_{i}"] = np.asarray(leaf)
+    prompts = []
+    for key, (pages, payload) in store.get("prompts", {}).items():
+        idx = None
+        if payload is not None:
+            idx = len([k for k in arrays if k.startswith("payload")])
+            arrays[f"payload{idx}"] = np.asarray(payload)
+        prompts.append([key, [int(p) for p in pages], idx])
+    np.savez(os.path.join(path, "host_pages.npz"), **arrays)
+    meta = {"kind": "prefix_store",
+            "page_size": int(store["page_size"]),
+            "kv_cache_dtype": store["kv_cache_dtype"],
+            "pages": pages_index,
+            "prefixes": [[k, int(p)]
+                         for k, p in store.get("prefixes", {}).items()],
+            "prompts": prompts}
+    with open(os.path.join(path, "prefix_store.json"), "w") as f:
+        json.dump(meta, f, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    return write_manifest(path, {"kind": "prefix_store",
+                                 "pages": len(pages_index)})
+
+
+def load_prefix_store(path: str, recorder=None
+                      ) -> Optional[Dict[str, Any]]:
+    """Load a :func:`save_prefix_store` directory back into the dict
+    shape ``GenerationServer.import_prefix_store`` consumes. Refuses
+    — returns None, the caller starts cold — when the directory was
+    never committed or fails verification: a warm start from torn KV
+    bytes would serve silently wrong attention."""
+    reason = verify_checkpoint(path)
+    if reason is not None:
+        logger.warning("prefix store at %s refused: %s", path, reason)
+        if recorder is not None:
+            recorder.emit("prefix_store_rejected", path=path,
+                          reason=reason)
+        return None
+    try:
+        with open(os.path.join(path, "prefix_store.json")) as f:
+            meta = json.load(f)
+        npz = np.load(os.path.join(path, "host_pages.npz"))
+    except (OSError, ValueError) as err:
+        logger.warning("prefix store at %s unreadable: %s", path, err)
+        return None
+    pages = {int(h): [npz[f"p{int(h)}_{i}"] for i in range(n)]
+             for h, n in meta.get("pages", {}).items()}
+    return {
+        "page_size": meta["page_size"],
+        "kv_cache_dtype": meta["kv_cache_dtype"],
+        "pages": pages,
+        "prefixes": {k: int(p) for k, p in meta.get("prefixes", [])},
+        "prompts": {k: (
+            [int(p) for p in pids],
+            npz[f"payload{idx}"] if idx is not None else None)
+            for k, pids, idx in meta.get("prompts", [])},
+    }
 
 
 def save_checkpoint(output_dir: str, epoch: int, step: int, state,

@@ -41,7 +41,12 @@ Three capabilities, one ``FleetRouter``:
   each partial to a peer via ``submit(resume_tokens=...,
   trace_id=..., nonce=...)``: committed tokens, the trace id AND the
   sampling nonce all survive, so the resumed stream is token-exact
-  and reads as one trace in events.jsonl.
+  and reads as one trace in events.jsonl.  Tiered replicas
+  (``host_pool_bytes``) additionally hand their hot prefix store to
+  the fresh server (``export_prefix_store`` → optional
+  checkpoint-manifest round trip under ``prefix_store_dir`` →
+  ``import_prefix_store``), so the restarted replica's first
+  registry hits rehydrate from host DRAM instead of re-prefilling.
 
 Determinism contract: the router assigns sampling nonces from its OWN
 counter in global submission order (consumed only on successful
@@ -55,6 +60,7 @@ decoding, under any routing interleaving, with or without failover
 
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -106,7 +112,8 @@ class FleetRouter:
                  num_replicas: int = 2, *,
                  prefill_replicas: int = 0,
                  events_path: Optional[str] = None,
-                 handoff: str = "device"):
+                 handoff: str = "device",
+                 prefix_store_dir: Optional[str] = None):
         if num_replicas < 1:
             raise ValueError(
                 f"num_replicas must be >= 1, got {num_replicas}")
@@ -121,6 +128,11 @@ class FleetRouter:
         self._factory = server_factory
         self._split = prefill_replicas > 0
         self._handoff = handoff
+        #: when set, restart_replica round-trips each tiered replica's
+        #: hot prefix store through a committed-last checkpoint dir
+        #: under this path so the restarted replica starts warm
+        #: (docs/fleet_serving.md); None keeps the store in-process
+        self._prefix_store_dir = prefix_store_dir
         # /healthz runs on the metrics server's request threads while
         # restart_replica swaps list entries on the main thread: the
         # swap and the handler's list copy serialize on this lock
@@ -496,17 +508,31 @@ class FleetRouter:
             comp = self._failover(gid, c)
             if comp is not None:
                 done.append(comp)
+        # warm-start handoff: lift the hot prefix store (host tier +
+        # registries) out of the dying server BEFORE close() frees it,
+        # optionally round-tripping through the checkpoint-manifest
+        # path so the bytes that reach the fresh replica are exactly
+        # the bytes a crash-restart would read from disk
+        store = rep.server.export_prefix_store()
+        if store is not None and self._prefix_store_dir is not None:
+            from .checkpoint import load_prefix_store, save_prefix_store
+            store_path = os.path.join(self._prefix_store_dir,
+                                      f"{rep.name}_prefix_store")
+            save_prefix_store(store_path, store)
+            store = load_prefix_store(store_path, recorder=self._recorder)
         rep.server.close()
         fresh = FleetReplica(
             name=rep.name, server=self._factory(rep.name),
             role=rep.role, restarts=rep.restarts + 1)
+        adopted = fresh.server.import_prefix_store(store)
         with self._health_lock:
             self.replicas[idx] = fresh
         self.inc("fleet/restarts")
         # the new server's start_from_env stole /healthz — take it back
         self._install_endpoint()
         self._emit("fleet_restart_end", replica=rep.name,
-                   finished=len(done), failovers=len(partials))
+                   finished=len(done), failovers=len(partials),
+                   warm_pages=adopted)
         return done
 
     def rolling_restart(self, max_ticks: int = 0) -> List[Completion]:

@@ -38,6 +38,19 @@ fixed-size pages reached through a slot->page table
   (``prefill_chunk_paged``), so admitting a long prompt never stalls
   tokens/s for running slots.
 
+Hierarchical KV cache (``host_pool_bytes``, docs/inference.md): a
+bounded pinned-host spill tier under the HBM pool. A registered
+prefix/prompt page's last reference is pinned instead of freed, and at
+the next step-entry yield point its KV is gathered on device and
+staged to host memory by a background writer thread while the
+registries keep pointing at it across the tier move
+(``PageAllocator.spill``); a later registry hit scatters the host copy
+back into a fresh HBM page (``serving/rehydrate``) instead of
+re-prefilling. Decode ticks never block on the swap, COW splits only
+ever touch HBM pages, and ``export_prefix_store`` /
+``import_prefix_store`` carry the tier across rolling restarts
+(``core/checkpoint.py`` manifest path + ``FleetRouter``).
+
 Speculative decoding (``GenerationConfig.spec_method``/``spec_tokens``):
 decode at small batch is latency-bound on the per-step collectives, so
 the tick instead drafts ``k`` tokens per slot from a host draft source
@@ -80,7 +93,9 @@ Telemetry (docs/observability.md): ``serving/slot_occupancy`` and
 ``serving/evicted`` / ``serving/preempted`` / ``serving/prefix_hits``
 / ``serving/cow_splits`` / ``serving/prefill_chunks`` /
 ``serving/decode_tokens`` counters (committed tokens, NOT ticks — with
-spec decode 1 tick != 1 token), the ``serving/spec_drafted`` /
+spec decode 1 tick != 1 token), the tiered ``serving/spill`` /
+``serving/rehydrate`` counters + ``serving/host_pages`` gauge +
+``serving/rehydrate_ms`` histogram, the ``serving/spec_drafted`` /
 ``serving/spec_accepted`` counters + ``serving/spec_accept_rate``
 gauge, the ``serving/device_ticks`` counter and per-reason
 ``serving/loop_exit/{finished,admission,budget,drain}`` counters of
@@ -109,6 +124,7 @@ reconstructs a request's whole life, submit through evict. With
 from __future__ import annotations
 
 import dataclasses as _dc
+import queue
 import signal
 import threading
 import time
@@ -134,7 +150,7 @@ from ..observability.spans import Tracer
 from ..utils.log import logger
 from .paging import (
     NULL_PAGE, PageAllocator, PagePoolExhausted, page_prefix_keys,
-    prompt_key,
+    pool_pages_for_bytes, prompt_key,
 )
 from .resilience import FaultInjector, StepWatchdog
 from .spec import make_draft_source
@@ -200,6 +216,7 @@ class GenerationServer:
                  pool_pages: Optional[int] = None,
                  prefill_chunk_pages: int = 2,
                  prefix_sharing: bool = True,
+                 host_pool_bytes: Optional[int] = None,
                  request_ttl_s: Optional[float] = None,
                  max_queue_depth: Optional[int] = None,
                  drain_on_sigterm: bool = False,
@@ -222,6 +239,7 @@ class GenerationServer:
         # T = 1 keeps the original one-tick step() path byte-for-byte
         self._loop_ticks = int(device_loop_ticks)
         self._roundtrips = 0
+        self._tiered = False
         model, params = _unrolled_twin(model, params)
         cfg = model.config
         # paged mode: explicit kwargs win, else the config's own
@@ -261,7 +279,42 @@ class GenerationServer:
                     f"max_position_embeddings "
                     f"{cfg.max_position_embeddings}")
             self._prefix_sharing = bool(prefix_sharing)
-            self._alloc = PageAllocator(cfg.kv_pool_pages, self._page)
+            # hierarchical KV cache (docs/inference.md): a bounded
+            # pinned-host spill tier sized dtype-aware from a BYTE
+            # budget, so int8 KV doubles its page capacity for free
+            host_pages = 0
+            if host_pool_bytes:
+                if not self._prefix_sharing:
+                    raise ValueError(
+                        "host_pool_bytes requires prefix_sharing: the "
+                        "spill tier holds only registry-reachable "
+                        "pages")
+                host_pages = pool_pages_for_bytes(
+                    int(host_pool_bytes), cfg.num_layers,
+                    cfg.num_attention_heads, cfg.head_dim, self._page,
+                    cfg.kv_cache_dtype)
+                if host_pages < 1:
+                    raise ValueError(
+                        f"host_pool_bytes ({host_pool_bytes}) smaller "
+                        f"than one KV page")
+            self._tiered = host_pages > 0
+            self._alloc = PageAllocator(cfg.kv_pool_pages, self._page,
+                                        host_pages=host_pages)
+            if self._tiered:
+                self._host_pool_bytes = int(host_pool_bytes)
+                # pages whose LAST reference is held back as a spill
+                # pin until the next yield-point drain (insertion
+                # order = spill order)
+                self._spill_pin: Dict[int, None] = {}
+                # host id -> device_get'd page tree; shared with the
+                # spill writer thread, every access under _spill_lock
+                self._host_data: Dict[int, object] = {}
+                self._spill_lock = threading.Lock()
+                self._spill_q: queue.Queue = queue.Queue()
+                self._spill_writer_thread = threading.Thread(
+                    target=self._spill_writer, name="kv-spill-writer",
+                    daemon=True)
+                self._spill_writer_thread.start()
             self._pt = np.full((num_slots, self._max_pages), NULL_PAGE,
                                np.int32)
             self._pt_dev = jnp.asarray(self._pt)
@@ -273,6 +326,10 @@ class GenerationServer:
             #: prompt_key -> imported page ids pinned by kv_import
             #: until kv_import_release (cross-server KV handoff)
             self._imports: Dict[str, List[int]] = {}
+        elif host_pool_bytes:
+            raise ValueError(
+                "host_pool_bytes requires paged mode (page_size/"
+                "pool_pages): the spill tier holds KV pages")
         compute_dtype = jnp.dtype(cfg.dtype)
         if compute_dtype != jnp.float32:
             # same one-time cast as generate(): halve the per-token
@@ -369,6 +426,8 @@ class GenerationServer:
                    paged=self.paged,
                    page_size=self._page if self.paged else 0,
                    pool_pages=cfg.kv_pool_pages if self.paged else 0,
+                   host_pages=self._alloc.host_pages
+                   if self.paged else 0,
                    spec=self.spec,
                    spec_tokens=self._spec_k if self.spec else 0,
                    loop_ticks=self._loop_ticks)
@@ -729,19 +788,34 @@ class GenerationServer:
                 if self._prefix_sharing else None
             if hit is not None:
                 pages, last = hit
+                n_host = sum(1 for p in pages if self._alloc.is_host(p))
+                if n_host and self._alloc.free_pages < n_host:
+                    # rehydration needs fresh HBM pages — block the
+                    # queue head until they free (same starvation rule
+                    # as the chunked path's owned-pages check)
+                    break
                 self._queue.popleft()
+                mapped = []
                 for pid in pages:
-                    self._alloc.retain(pid)
+                    if self._alloc.is_host(pid):
+                        # spilled page: scatter the host copy back
+                        # into a fresh HBM id (refcount 1 = this
+                        # request's reference)
+                        mapped.append(self._rehydrate(pid))
+                    else:
+                        self._alloc.retain(pid)
+                        mapped.append(pid)
                 self._pt[slot, :] = NULL_PAGE
-                self._pt[slot, :len(pages)] = pages
+                self._pt[slot, :len(mapped)] = mapped
                 self._pt_dirty = True
                 self._alloc.stats["prompt_hits"] += 1
                 metrics.inc("serving/prefix_hits")
-                self._place(req, slot, num_pages=len(pages))
+                self._place(req, slot, num_pages=len(mapped))
                 self._activate(slot, last)
                 self._emit("serving_admit", request=req["id"],
                            slot=slot, prompt_len=L, mode="prompt_hit",
-                           shared_pages=len(pages),
+                           shared_pages=len(mapped),
+                           rehydrated=n_host or None,
                            trace=self._trace_id(req))
                 continue
             shared_pids: List[int] = []
@@ -766,12 +840,20 @@ class GenerationServer:
             start = len(shared_pids) * self._page
             n_chunks = -(-(L - start) // self._chunk)
             total_pages = (start + n_chunks * self._chunk) // self._page
-            if self._alloc.free_pages < total_pages - len(shared_pids):
+            n_host = sum(1 for p in shared_pids
+                         if self._alloc.is_host(p))
+            # host-resident shared pages need fresh HBM ids on top of
+            # the owned pages the chunked tail allocates
+            if self._alloc.free_pages < \
+                    total_pages - len(shared_pids) + n_host:
                 break
             self._queue.popleft()
             self._pt[slot, :] = NULL_PAGE
             for j, pid in enumerate(shared_pids):
-                self._alloc.retain(pid)
+                if self._alloc.is_host(pid):
+                    pid = self._rehydrate(pid)
+                else:
+                    self._alloc.retain(pid)
                 self._pt[slot, j] = pid
             for j in range(len(shared_pids), total_pages):
                 self._pt[slot, j] = self._alloc.alloc()
@@ -785,6 +867,7 @@ class GenerationServer:
             self._emit("serving_admit", request=req["id"], slot=slot,
                        prompt_len=L, mode="chunked",
                        shared_pages=len(shared_pids), chunks=n_chunks,
+                       rehydrated=n_host or None,
                        trace=self._trace_id(req))
 
     def _prefill_pump(self) -> None:
@@ -824,7 +907,7 @@ class GenerationServer:
         used = -(-L // self._page)
         if used < req["num_pages"]:
             for j in range(used, req["num_pages"]):
-                self._alloc.release(int(self._pt[slot, j]))
+                self._release_page(int(self._pt[slot, j]))
                 self._pt[slot, j] = NULL_PAGE
             req["num_pages"] = used
             self._pt_dirty = True
@@ -845,10 +928,139 @@ class GenerationServer:
         for j in range(req.get("num_pages", 0)):
             pid = int(self._pt[slot, j])
             if pid != NULL_PAGE:
-                self._alloc.release(pid)
+                self._release_page(pid)
         self._pt[slot, :] = NULL_PAGE
         self._pt_dirty = True
         req["num_pages"] = 0
+
+    # -- hierarchical KV cache: HBM -> pinned-host spill tier ---------
+    #
+    # With host_pool_bytes set, a REGISTERED page's last reference is
+    # never dropped outright: _release_page keeps it as a spill pin,
+    # and _drain_spills — called only at the host yield point (step
+    # entry, between device launches) — gathers the page's KV on
+    # device, moves its registrations onto a host-tier id
+    # (PageAllocator.spill) and frees the HBM page. The blocking
+    # device->host copy happens on a background writer thread
+    # (_spill_writer), so decode ticks never wait on a spill. A later
+    # registry hit rehydrates: fresh HBM page, scatter the staged
+    # bytes, move the registrations back (promote) — the same
+    # export-pin -> gather -> remap -> scatter contract as the fleet
+    # KV handoff, pointed at this server's own host tier. COW safety
+    # is structural: host ids never appear in any page table, so a
+    # divergent write can only target an HBM page and the host copy is
+    # never mutated. Thread discipline mirrors _health_lock: the
+    # writer touches ONLY the spill queue and the _spill_lock-guarded
+    # _host_data dict; allocator, cache, and telemetry stay with the
+    # main loop.
+
+    def _spill_writer(self) -> None:
+        """Background spill writer: stage each gathered page tree to
+        host memory (``jax.device_get`` — the device sync the decode
+        tick must never pay) and publish it under the spill lock.
+        ``None`` is the shutdown sentinel (:meth:`close`)."""
+        while True:
+            item = self._spill_q.get()
+            if item is None:
+                self._spill_q.task_done()
+                return
+            hpid, data = item
+            host = jax.device_get(data)
+            with self._spill_lock:
+                self._host_data[hpid] = host
+            self._spill_q.task_done()
+
+    def _release_page(self, pid: int) -> None:
+        """Release one reference to a slot-mapped page. In tiered mode
+        a registered page's LAST reference becomes a spill pin instead
+        of freeing — the page stays whole until :meth:`_drain_spills`
+        moves it to the host tier at the next yield point."""
+        if self._tiered and pid not in self._spill_pin and \
+                self._alloc.refcount(pid) == 1 and \
+                self._alloc.page_registered(pid):
+            self._spill_pin[pid] = None
+            return
+        self._alloc.release(pid)
+        if self._tiered:
+            self._drop_evicted_host_data()
+
+    def _drop_evicted_host_data(self) -> None:
+        """Forget the staged bytes of host pages the allocator evicted
+        (LRU pressure, orphan sweep) — before their ids are reused."""
+        evicted = self._alloc.pop_host_evicted()
+        if evicted:
+            with self._spill_lock:
+                for hpid in evicted:
+                    self._host_data.pop(hpid, None)
+
+    def _drain_spills(self) -> None:
+        """Dispatch every pinned spill: per page, gather its KV on
+        device (async dispatch — the blocking copy runs on the writer
+        thread), move its registrations to a host id, free the HBM
+        page. Runs ONLY at the step-entry yield point, never between
+        decode ticks — the decode-never-blocks contract the event
+        timeline test pins (every ``serving_spill`` pairs with the
+        ``serving_yield`` that opened the drain)."""
+        if not self._tiered or not self._spill_pin:
+            return
+        self._emit("serving_yield", ticks=self._ticks,
+                   roundtrips=self._roundtrips,
+                   pending_spills=len(self._spill_pin))
+        while self._spill_pin:
+            pid = next(iter(self._spill_pin))   # FIFO: oldest pin first
+            del self._spill_pin[pid]
+            if self._alloc.refcount(pid) > 1:
+                # re-shared while pinned: drop the pin, stay in HBM
+                self._alloc.release(pid)
+                continue
+            data = gather_kv_pages(self._cache,
+                                   jnp.asarray([pid], jnp.int32))
+            hpid = self._alloc.spill(pid)
+            if hpid is None:
+                # registrations died while pinned (a co-member freed)
+                self._alloc.release(pid)
+                continue
+            self._drop_evicted_host_data()
+            self._spill_q.put((hpid, data))
+            metrics.inc("serving/spill")
+            self._emit("serving_spill", page=pid, host_page=hpid,
+                       ticks=self._ticks, roundtrips=self._roundtrips)
+        metrics.get_registry().set_gauge(
+            "serving/host_pages", self._alloc.host_pages_resident)
+
+    def _rehydrate(self, hpid: int) -> int:
+        """Bring one host-resident page back into HBM under a fresh
+        page id: pop the staged bytes (waiting out the writer if the
+        spill is still in flight — admission time only, never between
+        decode ticks), scatter them into a newly allocated page, and
+        move the registrations back. The fresh page's refcount-1
+        reference belongs to the admitting request. The caller checks
+        ``free_pages`` first, so the alloc always succeeds."""
+        t0 = time.time()
+        pid = self._alloc.alloc()
+        with self._spill_lock:
+            data = self._host_data.pop(hpid, None)
+        if data is None:
+            # gathered but not yet staged: wait for the writer to
+            # finish the queue (must NOT hold _spill_lock here — the
+            # writer needs it to publish)
+            self._spill_q.join()
+            with self._spill_lock:
+                data = self._host_data.pop(hpid, None)
+        if data is None:
+            raise RuntimeError(
+                f"host page {hpid} resident but its bytes are gone")
+        self._cache = scatter_kv_pages(
+            self._cache, data, jnp.asarray([pid], jnp.int32))
+        self._alloc.promote(hpid, pid)
+        metrics.inc("serving/rehydrate")
+        self._metrics.observe("serving/rehydrate_ms",
+                              (time.time() - t0) * 1000.0)
+        self._emit("serving_rehydrate", host_page=hpid, page=pid,
+                   ticks=self._ticks)
+        metrics.get_registry().set_gauge(
+            "serving/host_pages", self._alloc.host_pages_resident)
+        return pid
 
     def _alloc_or_preempt(self, needy_slot: int) -> int:
         """A free page, preempting the youngest OTHER occupied slot
@@ -857,6 +1069,16 @@ class GenerationServer:
         always grow to its maximum length, so this terminates."""
         pid = self._alloc.try_alloc()
         while pid is None:
+            if self._tiered and self._spill_pin:
+                # a pinned to-be-spilled page is idle KV: reclaiming
+                # it costs one lost spill, never a preemption (and
+                # keeps the pin set from deadlocking the pool)
+                held = next(iter(self._spill_pin))
+                del self._spill_pin[held]
+                self._alloc.release(held)
+                self._drop_evicted_host_data()
+                pid = self._alloc.try_alloc()
+                continue
             victims = [s for s, r in enumerate(self._slots)
                        if r is not None and s != needy_slot]
             if not victims:
@@ -934,7 +1156,7 @@ class GenerationServer:
                         self._cache = copy_kv_pages(
                             self._cache, jnp.asarray([pid], jnp.int32),
                             jnp.asarray([new], jnp.int32))
-                        self._alloc.release(pid)
+                        self._release_page(pid)
                         self._pt[slot, j] = new
                         self._pt_dirty = True
                         self._alloc.stats["cow_splits"] += 1
@@ -1068,9 +1290,11 @@ class GenerationServer:
         return list(pages), last
 
     def kv_export_release(self, pages: Sequence[int]) -> None:
-        """Drop the transfer references :meth:`kv_export` took."""
+        """Drop the transfer references :meth:`kv_export` took (in
+        tiered mode a registered page's last pin spills instead of
+        freeing, keeping the exported prefix warm)."""
         for pid in pages:
-            self._alloc.release(int(pid))
+            self._release_page(int(pid))
 
     def kv_page_data(self, pages: Sequence[int]):
         """Device-side gather of ``pages``' contents (KV plus int8
@@ -1121,7 +1345,108 @@ class GenerationServer:
         pids = self._imports.pop(
             prompt_key([int(t) for t in tokens]), None)
         for pid in pids or ():
-            self._alloc.release(pid)
+            self._release_page(pid)
+
+    # -- restart-persistent prefix store ------------------------------
+    #
+    # A drained tiered server's shareable KV is (by construction) all
+    # host-resident: every registered page released to its last
+    # reference spilled. export_prefix_store snapshots that tier —
+    # staged bytes + the registry entries that reach them — as a
+    # plain dict; core/checkpoint.py's save/load_prefix_store round it
+    # through a committed-last manifest directory, and
+    # FleetRouter.restart_replica hands it to the restarted replica's
+    # import_prefix_store so it serves its first request warm.
+
+    def export_prefix_store(self) -> Optional[dict]:
+        """Snapshot the host tier for a restart warm start: drain any
+        pending spill pins first (a just-drained server's shareable
+        pages are still pinned), wait out the writer, and return page
+        bytes (flat numpy leaf lists in cache tree order) plus the
+        host-resident registry entries. None on non-tiered servers."""
+        if not self.paged or not self._tiered:
+            return None
+        self._drain_spills()
+        self._spill_q.join()
+        prefixes, prompts = self._alloc.host_snapshot()
+        needed = set(prefixes.values())
+        for pages, _ in prompts.values():
+            needed.update(pages)
+        with self._spill_lock:
+            data = {h: self._host_data[h] for h in needed
+                    if h in self._host_data}
+        cfg = self.model.config
+        store = {
+            "page_size": self._page,
+            "kv_cache_dtype": cfg.kv_cache_dtype,
+            "pages": {h: jax.tree_util.tree_leaves(t)
+                      for h, t in data.items()},
+            "prefixes": {k: h for k, h in prefixes.items()
+                         if h in data},
+            "prompts": {k: (pages, payload)
+                        for k, (pages, payload) in prompts.items()
+                        if all(p in data for p in pages)},
+        }
+        self._emit("serving_prefix_store_export",
+                   pages=len(store["pages"]),
+                   prefixes=len(store["prefixes"]),
+                   prompts=len(store["prompts"]))
+        return store
+
+    def import_prefix_store(self, store: Optional[dict]) -> int:
+        """Adopt an exported prefix store on a fresh server (the
+        restart warm start): fill free host slots with the saved pages
+        and re-register their content keys, so the next admission of
+        a covered prompt rehydrates instead of re-prefilling. A
+        geometry mismatch (page size, KV dtype) imports nothing — the
+        bytes would be garbage. Returns the pages adopted."""
+        if not store or not self.paged or not self._tiered:
+            return 0
+        cfg = self.model.config
+        if store.get("page_size") != self._page or \
+                store.get("kv_cache_dtype") != cfg.kv_cache_dtype:
+            logger.warning(
+                "prefix store geometry mismatch (page %s dtype %s vs "
+                "page %d dtype %s): starting cold",
+                store.get("page_size"), store.get("kv_cache_dtype"),
+                self._page, cfg.kv_cache_dtype)
+            return 0
+        treedef = jax.tree_util.tree_structure(self._cache)
+        remap: Dict[int, int] = {}
+
+        def _adopt(old: int) -> Optional[int]:
+            if old in remap:
+                return remap[old]
+            leaves = store["pages"].get(old)
+            if leaves is None:
+                return None
+            hpid = self._alloc.host_import()
+            if hpid is None:   # tier full: import what fits, stop
+                return None
+            with self._spill_lock:
+                self._host_data[hpid] = jax.tree_util.tree_unflatten(
+                    treedef, leaves)
+            remap[old] = hpid
+            return hpid
+
+        for key, old in store.get("prefixes", {}).items():
+            hpid = _adopt(old)
+            if hpid is not None:
+                self._alloc.register_prefix(key, hpid)
+        for key, (pages, payload) in store.get("prompts", {}).items():
+            new_pages = [_adopt(p) for p in pages]
+            if all(p is not None for p in new_pages):
+                self._alloc.register_prompt(key, new_pages, payload)
+        # a page adopted for a prompt entry that then failed to fully
+        # remap may be unreachable — evict such orphans right away
+        self._alloc.sweep_host_orphans()
+        self._drop_evicted_host_data()
+        adopted = self._alloc.host_pages_resident
+        metrics.get_registry().set_gauge("serving/host_pages", adopted)
+        self._emit("serving_prefix_store_import", pages=adopted,
+                   prefixes=len(store.get("prefixes", {})),
+                   prompts=len(store.get("prompts", {})))
+        return adopted
 
     # -- the serving loop ---------------------------------------------
 
@@ -1143,6 +1468,9 @@ class GenerationServer:
         expired = self._expire_deadlines()
         if self._faults is not None:
             self._faults.fire("tick", self._ticks + 1)
+        # host yield point: between device launches is the ONLY place
+        # pinned spills move to the host tier (decode never blocks)
+        self._drain_spills()
         if not self._draining:
             self._admit()
         reg = metrics.get_registry()
@@ -1247,7 +1575,7 @@ class GenerationServer:
                     used = -(-req["cur_len"] // self._page)
                     if used < req["num_pages"]:
                         for j in range(used, req["num_pages"]):
-                            self._alloc.release(int(self._pt[slot, j]))
+                            self._release_page(int(self._pt[slot, j]))
                             self._pt[slot, j] = NULL_PAGE
                         req["num_pages"] = used
                         self._pt_dirty = True
@@ -1308,6 +1636,10 @@ class GenerationServer:
         if self.paged:
             if self._prefilling:
                 return True
+            if self._tiered and self._spill_pin:
+                # pinned spills drain at step entry — exit after one
+                # tick so the writer gets its work this round-trip
+                return True
             per_tick = (self._spec_k + 1) if self.spec else 1
             span = self._loop_ticks * per_tick
             cap = self.model.config.cache_capacity
@@ -1336,6 +1668,10 @@ class GenerationServer:
         expired = self._expire_deadlines()
         if self._faults is not None:
             self._faults.fire("tick", self._ticks + 1)
+        # host yield point (see step()): pinned spills drain here and
+        # nowhere else — a pending pin capped the previous launch at
+        # one tick via _loop_host_flag
+        self._drain_spills()
         if not self._draining:
             self._admit()
         reg = metrics.get_registry()
@@ -1480,7 +1816,7 @@ class GenerationServer:
                 used = -(-req["cur_len"] // self._page)
                 if used < req["num_pages"]:
                     for j in range(used, req["num_pages"]):
-                        self._alloc.release(int(self._pt[slot, j]))
+                        self._release_page(int(self._pt[slot, j]))
                         self._pt[slot, j] = NULL_PAGE
                     req["num_pages"] = used
                     self._pt_dirty = True
@@ -1550,10 +1886,15 @@ class GenerationServer:
         return out
 
     def close(self) -> None:
-        """Detach OS-level hooks: stop the watchdog thread and restore
-        a ``drain_on_sigterm`` handler. Idempotent."""
+        """Detach OS-level hooks: stop the watchdog and spill-writer
+        threads and restore a ``drain_on_sigterm`` handler.
+        Idempotent."""
         if self._watchdog is not None:
             self._watchdog.stop()
+        if self._tiered and self._spill_writer_thread is not None:
+            self._spill_q.put(None)
+            self._spill_writer_thread.join(timeout=10.0)
+            self._spill_writer_thread = None
         if self._sigterm_installed:
             signal.signal(signal.SIGTERM, self._prev_sigterm)
             self._sigterm_installed = False
@@ -1600,7 +1941,8 @@ class GenerationServer:
                                ("tpot", "serving/tpot_ms"),
                                ("tick", "serving/tick_ms"),
                                ("host_roundtrip",
-                                "serving/host_roundtrip_ms")):
+                                "serving/host_roundtrip_ms"),
+                               ("rehydrate", "serving/rehydrate_ms")):
             h = self._metrics.histogram(series)
             if h is not None and h.count:
                 s[f"{prefix}_p50_ms"] = round(h.percentile(50), 3)
@@ -1626,6 +1968,11 @@ class GenerationServer:
                 mcfg.num_layers, mcfg.num_attention_heads,
                 mcfg.head_dim, self._page, self._alloc.num_pages,
                 mcfg.kv_cache_dtype)
+            if self._tiered:
+                s["tiered"] = True
+                s["host_pool_bytes"] = self._host_pool_bytes
+                s["host_pages_cap"] = self._alloc.host_pages
+                s["host_pages"] = self._alloc.host_pages_resident
             s.update(self._alloc.stats)
         self._emit("serving_summary", **s)
         return s

@@ -1158,8 +1158,11 @@ def copy_kv_pages(cache, src: jax.Array, dst: jax.Array):
 def gather_kv_pages(cache, pids: jax.Array):
     """Pull physical pages ``pids`` (``[k]`` int32) out of every KV
     pool leaf — the export half of a cross-server KV handoff
-    (``core/fleet.py``). Non-pool leaves pass through untouched, so
-    the result has the cache's own tree structure and
+    (``core/fleet.py``) and of the hierarchical-cache spill path
+    (``core/serving.py`` issues this gather asynchronously at the
+    yield point; the writer thread ``device_get``\\ s the result into
+    the host tier). Non-pool leaves pass through untouched, so the
+    result has the cache's own tree structure and
     :func:`scatter_kv_pages` consumes it directly; int8 pools carry
     their fp32 ``cached_*_scale`` pages alongside automatically (the
     same four leaf names :func:`copy_kv_pages` copies)."""
@@ -1177,7 +1180,9 @@ def gather_kv_pages(cache, pids: jax.Array):
 @jax.jit
 def scatter_kv_pages(cache, page_data, pids: jax.Array):
     """Write gathered page contents into pages ``pids`` of THIS pool —
-    the import half of a cross-server KV handoff. ``page_data`` is a
+    the import half of a cross-server KV handoff, and the rehydrate
+    half of the hierarchical cache (host-tier numpy pages re-enter
+    HBM under fresh page ids). ``page_data`` is a
     :func:`gather_kv_pages` result: device arrays for a same-devices
     transfer, or host-staged numpy (``jax.device_get`` of the gather)
     when the two pools' meshes don't share devices. The destination's

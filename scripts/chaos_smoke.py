@@ -34,14 +34,21 @@ payload, not sidecar metadata (docs/quantization.md).
 
 With ``--fleet`` a serving leg drills the fleet's availability story
 (docs/fleet_serving.md) in-process: two paged interpret-mode
-GenerationServer replicas behind a FleetRouter serve a shared-prefix
-trace while EVERY replica is rolling-restarted mid-stream. Asserted:
-every completion is token-identical to the single-batch lockstep
-reference (zero dropped committed tokens), nothing was shed (the peer
-always had capacity), at least one request actually failed over, and
-events.jsonl ALONE reconstructs one trace id per request — with two
-``serving/request`` lifetimes bridged by a ``fleet/failover`` span
-for each failed-over stream. Run from the repo root:
+GenerationServer replicas — tiered, with a pinned-host spill pool and
+the router's ``prefix_store_dir`` round-tripping each dying replica's
+prefix store through disk — behind a FleetRouter serve a
+shared-prefix trace while EVERY replica is rolling-restarted
+mid-stream. Asserted: every completion is token-identical to the
+single-batch lockstep reference (zero dropped committed tokens),
+nothing was shed (the peer always had capacity), at least one request
+actually failed over, and events.jsonl ALONE reconstructs one trace
+id per request — with two ``serving/request`` lifetimes bridged by a
+``fleet/failover`` span for each failed-over stream. A second wave of
+the same prompts then proves the warm restart: the restarted replicas
+serve it with at least one ``serving_rehydrate``, and in the
+post-restart event stream the first rehydrate precedes the first
+``serving_prefill_chunk`` — host-DRAM hits beat re-prefill
+(docs/inference.md "Hierarchical KV cache"). Run from the repo root:
 
   python scripts/chaos_smoke.py [--workdir DIR] [--steps 12]
                                 [--kill-step 7] [--save-steps 4]
@@ -270,9 +277,11 @@ def ptq_leg(work, chaos_out, cfg_path):
 
 
 def fleet_leg(work):
-    """In-process fleet drill: rolling-restart a 2-replica fleet
-    mid-stream and prove zero token loss + trace continuity from the
-    event log alone."""
+    """In-process fleet drill: rolling-restart a 2-replica tiered
+    fleet mid-stream and prove zero token loss + trace continuity
+    from the event log alone, then a warm second wave that must
+    rehydrate from the restart-persisted prefix store before it
+    prefills anything."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.setdefault("PFX_PALLAS_INTERPRET", "1")
     sys.path.insert(0, REPO)
@@ -325,9 +334,13 @@ def fleet_leg(work):
                                 rng=jax.random.PRNGKey(7),
                                 page_size=128, pool_pages=17,
                                 prefill_chunk_pages=1,
+                                prefix_sharing=True,
+                                host_pool_bytes=4 << 20,
                                 events_path=events)
 
-    fleet = FleetRouter(factory, 2, events_path=events)
+    stores = os.path.join(work, "fleet_stores")
+    fleet = FleetRouter(factory, 2, events_path=events,
+                        prefix_store_dir=stores)
     gids = [fleet.submit(p) for p in prompts]
     done = {}
     for _ in range(3):                  # commit some tokens first
@@ -340,7 +353,6 @@ def fleet_leg(work):
         for c in fleet.step():
             done[c.request_id] = c
     summ = fleet.summary()
-    fleet.close()
 
     missing = [g for g in gids if g not in done]
     if missing:
@@ -383,11 +395,57 @@ def fleet_leg(work):
                  f"serving/request lifetimes, expected >= 2")
         if not bridges:
             fail(f"failed-over trace {tid} has no fleet/failover span")
+
+    # warm second wave: the restarted replicas carry the dying
+    # replicas' prefix stores (round-tripped through prefix_store_dir
+    # on disk), so resubmitting the SAME prompts must be served by
+    # rehydrating spilled prefix pages from host DRAM — and the first
+    # serving_rehydrate in the post-restart stream must land BEFORE
+    # the first serving_prefill_chunk (docs/fleet_serving.md
+    # "Warm starts").
+    for i in range(2):
+        if not os.path.exists(os.path.join(
+                stores, f"replica{i}_prefix_store",
+                "pfx_manifest.json")):
+            fail(f"replica{i} left no committed prefix store under "
+                 f"{stores}")
+    mark = sum(1 for _ in open(events))
+    gids2 = [fleet.submit(p) for p in prompts]
+    done2 = {}
+    while fleet.busy:
+        for c in fleet.step():
+            done2[c.request_id] = c
+    summ2 = fleet.summary()
+    fleet.close()
+    got2 = [done2[g].tokens for g in gids2 if g in done2]
+    if got2 != ref:
+        fail("warm wave diverged from the lockstep reference — the "
+             "imported prefix store corrupted decoding")
+    rehydrates = sum(r.get("rehydrates", 0)
+                     for r in summ2["per_replica"])
+    if rehydrates < 1:
+        fail("warm wave rehydrated nothing — the restarted replicas "
+             "started cold despite the persisted prefix store")
+    with open(events) as f:
+        warm_evs = [json.loads(line)
+                    for line in list(f)[mark:] if line.strip()]
+    kinds = [e["event"] for e in warm_evs
+             if e.get("event") in ("serving_rehydrate",
+                                   "serving_prefill_chunk")]
+    if "serving_rehydrate" not in kinds:
+        fail("no serving_rehydrate event in the warm wave")
+    if kinds.index("serving_rehydrate") != 0:
+        fail(f"warm wave prefilled before it rehydrated "
+             f"(event order {kinds[:4]}) — registry hits must be "
+             f"served from the host tier first")
+
     sys.stdout.write(
-        f"FLEET LEG OK: rolling restart of 2 replicas under load — "
-        f"{len(gids)} requests lockstep-exact, shed=0, "
+        f"FLEET LEG OK: rolling restart of 2 tiered replicas under "
+        f"load — {len(gids)} requests lockstep-exact, shed=0, "
         f"failovers={summ['failovers']}, per-request traces "
-        f"reconstruct from {os.path.basename(events)}\n")
+        f"reconstruct from {os.path.basename(events)}; warm wave "
+        f"re-served {len(gids2)} prompts with {rehydrates} "
+        f"rehydrates, first rehydrate ahead of any prefill chunk\n")
 
 
 def main():

@@ -589,8 +589,10 @@ def test_bench_serving_runs_offline(monkeypatch, capsys):
     decode-tokens/s headline, then the speculative A/B companion —
     with the pinned metric grammar (same record shapes the on-chip
     345M run emits). The sweep is trimmed to T=4 here for CI time;
-    the default knob value is ``1,4,16``."""
+    the default knob value is ``1,4,16``. The tiered-cache A/B is
+    pinned off here — its record grammar has its own pins below."""
     monkeypatch.setenv("PFX_BENCH_SERVING_LOOP_TICKS", "1,4")
+    monkeypatch.setenv("PFX_BENCH_SERVING_TIERED", "0")
     bench.bench_serving()
     lines = capsys.readouterr().out.strip().splitlines()
     recs = [json.loads(ln) for ln in lines if ln.startswith("{")]
@@ -645,6 +647,7 @@ def test_bench_serving_runs_offline(monkeypatch, capsys):
 def test_bench_serving_spec_knobs(monkeypatch, capsys):
     """PFX_BENCH_SERVING_SPEC=0 suppresses the A/B record entirely;
     _SPEC_TOKENS overrides the draft width and is echoed back."""
+    monkeypatch.setenv("PFX_BENCH_SERVING_TIERED", "0")
     monkeypatch.setenv("PFX_BENCH_SERVING_LOOP_TICKS", "1")
     monkeypatch.setenv("PFX_BENCH_SERVING_REQUESTS", "3")
     monkeypatch.setenv("PFX_BENCH_SERVING_MAX_PROMPT", "8")
@@ -669,6 +672,7 @@ def test_bench_serving_paged_knob_off(monkeypatch, capsys):
     per-slot cache and the record says so (page fields zeroed), so
     perf CI can A/B the two layouts on the identical trace."""
     monkeypatch.setenv("PFX_BENCH_SERVING_LOOP_TICKS", "1")
+    monkeypatch.setenv("PFX_BENCH_SERVING_TIERED", "0")
     monkeypatch.setenv("PFX_BENCH_SERVING_PAGED", "0")
     monkeypatch.setenv("PFX_BENCH_SERVING_SPEC", "0")
     monkeypatch.setenv("PFX_BENCH_SERVING_REQUESTS", "3")
@@ -686,6 +690,7 @@ def test_bench_serving_env_knobs_pin_trace(monkeypatch, capsys):
     """PFX_BENCH_SERVING_* knobs override the trace shape and are
     echoed back in the record (the perf-CI driver pins runs by these;
     mirrors the bench_moe PFX_BENCH_MOE_DISPATCH convention)."""
+    monkeypatch.setenv("PFX_BENCH_SERVING_TIERED", "0")
     monkeypatch.setenv("PFX_BENCH_SERVING_LOOP_TICKS", "1")
     monkeypatch.setenv("PFX_BENCH_SERVING_REQUESTS", "3")
     monkeypatch.setenv("PFX_BENCH_SERVING_SLOTS", "1")
@@ -771,6 +776,7 @@ def test_bench_serving_kv_dtype_ab_record(monkeypatch, capsys):
     headline and spec record keep their pinned last-two positions
     and their values' provenance (the knob must not perturb them)."""
     from paddlefleetx_tpu.core.paging import pool_bytes
+    monkeypatch.setenv("PFX_BENCH_SERVING_TIERED", "0")
     monkeypatch.setenv("PFX_BENCH_SERVING_LOOP_TICKS", "1")
     monkeypatch.setenv("PFX_BENCH_SERVING_REQUESTS", "3")
     monkeypatch.setenv("PFX_BENCH_SERVING_MAX_PROMPT", "8")
@@ -809,6 +815,7 @@ def test_bench_serving_kv_dtype_off_by_default_and_unpaged(
     """No knob -> no A/B record; knob + PAGED=0 -> also no record
     (the density story is the paged pool's — a contiguous cache has
     no byte-matched resize to report)."""
+    monkeypatch.setenv("PFX_BENCH_SERVING_TIERED", "0")
     monkeypatch.setenv("PFX_BENCH_SERVING_LOOP_TICKS", "1")
     monkeypatch.setenv("PFX_BENCH_SERVING_REQUESTS", "3")
     monkeypatch.setenv("PFX_BENCH_SERVING_MAX_PROMPT", "8")
@@ -825,6 +832,80 @@ def test_bench_serving_kv_dtype_off_by_default_and_unpaged(
     assert not any("_kv_int8" in ln for ln in lines)
     assert json.loads(lines[-1])["metric"] == \
         bench.METRIC_BY_MODE["serving"]
+
+
+def test_bench_serving_tiered_ab_record(monkeypatch, capsys):
+    """The tiered-cache A/B (on by default in paged mode) emits ONE
+    ``_tiered`` record ahead of the headline: a seeded multi-turn
+    conversational trace served from a small HBM pool + host spill
+    tier vs an unlimited untiered pool (docs/inference.md
+    "Hierarchical KV cache"). The record must prove the bet — spills
+    and rehydrates actually happened, and the tiered arm re-prefilled
+    strictly less than the untiered arm whose pool never evicts a
+    registry entry it could have kept."""
+    monkeypatch.setenv("PFX_BENCH_SERVING_LOOP_TICKS", "1")
+    monkeypatch.setenv("PFX_BENCH_SERVING_SPEC", "0")
+    bench.bench_serving()
+    lines = capsys.readouterr().out.strip().splitlines()
+    recs = [json.loads(ln) for ln in lines if ln.startswith("{")]
+    tier, rec = recs[-2], recs[-1]
+    # pinned positions: tiered record ahead of the headline
+    assert rec["metric"] == bench.METRIC_BY_MODE["serving"]
+    assert tier["metric"] == \
+        "gpt345m_serving_decode_tokens_per_sec_per_chip_tiered"
+    assert tier["value"] > 0 and tier["unit"] == "tokens/s"
+    # trace shape: default smoke knobs -> 6 requests over 3 turns
+    assert tier["users"] == 2 and tier["turns"] == 3
+    assert tier["seed"] == 0 and tier["page_size"] == 128
+    assert tier["host_pool_mb"] == 64          # the default budget
+    # the pool is deliberately smaller than the trace's KV footprint
+    # (otherwise nothing would ever spill) and the host tier is real
+    assert tier["hbm_pool_pages"] < tier["kv_footprint_pages"]
+    assert tier["host_pages_cap"] >= 1
+    # the bet, in numbers: between-turn idle pages spilled to host,
+    # the next turn's registry hits rehydrated instead of
+    # re-prefilling, so the tiered arm runs strictly fewer prefill
+    # chunks and a strictly better prefix-hit rate than untiered
+    assert tier["spills"] > 0
+    assert tier["rehydrates"] > 0
+    assert tier["prefill_chunks"] < tier["prefill_chunks_untiered"]
+    assert tier["prefix_hit_rate"] > tier["prefix_hit_rate_untiered"]
+    assert tier["host_evictions"] >= 0
+    # latency accounting rides for both arms
+    assert tier["ttft_p99_ms"] >= tier["ttft_p50_ms"] > 0
+    assert tier["ttft_p99_ms_untiered"] >= \
+        tier["ttft_p50_ms_untiered"] > 0
+    assert tier["rehydrate_p99_ms"] > 0
+
+
+def test_bench_serving_tiered_knobs(monkeypatch, capsys):
+    """PFX_BENCH_SERVING_TIERED=0 suppresses the A/B record, PAGED=0
+    suppresses it too (the spill tier is the paged allocator's), and
+    _HOST_POOL_MB / _TURNS reshape the trace and are echoed back."""
+    monkeypatch.setenv("PFX_BENCH_SERVING_LOOP_TICKS", "1")
+    monkeypatch.setenv("PFX_BENCH_SERVING_REQUESTS", "4")
+    monkeypatch.setenv("PFX_BENCH_SERVING_MAX_PROMPT", "8")
+    monkeypatch.setenv("PFX_BENCH_SERVING_DEC_LEN", "4")
+    monkeypatch.setenv("PFX_BENCH_SERVING_SPEC", "0")
+    monkeypatch.setenv("PFX_BENCH_SERVING_TIERED", "0")
+    bench.bench_serving()
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert not any("_tiered" in ln for ln in lines)
+    monkeypatch.setenv("PFX_BENCH_SERVING_TIERED", "1")
+    monkeypatch.setenv("PFX_BENCH_SERVING_PAGED", "0")
+    bench.bench_serving()
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert not any("_tiered" in ln for ln in lines)
+    monkeypatch.setenv("PFX_BENCH_SERVING_PAGED", "1")
+    monkeypatch.setenv("PFX_BENCH_SERVING_HOST_POOL_MB", "7")
+    monkeypatch.setenv("PFX_BENCH_SERVING_TURNS", "2")
+    bench.bench_serving()
+    lines = capsys.readouterr().out.strip().splitlines()
+    tier = next(json.loads(ln) for ln in lines
+                if "_tiered" in ln and ln.startswith("{"))
+    assert tier["host_pool_mb"] == 7
+    assert tier["turns"] == 2 and tier["users"] == 2
+    assert tier["spills"] > 0
 
 
 # -- observability wiring (flight recorder, probe stderr tails) --------

@@ -575,3 +575,60 @@ def test_fleet_constructor_validation(model_and_params):
     assert [r.role for r in fleet.replicas] == ["prefill", "decode"]
     assert isinstance(fleet.replicas[0], FleetReplica)
     fleet.close()
+
+
+# -- tiered replicas: warm rolling restarts ----------------------------
+
+
+def test_rolling_restart_warm_prefix_store(paged512_model_and_params,
+                                           tmp_path):
+    """A rolling restart of tiered replicas hands each one's hot
+    prefix store to its replacement through the checkpoint-manifest
+    round trip (docs/fleet_serving.md, "Warm starts"): the second
+    wave of conversations — resubmitted after EVERY replica was
+    swapped — is served token-identically to an untiered unlimited
+    fleet, with the restarted replicas rehydrating instead of
+    re-prefilling."""
+    model, params = paged512_model_and_params
+    gen_cfg = _greedy_cfg(max_dec=6)
+    rng = np.random.default_rng(11)
+    system = rng.integers(0, EOS, 130).tolist()
+    prompts = [system + rng.integers(0, EOS, 7 + i).tolist()
+               for i in range(3)]
+
+    def run_fleet(factory, store_dir):
+        fleet = FleetRouter(factory, 2, prefix_store_dir=store_dir)
+        done = {}
+        for p in prompts:
+            done[fleet.submit(p)] = None
+        _drain_fleet(fleet, done)
+        fleet.rolling_restart()
+        for p in prompts:
+            done[fleet.submit(p)] = None
+        _drain_fleet(fleet, done)
+        reps = [(r.restarts, r.server.summary())
+                for r in fleet.replicas]
+        toks = [done[i].tokens for i in sorted(done)]
+        fleet.close()
+        return toks, reps
+
+    tiered_kw = dict(page_size=128, pool_pages=5,
+                     prefill_chunk_pages=1, prefix_sharing=True,
+                     host_pool_bytes=1 << 20)
+    t_toks, t_reps = run_fleet(
+        _mixed_factory(model, params, gen_cfg, **tiered_kw),
+        str(tmp_path))
+    u_toks, _ = run_fleet(
+        _mixed_factory(model, params, gen_cfg, page_size=128,
+                       pool_pages=64, prefill_chunk_pages=1,
+                       prefix_sharing=True), None)
+    assert t_toks == u_toks
+    # every replica was swapped, the store round-tripped through disk
+    # (committed-last manifest), and the fresh servers served wave 2
+    # from rehydration
+    assert all(restarts == 1 for restarts, _ in t_reps)
+    assert all((tmp_path / f"replica{i}_prefix_store" /
+                "pfx_manifest.json").exists() for i in range(2))
+    assert sum(s["rehydrates"] for _, s in t_reps) > 0
+    assert all(s["prefill_chunks"] == 0 for _, s in t_reps
+               if s["rehydrates"] > 0)

@@ -239,6 +239,159 @@ def test_import_is_idempotent_under_registry_collision():
     dst.check()
 
 
+# -- host tier: spill / promote / LRU / snapshot -----------------------
+#
+# The hierarchical cache (docs/inference.md) moves a registered page's
+# REGISTRATIONS to a host page id at refcount zero instead of dropping
+# them; a later registry hit promotes them back onto a fresh device id.
+# Host ids live in ``num_pages .. num_pages + host_pages - 1`` and are
+# never mapped by a page table, so COW safety is structural.
+
+
+def test_spill_moves_registrations_and_frees_device_page():
+    a = PageAllocator(num_pages=4, page_size=2, host_pages=2)
+    p = a.alloc()
+    a.register_prefix("k", p)
+    a.register_prompt("P", [p], payload="row")
+    hpid = a.spill(p)
+    assert hpid is not None and hpid >= 4 and a.is_host(hpid)
+    assert a.refcount(p) == 0 and a.free_pages == 3  # device page freed
+    assert a.lookup_prefix("k") == hpid
+    assert a.lookup_prompt("P") == ((hpid,), "row")
+    assert a.page_registered(hpid) and a.host_pages_resident == 1
+    assert a.stats["spills"] == 1
+    a.check()
+
+
+def test_spill_rejects_bad_refcounts_and_unregistered_pages():
+    a = PageAllocator(num_pages=4, page_size=2, host_pages=2)
+    p = a.alloc()
+    a.retain(p)
+    with pytest.raises(ValueError):
+        a.spill(p)  # refcount 2: someone still maps it
+    a.release(p)
+    # unregistered page: nothing to keep warm — caller must release()
+    assert a.spill(p) is None
+    assert a.refcount(p) == 1  # NOT freed by the refusal
+    a.release(p)
+    # no tier configured: spill is always a refusal
+    b = PageAllocator(num_pages=4, page_size=2)
+    q = b.alloc()
+    b.register_prefix("k", q)
+    assert b.spill(q) is None
+    a.check()
+    b.check()
+
+
+def test_promote_restores_device_residency():
+    a = PageAllocator(num_pages=4, page_size=2, host_pages=2)
+    p = a.alloc()
+    a.register_prefix("k", p)
+    hpid = a.spill(p)
+    fresh = a.alloc()  # the admitting request's page
+    a.promote(hpid, fresh)
+    assert a.lookup_prefix("k") == fresh
+    assert a.host_pages_resident == 0 and not a.is_host(hpid)
+    assert a.refcount(fresh) == 1  # the admitter's reference
+    assert a.stats["rehydrates"] == 1
+    a.check()
+    with pytest.raises(ValueError):
+        a.promote(hpid, fresh)  # hpid no longer resident
+
+
+def test_host_tier_lru_eviction_drops_oldest_registrations():
+    a = PageAllocator(num_pages=6, page_size=2, host_pages=2)
+    pids = [a.alloc() for _ in range(3)]
+    for i, p in enumerate(pids):
+        a.register_prefix(f"k{i}", p)
+    h0 = a.spill(pids[0])
+    a.spill(pids[1])
+    a.spill(pids[2])  # tier full: h0 (oldest) is evicted to make room
+    assert a.lookup_prefix("k0") is None
+    assert a.lookup_prefix("k1") is not None
+    assert a.lookup_prefix("k2") is not None
+    assert a.pop_host_evicted() == [h0]
+    assert a.pop_host_evicted() == []  # return-and-clear
+    assert a.stats["host_evictions"] == 1
+    a.check()
+
+
+def test_prompt_entry_spanning_tiers_cascades_on_member_death():
+    # a prompt entry with one hosted and one live member: the live
+    # member dying invalidates the page list, and the hosted member —
+    # now carrying no registration — must be evicted from the tier,
+    # not leak in it
+    a = PageAllocator(num_pages=6, page_size=2, host_pages=2)
+    p1, p2 = a.alloc(), a.alloc()
+    a.register_prompt("P", [p1, p2], payload=None)
+    a.register_prefix("k", p1)  # keeps p1 spillable on its own
+    h1 = a.spill(p1)
+    assert a.lookup_prompt("P") == ((h1, p2), None)
+    a.release(p2)
+    assert a.lookup_prompt("P") is None
+    assert a.host_pages_resident == 1  # h1 lives on via its prefix key
+    # now kill the prefix entry's only registration via a live page
+    fresh = a.alloc()
+    a.promote(h1, fresh)
+    a.release(fresh)
+    assert a.host_pages_resident == 0 and a.lookup_prefix("k") is None
+    a.check()
+
+
+def test_host_snapshot_and_import_roundtrip():
+    a = PageAllocator(num_pages=4, page_size=2, host_pages=3)
+    p1, p2 = a.alloc(), a.alloc()
+    a.register_prefix("k", p1)
+    a.register_prompt("P", [p1, p2], payload="row")
+    h1 = a.spill(p1)
+    h2 = a.spill(p2)
+    prefixes, prompts = a.host_snapshot()
+    assert prefixes == {"k": h1}
+    assert prompts == {"P": ([h1, h2], "row")}
+    a.check()
+    # a fresh allocator (the restarted replica) adopts the snapshot
+    b = PageAllocator(num_pages=4, page_size=2, host_pages=2)
+    nh1, nh2 = b.host_import(), b.host_import()
+    assert nh1 is not None and nh2 is not None
+    assert b.host_import() is None  # full: import never evicts
+    b.register_prefix("k", nh1)
+    b.register_prompt("P", [nh1, nh2], payload="row")
+    assert b.lookup_prefix("k") == nh1
+    assert b.host_pages_resident == 2
+    b.check()
+    # orphan sweep: an imported page that ended up unregistered goes
+    c = PageAllocator(num_pages=4, page_size=2, host_pages=2)
+    orphan = c.host_import()
+    assert orphan is not None
+    c.sweep_host_orphans()
+    assert c.host_pages_resident == 0
+    assert c.pop_host_evicted() == [orphan]
+    c.check()
+
+
+def test_check_catches_cross_tier_corruption():
+    a = PageAllocator(num_pages=4, page_size=2, host_pages=2)
+    p = a.alloc()
+    a.register_prefix("k", p)
+    hpid = a.spill(p)
+    # no pid may be simultaneously free and host-resident
+    a._free.append(hpid)
+    with pytest.raises(AssertionError):
+        a.check()
+    a._free.remove(hpid)
+    a.check()
+    # ...nor live (refcounted) and host-resident
+    a._ref[hpid] = 1
+    with pytest.raises(AssertionError):
+        a.check()
+    del a._ref[hpid]
+    a.check()
+    # a hosted page carrying no registration is a leak
+    a._page_prefix_keys.pop(hpid)
+    with pytest.raises(AssertionError):
+        a.check()
+
+
 # -- randomized state-machine trace ------------------------------------
 
 
@@ -330,3 +483,131 @@ def test_randomized_admit_evict_preempt_trace():
     a.check()
     assert a.pages_in_use == 0 and a.free_pages == 16
     assert a.stats["allocs"] == a.stats["frees"]
+
+
+def test_randomized_tiered_trace_spill_rehydrate_cow():
+    """The same transition mix over a TWO-tier allocator: evictions of
+    registered last-ref pages spill instead of freeing (what
+    ``core/serving.py::_drain_spills`` does), registry hits that land
+    on host ids rehydrate through ``try_alloc`` + ``promote`` (what
+    ``_rehydrate`` does), and COW stays device-only structurally —
+    the ledger never references a host id. ``check()``'s cross-tier
+    invariant runs after every step; the final drain proves neither
+    tier leaks."""
+    rng = np.random.default_rng(7)
+    page = 4
+    a = PageAllocator(num_pages=13, page_size=page, host_pages=4)
+    live = {}
+    next_id = 0
+    spills = rehydrates = 0
+    for step in range(3000):
+        op = rng.choice(["admit", "grow", "cow", "evict"])
+        if op == "admit":
+            base = rng.integers(0, 3)
+            L = int(rng.integers(1, 3 * page + 1))
+            toks = [int(base)] * L
+            hit = a.lookup_prompt(prompt_key(toks))
+            pages = []
+            ok = True
+            if hit is not None:
+                for pid in hit[0]:
+                    if a.is_host(pid):
+                        fresh = a.try_alloc()
+                        if fresh is None:
+                            ok = False
+                            break
+                        a.promote(pid, fresh)
+                        rehydrates += 1
+                        pages.append(fresh)
+                    else:
+                        a.retain(pid)
+                        pages.append(pid)
+                if not ok:  # pool full mid-rehydrate: roll back
+                    for pid in pages:
+                        a.release(pid)
+                    a.check()
+                    continue
+            else:
+                keys = page_prefix_keys(toks, page)[:(L - 1) // page]
+                owned_from = 0
+                for k in keys:
+                    pid = a.lookup_prefix(k)
+                    if pid is None:
+                        break
+                    if a.is_host(pid):
+                        fresh = a.try_alloc()
+                        if fresh is None:
+                            break
+                        a.promote(pid, fresh)
+                        rehydrates += 1
+                        pages.append(fresh)
+                    else:
+                        a.retain(pid)
+                        pages.append(pid)
+                    owned_from += 1
+                need = -(-L // page) - owned_from
+                got = []
+                for _ in range(need):
+                    pid = a.try_alloc()
+                    if pid is None:
+                        break
+                    got.append(pid)
+                if len(got) < need:
+                    for pid in got + pages:
+                        a.release(pid)
+                    a.check()
+                    continue
+                pages += got
+                for j, k in enumerate(keys):
+                    a.register_prefix(k, pages[j])
+                a.register_prompt(prompt_key(toks), pages, payload=L)
+            live[next_id] = pages
+            next_id += 1
+        elif op == "grow" and live:
+            rid = int(rng.choice(list(live)))
+            pid = a.try_alloc()
+            if pid is not None:
+                live[rid].append(pid)
+        elif op == "cow" and live:
+            rid = int(rng.choice(list(live)))
+            pages = live[rid]
+            j = int(rng.integers(0, len(pages)))
+            assert not a.is_host(pages[j])  # structural COW safety
+            if a.refcount(pages[j]) > 1:
+                new = a.try_alloc()
+                if new is not None:
+                    a.release(pages[j])
+                    pages[j] = new
+                    a.stats["cow_splits"] += 1
+        elif op == "evict" and live:
+            rid = int(rng.choice(list(live)))
+            for pid in live.pop(rid):
+                # the serving release path: last ref on a registered
+                # page tiers down (sometimes — admission pressure can
+                # also just release, e.g. _alloc_or_preempt reclaims)
+                if a.refcount(pid) == 1 and a.page_registered(pid) \
+                        and rng.random() < 0.7:
+                    if a.spill(pid) is not None:
+                        spills += 1
+                        continue
+                a.release(pid)
+        a.check()
+        refs = {}
+        for pages in live.values():
+            for pid in pages:
+                assert not a.is_host(pid)  # host ids never mapped
+                refs[pid] = refs.get(pid, 0) + 1
+        assert a.pages_in_use == len(refs)
+        for pid, n in refs.items():
+            assert a.refcount(pid) == n, (step, pid)
+    # the trace must actually have exercised the tier
+    assert spills > 100 and rehydrates > 10
+    assert a.stats["spills"] == spills
+    assert a.stats["rehydrates"] == rehydrates
+    # drain: device pool comes back whole; hosted pages all remain
+    # registered (check() proved that each step) and evict cleanly
+    for rid in list(live):
+        for pid in live.pop(rid):
+            a.release(pid)
+    a.check()
+    assert a.pages_in_use == 0 and a.free_pages == 12

@@ -901,6 +901,31 @@ def test_serving_health_lock_mutation_trips_gate():
     assert any("_health_snapshot" in k for k in keys), keys
 
 
+def test_serving_spill_lock_mutation_trips_gate():
+    """Same pin for the hierarchical KV cache: the spill writer
+    thread publishes staged host bytes into ``_host_data`` under
+    ``_spill_lock`` while the main loop pops them on rehydrate —
+    dropping the writer-side guard must re-race them (PFX301)."""
+    srv = open(os.path.join(REPO, "paddlefleetx_tpu", "core",
+                            "serving.py"), encoding="utf-8").read()
+    obs = open(os.path.join(REPO, "paddlefleetx_tpu",
+                            "observability", "server.py"),
+               encoding="utf-8").read()
+    sources = {"paddlefleetx_tpu/core/serving.py": srv,
+               "paddlefleetx_tpu/observability/server.py": obs}
+    guarded = ("            with self._spill_lock:\n"
+               "                self._host_data[hpid] = host\n")
+    assert guarded in srv, "spill writer lost its _spill_lock guard?"
+    mutated = srv.replace(
+        guarded,
+        "            if True:\n"
+        "                self._host_data[hpid] = host\n")
+    sources["paddlefleetx_tpu/core/serving.py"] = mutated
+    keys = {f.key for f in run_rules(_ctx(sources),
+                                     select={"PFX301"})}
+    assert any("_host_data" in k for k in keys), keys
+
+
 def test_metrics_registry_lock_mutation_trips_gate():
     """Same pin for the registry: dropping its lock re-races the
     watchdog/HTTP readers against the main loop."""

@@ -1840,3 +1840,237 @@ def test_paged_int8_kv_spec_serving_smoke_interpret_kernel(
     finally:
         metrics.set_enabled(False)
         reg.reset()
+
+
+# -- hierarchical KV cache (host spill tier) ---------------------------
+#
+# host_pool_bytes adds a bounded pinned-host tier under the paged pool
+# (docs/inference.md, "Hierarchical KV cache"): registered pages spill
+# HBM->host at refcount zero instead of dying, registry hits rehydrate
+# them into fresh page ids instead of re-prefilling, and the store
+# survives a restart through core/checkpoint.py. The acceptance bar:
+# on traces whose KV footprint exceeds the HBM pool, the tier must be
+# invisible in the tokens and visible in the prefill counters.
+
+ICFG512 = GPTConfig(**{**PCFG512.__dict__, "kv_cache_dtype": "int8"})
+
+
+@pytest.fixture(scope="module")
+def tiered_int8_model_and_params():
+    model = GPTForPretraining(ICFG512)
+    variables = model.init({"params": jax.random.key(0)},
+                           jnp.zeros((1, 8), jnp.int32))
+    return model, variables["params"]
+
+
+def _conv_trace(seed=11, users=3, turns=2, sys_len=130):
+    """Seeded multi-turn conversations: one shared system prompt, each
+    turn resubmitting a user's grown history — every turn's KV is a
+    chain-prefix of the next, the trace the spill tier exists for."""
+    rng = np.random.default_rng(seed)
+    system = rng.integers(0, EOS, sys_len).tolist()
+    hist = [list(system) for _ in range(users)]
+    waves = []
+    for _ in range(turns):
+        wave = []
+        for u in range(users):
+            hist[u] = hist[u] + rng.integers(
+                0, EOS, 12 + 7 * u).tolist()
+            wave.append(list(hist[u]))
+        waves.append(wave)
+    return waves
+
+
+def _serve_tiered_trace(model, params, gen_cfg, waves, **kw):
+    """Run the waves one at a time (between waves every conversation's
+    refcounts hit zero — the spill window) and return (tokens, summary)."""
+    srv = GenerationServer(model, params, gen_cfg, num_slots=2,
+                           rng=jax.random.key(5), page_size=128,
+                           prefill_chunk_pages=1, prefix_sharing=True,
+                           **kw)
+    out = [[c.tokens for c in srv.run(w)] for w in waves]
+    summ = srv.summary()
+    srv._alloc.check()
+    srv.close()
+    return out, summ
+
+
+@pytest.mark.parametrize("kv", ["bf16", "int8"])
+@pytest.mark.parametrize("spec", [False, True])
+@pytest.mark.parametrize("strategy", ["greedy", "sampling"])
+def test_tiered_parity_matrix(paged512_model_and_params,
+                              tiered_int8_model_and_params,
+                              strategy, spec, kv):
+    """The hierarchical-cache acceptance pin: on a multi-turn trace
+    whose KV footprint exceeds the tiered server's HBM pool (5 pages
+    against 10+ pages of conversations), tiered output is
+    token-identical to an untiered server with an unlimited pool —
+    greedy and sampled, bf16 and int8 KV, spec on and off — while
+    re-prefilling strictly fewer chunks (the rehydrate win)."""
+    model, params = (paged512_model_and_params if kv == "bf16"
+                     else tiered_int8_model_and_params)
+    if strategy == "greedy":
+        gen_cfg = _greedy_cfg(max_dec=4)
+    else:
+        gen_cfg = GenerationConfig(
+            max_dec_len=4, decode_strategy="sampling", top_k=8,
+            top_p=0.9, temperature=0.7, eos_token_id=EOS,
+            pad_token_id=PAD)
+    if spec:
+        gen_cfg = _spec_cfg(gen_cfg, 2)
+    waves = _conv_trace()
+    tiered, ts = _serve_tiered_trace(
+        model, params, gen_cfg, waves,
+        pool_pages=5, host_pool_bytes=1 << 20)
+    untiered, us = _serve_tiered_trace(
+        model, params, gen_cfg, waves, pool_pages=64)
+    assert tiered == untiered
+    assert ts["tiered"] is True and ts["spills"] > 0
+    assert ts["rehydrates"] > 0
+    assert ts["prefill_chunks"] < us["prefill_chunks"]
+
+
+def test_tiered_cow_divergent_write_splits_in_hbm(
+        paged512_model_and_params):
+    """COW across tiers: two requests admitting the SAME prompt off a
+    rehydrated page share it refcount-2; their divergent sampled
+    decode writes must split in HBM (cow_splits), never mutate the
+    host copy — proven by a third admission after everything spilled
+    again still matching the untiered server token-for-token."""
+    model, params = paged512_model_and_params
+    gen_cfg = GenerationConfig(
+        max_dec_len=4, decode_strategy="sampling", top_k=8,
+        top_p=0.9, temperature=0.7, eos_token_id=EOS, pad_token_id=PAD)
+    rng = np.random.default_rng(17)
+    prompt = rng.integers(0, EOS, 140).tolist()
+    waves = [[prompt], [list(prompt), list(prompt)], [list(prompt)]]
+    tiered, ts = _serve_tiered_trace(
+        model, params, gen_cfg, waves,
+        pool_pages=5, host_pool_bytes=1 << 20)
+    untiered, _ = _serve_tiered_trace(
+        model, params, gen_cfg, waves, pool_pages=64)
+    assert tiered == untiered
+    assert ts["rehydrates"] > 0
+    assert ts["cow_splits"] >= 1
+
+
+def test_tiered_spill_rehydrate_serving_smoke_interpret_kernel(
+        paged512_model_and_params, tmp_path):
+    """CI smoke (`-k smoke`), tiered edition: the spill->rehydrate
+    cycle on a deliberately tiny HBM pool under the interpret-mode
+    paged kernel, with the flight recorder proving spills drain ONLY
+    at the device-loop yield point (every `serving_spill` shares its
+    tick/round-trip stamp with a `serving_yield`)."""
+    _, params = paged512_model_and_params
+    kcfg = GPTConfig(**{**PCFG512.__dict__,
+                        "use_flash_attention": True})
+    model = GPTForPretraining(kcfg)
+    gen_cfg = _greedy_cfg(max_dec=4)
+    waves = _conv_trace(seed=9)
+    ref = _lockstep(model, params, [p for w in waves for p in w],
+                    gen_cfg)
+    events = tmp_path / "events.jsonl"
+    metrics.set_enabled(True)
+    reg = metrics.get_registry()
+    reg.reset()
+    try:
+        srv = GenerationServer(model, params, gen_cfg, num_slots=2,
+                               rng=jax.random.key(5), page_size=128,
+                               pool_pages=5, prefill_chunk_pages=1,
+                               prefix_sharing=True,
+                               host_pool_bytes=1 << 20,
+                               events_path=str(events))
+        toks = []
+        for w in waves:
+            toks.extend(c.tokens for c in srv.run(w))
+        assert toks == ref
+        assert reg.counter("attention/flash_decode_paged") >= 1
+        assert reg.counter("serving/spill") == \
+            srv._alloc.stats["spills"] > 0
+        assert reg.counter("serving/rehydrate") == \
+            srv._alloc.stats["rehydrates"] > 0
+        summ = srv.summary()
+        assert summ["tiered"] is True
+        assert summ["host_pages_cap"] >= 1
+        assert summ["rehydrate_p99_ms"] > 0
+        srv._alloc.check()
+        srv.close()
+        evs = [json.loads(l) for l in events.read_text().splitlines()]
+        start = [e for e in evs if e["event"] == "serving_start"]
+        assert start and start[0]["host_pages"] >= 1
+        spills = [e for e in evs if e["event"] == "serving_spill"]
+        yields = {(e["ticks"], e["roundtrips"]) for e in evs
+                  if e["event"] == "serving_yield"}
+        assert spills and yields
+        for e in spills:  # drained only at the yield point
+            assert (e["ticks"], e["roundtrips"]) in yields
+        assert any(e["event"] == "serving_rehydrate" for e in evs)
+        assert any(e.get("rehydrated") for e in evs
+                   if e["event"] == "serving_admit")
+    finally:
+        metrics.set_enabled(False)
+        reg.reset()
+
+
+def test_prefix_store_persistence_roundtrip(paged512_model_and_params,
+                                            tmp_path):
+    """export -> save (manifest-committed) -> load (verified) ->
+    import into a FRESH server: the adopter serves the same trace with
+    rehydrates instead of prefill chunks, token-identically; a corrupt
+    store is refused on load and the server just starts cold."""
+    from paddlefleetx_tpu.core.checkpoint import (
+        load_prefix_store, save_prefix_store,
+    )
+    model, params = paged512_model_and_params
+    gen_cfg = _greedy_cfg(max_dec=4)
+    waves = _conv_trace(seed=13)
+    kw = dict(num_slots=2, rng=jax.random.key(5), page_size=128,
+              pool_pages=5, prefill_chunk_pages=1, prefix_sharing=True,
+              host_pool_bytes=1 << 20)
+    srv1 = GenerationServer(model, params, gen_cfg, **kw)
+    ref = [[c.tokens for c in srv1.run(w)] for w in waves]
+    store = srv1.export_prefix_store()
+    s1 = srv1.summary()
+    srv1.close()
+    assert store and store["pages"] and store["page_size"] == 128
+    path = str(tmp_path / "store")
+    save_prefix_store(path, store)
+    loaded = load_prefix_store(path)
+    assert loaded is not None
+    srv2 = GenerationServer(model, params, gen_cfg, **kw)
+    adopted = srv2.import_prefix_store(loaded)
+    assert adopted > 0
+    warm = [[c.tokens for c in srv2.run(w)] for w in waves]
+    ws = srv2.summary()
+    srv2.close()
+    assert warm == ref
+    assert ws["rehydrates"] > 0
+    # the cold run's first wave prefilled everything; the warm run's
+    # first wave rehydrated the adopted store instead
+    assert ws["prefill_chunks"] < s1["prefill_chunks"]
+    # a flipped byte in the page store must fail verification closed
+    with open(os.path.join(path, "host_pages.npz"), "r+b") as f:
+        f.seek(64)
+        b = f.read(1)
+        f.seek(64)
+        f.write(bytes([b[0] ^ 0xFF]))
+    assert load_prefix_store(path) is None
+    srv3 = GenerationServer(model, params, gen_cfg, **kw)
+    assert srv3.import_prefix_store(load_prefix_store(path)) == 0
+    srv3.close()
+
+
+def test_tiered_requires_paged_prefix_sharing(model_and_params):
+    """host_pool_bytes without a paged pool (or without prefix
+    sharing — nothing registered means nothing can ever spill) is a
+    configuration error, not a silent no-op."""
+    model, params = model_and_params
+    gen_cfg = _greedy_cfg()
+    with pytest.raises(ValueError):
+        GenerationServer(model, params, gen_cfg, num_slots=2,
+                         host_pool_bytes=1 << 20)
+    with pytest.raises(ValueError):
+        GenerationServer(model, params, gen_cfg, num_slots=2,
+                         page_size=128, pool_pages=8,
+                         prefill_chunk_pages=1, prefix_sharing=False,
+                         host_pool_bytes=1 << 20)
