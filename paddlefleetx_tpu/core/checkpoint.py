@@ -193,6 +193,7 @@ def save_prefix_store(path: str, store: Dict[str, Any]) -> str:
     meta = {"kind": "prefix_store",
             "page_size": int(store["page_size"]),
             "kv_cache_dtype": store["kv_cache_dtype"],
+            "model_fingerprint": store.get("model_fingerprint"),
             "pages": pages_index,
             "prefixes": [[k, int(p)]
                          for k, p in store.get("prefixes", {}).items()],
@@ -222,21 +223,25 @@ def load_prefix_store(path: str, recorder=None
     try:
         with open(os.path.join(path, "prefix_store.json")) as f:
             meta = json.load(f)
-        npz = np.load(os.path.join(path, "host_pages.npz"))
+        # all arrays materialize eagerly inside the context so the
+        # NpzFile's descriptor closes here rather than at GC
+        with np.load(os.path.join(path, "host_pages.npz")) as npz:
+            pages = {int(h): [npz[f"p{int(h)}_{i}"] for i in range(n)]
+                     for h, n in meta.get("pages", {}).items()}
+            prompts = {k: (
+                [int(p) for p in pids],
+                npz[f"payload{idx}"] if idx is not None else None)
+                for k, pids, idx in meta.get("prompts", [])}
     except (OSError, ValueError) as err:
         logger.warning("prefix store at %s unreadable: %s", path, err)
         return None
-    pages = {int(h): [npz[f"p{int(h)}_{i}"] for i in range(n)]
-             for h, n in meta.get("pages", {}).items()}
     return {
         "page_size": meta["page_size"],
         "kv_cache_dtype": meta["kv_cache_dtype"],
+        "model_fingerprint": meta.get("model_fingerprint"),
         "pages": pages,
         "prefixes": {k: int(p) for k, p in meta.get("prefixes", [])},
-        "prompts": {k: (
-            [int(p) for p in pids],
-            npz[f"payload{idx}"] if idx is not None else None)
-            for k, pids, idx in meta.get("prompts", [])},
+        "prompts": prompts,
     }
 
 

@@ -350,6 +350,24 @@ class PageAllocator:
         self._hosted[hpid] = self._host_seq
         return hpid
 
+    def host_generation(self, hpid: int) -> Optional[int]:
+        """Monotone residency generation of a host id (its spill
+        sequence), or None when not resident. A recycled id gets a
+        NEW generation, so the byte-store owner can tell staged bytes
+        of an evicted earlier residency from the live one's — the ids
+        alone are ambiguous the moment the LRU recycles them."""
+        return self._hosted.get(hpid)
+
+    def evict_host(self, hpid: int) -> None:
+        """Evict one resident host page by id — the caller lost its
+        byte copy (e.g. the spill stage failed on the writer thread),
+        so the registrations pointing at it must die before a lookup
+        hands out a page that can never rehydrate. The id shows up in
+        :meth:`pop_host_evicted` like any other eviction; a
+        non-resident id is a no-op (it may already have been LRU'd)."""
+        if hpid in self._hosted:
+            self._evict_host(hpid)
+
     def pop_host_evicted(self) -> List[int]:
         """Host ids this allocator evicted (LRU pressure, orphan
         sweep) since the last call — returned once so the caller can

@@ -124,6 +124,8 @@ reconstructs a request's whole life, submit through evict. With
 from __future__ import annotations
 
 import dataclasses as _dc
+import hashlib
+import json
 import queue
 import signal
 import threading
@@ -161,6 +163,14 @@ class RequestShed(RuntimeError):
     server is draining, or an ``admit_fail`` fault fired. The caller
     should back off and retry elsewhere — everything already admitted
     is unaffected."""
+
+
+class _RehydrateMiss(Exception):
+    """A host page's staged bytes are gone because its spill stage
+    failed on the writer thread; the page has been evicted (reaped)
+    and admission must unwind whatever it already mapped and retry
+    the request — it re-prefills cold on the next pass. Internal to
+    the admission loop, never escapes :meth:`GenerationServer.step`."""
 
 
 def default_prefill_buckets(max_prompt_len: int) -> Tuple[int, ...]:
@@ -306,9 +316,17 @@ class GenerationServer:
                 # pin until the next yield-point drain (insertion
                 # order = spill order)
                 self._spill_pin: Dict[int, None] = {}
-                # host id -> device_get'd page tree; shared with the
-                # spill writer thread, every access under _spill_lock
-                self._host_data: Dict[int, object] = {}
+                # host id -> (residency generation, device_get'd page
+                # tree); shared with the spill writer thread, every
+                # access under _spill_lock. The generation tag keeps a
+                # recycled host id's stale bytes (an old spill still
+                # in the writer queue when the LRU evicted and reused
+                # the id) from ever rehydrating as the new page's KV.
+                self._host_data: Dict[int, Tuple[int, object]] = {}
+                # (hpid, gen) pairs whose device_get failed on the
+                # writer; the main loop evicts them at the next yield
+                # point (_reap_failed_spills). Under _spill_lock.
+                self._spill_failed: List[Tuple[int, int]] = []
                 self._spill_lock = threading.Lock()
                 self._spill_q: queue.Queue = queue.Queue()
                 self._spill_writer_thread = threading.Thread(
@@ -344,6 +362,7 @@ class GenerationServer:
                 return p.astype(compute_dtype)
             params = jax.tree_util.tree_map_with_path(_cast, params)
         self.model, self.params = model, params
+        self._model_fp: Optional[str] = None
         self.gen_cfg = gen_cfg
         self.num_slots = num_slots
         # speculative decoding: the host draft source proposes, the
@@ -796,15 +815,26 @@ class GenerationServer:
                     break
                 self._queue.popleft()
                 mapped = []
-                for pid in pages:
-                    if self._alloc.is_host(pid):
-                        # spilled page: scatter the host copy back
-                        # into a fresh HBM id (refcount 1 = this
-                        # request's reference)
-                        mapped.append(self._rehydrate(pid))
-                    else:
-                        self._alloc.retain(pid)
-                        mapped.append(pid)
+                try:
+                    for pid in pages:
+                        if self._alloc.is_host(pid):
+                            # spilled page: scatter the host copy back
+                            # into a fresh HBM id (refcount 1 = this
+                            # request's reference)
+                            mapped.append(self._rehydrate(pid))
+                        else:
+                            self._alloc.retain(pid)
+                            mapped.append(pid)
+                except _RehydrateMiss:
+                    # a failed spill surfaced mid-map: unwind the
+                    # references taken so far and retry this request
+                    # on the next pass — the reap dropped the dead
+                    # page's registrations, so it re-prefills cold
+                    for m in mapped:
+                        self._alloc.release(m)
+                    self._drop_evicted_host_data()
+                    self._queue.appendleft(req)
+                    continue
                 self._pt[slot, :] = NULL_PAGE
                 self._pt[slot, :len(mapped)] = mapped
                 self._pt_dirty = True
@@ -849,12 +879,25 @@ class GenerationServer:
                 break
             self._queue.popleft()
             self._pt[slot, :] = NULL_PAGE
-            for j, pid in enumerate(shared_pids):
-                if self._alloc.is_host(pid):
-                    pid = self._rehydrate(pid)
-                else:
-                    self._alloc.retain(pid)
-                self._pt[slot, j] = pid
+            mapped = []
+            try:
+                for j, pid in enumerate(shared_pids):
+                    if self._alloc.is_host(pid):
+                        pid = self._rehydrate(pid)
+                    else:
+                        self._alloc.retain(pid)
+                    mapped.append(pid)
+                    self._pt[slot, j] = pid
+            except _RehydrateMiss:
+                # same unwind as the prompt-hit path: the dead prefix
+                # page's registration is gone, so the retry shares
+                # fewer pages and prefills the rest
+                for m in mapped:
+                    self._alloc.release(m)
+                self._pt[slot, :] = NULL_PAGE
+                self._drop_evicted_host_data()
+                self._queue.appendleft(req)
+                continue
             for j in range(len(shared_pids), total_pages):
                 self._pt[slot, j] = self._alloc.alloc()
             self._pt_dirty = True
@@ -957,18 +1000,35 @@ class GenerationServer:
     def _spill_writer(self) -> None:
         """Background spill writer: stage each gathered page tree to
         host memory (``jax.device_get`` — the device sync the decode
-        tick must never pay) and publish it under the spill lock.
+        tick must never pay) and publish it, tagged with its host
+        id's residency generation, under the spill lock. ``task_done``
+        is called on EVERY path (try/finally): a writer that died
+        mid-item would strand every later ``_spill_q.join()`` —
+        rehydrate slow path, prefix-store export — in a silent
+        deadlock. A failed stage is recorded instead (the main loop
+        evicts that host page at the next yield point, so the loss
+        surfaces as a cold re-prefill, never a hang or wrong KV).
         ``None`` is the shutdown sentinel (:meth:`close`)."""
         while True:
             item = self._spill_q.get()
-            if item is None:
+            try:
+                if item is None:
+                    return
+                hpid, gen, data = item
+                try:
+                    host = jax.device_get(data)
+                except Exception:
+                    logger.exception(
+                        "kv-spill-writer: staging host page %d "
+                        "(gen %d) failed; its KV is lost and the "
+                        "page will be evicted", hpid, gen)
+                    with self._spill_lock:
+                        self._spill_failed.append((hpid, gen))
+                    continue
+                with self._spill_lock:
+                    self._host_data[hpid] = (gen, host)
+            finally:
                 self._spill_q.task_done()
-                return
-            hpid, data = item
-            host = jax.device_get(data)
-            with self._spill_lock:
-                self._host_data[hpid] = host
-            self._spill_q.task_done()
 
     def _release_page(self, pid: int) -> None:
         """Release one reference to a slot-mapped page. In tiered mode
@@ -986,12 +1046,53 @@ class GenerationServer:
 
     def _drop_evicted_host_data(self) -> None:
         """Forget the staged bytes of host pages the allocator evicted
-        (LRU pressure, orphan sweep) — before their ids are reused."""
+        (LRU pressure, orphan sweep, failed spill) — before their ids
+        are reused. Generation-checked: if an evicted id was already
+        recycled AND the writer already published the new residency's
+        bytes, those bytes are live and must survive this drain."""
         evicted = self._alloc.pop_host_evicted()
-        if evicted:
-            with self._spill_lock:
-                for hpid in evicted:
-                    self._host_data.pop(hpid, None)
+        if not evicted:
+            return
+        with self._spill_lock:
+            for hpid in evicted:
+                entry = self._host_data.get(hpid)
+                if entry is not None and \
+                        entry[0] != self._alloc.host_generation(hpid):
+                    del self._host_data[hpid]
+
+    def _reap_failed_spills(self) -> None:
+        """Evict host pages whose spill stage failed on the writer
+        thread (their bytes never reached host memory): drop the
+        registrations pointing at them so no lookup can hand out a
+        page that cannot rehydrate. Main loop only — the writer
+        records failures, it never touches the allocator."""
+        with self._spill_lock:
+            failed, self._spill_failed = self._spill_failed, []
+        for hpid, gen in failed:
+            # gen guard: the failed residency may already be gone and
+            # the id recycled — never evict the successor
+            if self._alloc.host_generation(hpid) == gen:
+                self._alloc.evict_host(hpid)
+                metrics.inc("serving/spill_failed")
+        if failed:
+            self._drop_evicted_host_data()
+
+    def _pop_host_bytes(self, hpid: int, gen: int):
+        """Pop the staged bytes of the CURRENT residency of ``hpid``,
+        or None when they are not published yet. An entry tagged with
+        an older generation is a recycled id's stale spill whose
+        publish raced the eviction drain — discard it (its residency
+        is dead) and report a miss; the writer queue is FIFO, so after
+        ``_spill_q.join()`` the live generation's bytes are the ones
+        in place."""
+        with self._spill_lock:
+            entry = self._host_data.get(hpid)
+            if entry is None:
+                return None
+            del self._host_data[hpid]
+            if entry[0] != gen:
+                return None
+            return entry[1]
 
     def _drain_spills(self) -> None:
         """Dispatch every pinned spill: per page, gather its KV on
@@ -1001,7 +1102,10 @@ class GenerationServer:
         decode ticks — the decode-never-blocks contract the event
         timeline test pins (every ``serving_spill`` pairs with the
         ``serving_yield`` that opened the drain)."""
-        if not self._tiered or not self._spill_pin:
+        if not self._tiered:
+            return
+        self._reap_failed_spills()
+        if not self._spill_pin:
             return
         self._emit("serving_yield", ticks=self._ticks,
                    roundtrips=self._roundtrips,
@@ -1017,11 +1121,16 @@ class GenerationServer:
                                    jnp.asarray([pid], jnp.int32))
             hpid = self._alloc.spill(pid)
             if hpid is None:
-                # registrations died while pinned (a co-member freed)
+                # registrations died while pinned (a co-member freed);
+                # the release can cascade host evictions of its own —
+                # drain them now, not at some later call, so staged
+                # bytes never outlive their residency
                 self._alloc.release(pid)
+                self._drop_evicted_host_data()
                 continue
+            gen = self._alloc.host_generation(hpid)
             self._drop_evicted_host_data()
-            self._spill_q.put((hpid, data))
+            self._spill_q.put((hpid, gen, data))
             metrics.inc("serving/spill")
             self._emit("serving_spill", page=pid, host_page=hpid,
                        ticks=self._ticks, roundtrips=self._roundtrips)
@@ -1038,18 +1147,29 @@ class GenerationServer:
         ``free_pages`` first, so the alloc always succeeds."""
         t0 = time.time()
         pid = self._alloc.alloc()
-        with self._spill_lock:
-            data = self._host_data.pop(hpid, None)
+        gen = self._alloc.host_generation(hpid)
+        data = self._pop_host_bytes(hpid, gen)
         if data is None:
-            # gathered but not yet staged: wait for the writer to
+            # gathered but not yet staged (or a dead residency's
+            # stale bytes were in the way): wait for the writer to
             # finish the queue (must NOT hold _spill_lock here — the
-            # writer needs it to publish)
+            # writer needs it to publish) and retry
             self._spill_q.join()
-            with self._spill_lock:
-                data = self._host_data.pop(hpid, None)
+            data = self._pop_host_bytes(hpid, gen)
         if data is None:
-            raise RuntimeError(
-                f"host page {hpid} resident but its bytes are gone")
+            # the one legitimate way here: the spill's device_get
+            # failed on the writer after this page was looked up but
+            # before the failure was reaped. Reap now (evicts hpid,
+            # drops its registrations) and let admission unwind — the
+            # prompt re-prefills cold. Anything else is an invariant
+            # bug and must fail loudly.
+            self._reap_failed_spills()
+            self._alloc.release(pid)
+            if self._alloc.is_host(hpid):
+                raise RuntimeError(
+                    f"host page {hpid} (gen {gen}) resident but its "
+                    f"bytes are gone")
+            raise _RehydrateMiss(hpid)
         self._cache = scatter_kv_pages(
             self._cache, data, jnp.asarray([pid], jnp.int32))
         self._alloc.promote(hpid, pid)
@@ -1358,6 +1478,34 @@ class GenerationServer:
     # FleetRouter.restart_replica hands it to the restarted replica's
     # import_prefix_store so it serves its first request warm.
 
+    def _model_fingerprint(self) -> str:
+        """Identity of the model this server serves: a digest over
+        the config plus every parameter leaf's path, shape, dtype and
+        fp32 sum — cheap (one scalar reduction per leaf, one host
+        transfer), deterministic, and different whenever the weights
+        are. Stamped into every exported prefix store and checked on
+        import, so KV persisted under one deploy can never warm-start
+        a model with different weights. Computed once and cached."""
+        if self._model_fp is None:
+            h = hashlib.sha256()
+            cfg = self.model.config
+            cfg_d = _dc.asdict(cfg) if _dc.is_dataclass(cfg) \
+                else vars(cfg)
+            h.update(json.dumps({k: str(v) for k, v in cfg_d.items()},
+                                sort_keys=True).encode())
+            leaves = jax.tree_util.tree_flatten_with_path(
+                self.params)[0]
+            sums = jax.device_get(
+                [jnp.sum(jnp.asarray(leaf, jnp.float32))
+                 for _, leaf in leaves])
+            for (path, leaf), s in zip(leaves, sums):
+                h.update(jax.tree_util.keystr(path).encode())
+                h.update(str((tuple(leaf.shape),
+                              str(leaf.dtype))).encode())
+                h.update(np.float32(s).tobytes())
+            self._model_fp = h.hexdigest()[:16]
+        return self._model_fp
+
     def export_prefix_store(self) -> Optional[dict]:
         """Snapshot the host tier for a restart warm start: drain any
         pending spill pins first (a just-drained server's shareable
@@ -1368,17 +1516,22 @@ class GenerationServer:
             return None
         self._drain_spills()
         self._spill_q.join()
+        # the join flushed every publish AND every failure record —
+        # reap now so dead pages drop out of the snapshot
+        self._reap_failed_spills()
         prefixes, prompts = self._alloc.host_snapshot()
         needed = set(prefixes.values())
         for pages, _ in prompts.values():
             needed.update(pages)
         with self._spill_lock:
-            data = {h: self._host_data[h] for h in needed
-                    if h in self._host_data}
+            data = {h: self._host_data[h][1] for h in needed
+                    if h in self._host_data and self._host_data[h][0]
+                    == self._alloc.host_generation(h)}
         cfg = self.model.config
         store = {
             "page_size": self._page,
             "kv_cache_dtype": cfg.kv_cache_dtype,
+            "model_fingerprint": self._model_fingerprint(),
             "pages": {h: jax.tree_util.tree_leaves(t)
                       for h, t in data.items()},
             "prefixes": {k: h for k, h in prefixes.items()
@@ -1399,7 +1552,11 @@ class GenerationServer:
         and re-register their content keys, so the next admission of
         a covered prompt rehydrates instead of re-prefilling. A
         geometry mismatch (page size, KV dtype) imports nothing — the
-        bytes would be garbage. Returns the pages adopted."""
+        bytes would be garbage — and so does a model-identity
+        mismatch: KV computed by DIFFERENT weights under identical
+        geometry scatters cleanly but serves silently wrong
+        attention, the one failure mode a disk round-trip across
+        deploys invites. Returns the pages adopted."""
         if not store or not self.paged or not self._tiered:
             return 0
         cfg = self.model.config
@@ -1410,6 +1567,13 @@ class GenerationServer:
                 "page %d dtype %s): starting cold",
                 store.get("page_size"), store.get("kv_cache_dtype"),
                 self._page, cfg.kv_cache_dtype)
+            return 0
+        fp = self._model_fingerprint()
+        if store.get("model_fingerprint") != fp:
+            logger.warning(
+                "prefix store model fingerprint mismatch (%s vs %s): "
+                "its KV was computed by different weights — starting "
+                "cold", store.get("model_fingerprint"), fp)
             return 0
         treedef = jax.tree_util.tree_structure(self._cache)
         remap: Dict[int, int] = {}
@@ -1423,9 +1587,10 @@ class GenerationServer:
             hpid = self._alloc.host_import()
             if hpid is None:   # tier full: import what fits, stop
                 return None
+            gen = self._alloc.host_generation(hpid)
             with self._spill_lock:
-                self._host_data[hpid] = jax.tree_util.tree_unflatten(
-                    treedef, leaves)
+                self._host_data[hpid] = (
+                    gen, jax.tree_util.tree_unflatten(treedef, leaves))
             remap[old] = hpid
             return hpid
 

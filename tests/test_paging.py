@@ -369,6 +369,31 @@ def test_host_snapshot_and_import_roundtrip():
     c.check()
 
 
+def test_host_generation_tags_residencies_and_evict_host():
+    # host ids are recycled by the LRU, so ids alone cannot name a
+    # residency: host_generation must differ across recycles (the
+    # byte-store owner's stale-spill guard), and evict_host must let
+    # the owner retire a residency whose bytes it lost (failed spill)
+    a = PageAllocator(num_pages=4, page_size=2, host_pages=1)
+    p = a.alloc()
+    a.register_prefix("k", p)
+    h = a.spill(p)
+    g1 = a.host_generation(h)
+    assert g1 is not None
+    a.evict_host(h)
+    assert a.host_generation(h) is None  # non-resident: no generation
+    assert a.lookup_prefix("k") is None  # registrations died with it
+    assert a.pop_host_evicted() == [h]
+    a.evict_host(h)  # already gone: a no-op, not an error
+    assert a.pop_host_evicted() == []
+    p2 = a.alloc()
+    a.register_prefix("k2", p2)
+    h2 = a.spill(p2)
+    assert h2 == h  # the id was recycled...
+    assert a.host_generation(h2) > g1  # ...under a NEW generation
+    a.check()
+
+
 def test_check_catches_cross_tier_corruption():
     a = PageAllocator(num_pages=4, page_size=2, host_pages=2)
     p = a.alloc()

@@ -913,13 +913,14 @@ def test_serving_spill_lock_mutation_trips_gate():
                encoding="utf-8").read()
     sources = {"paddlefleetx_tpu/core/serving.py": srv,
                "paddlefleetx_tpu/observability/server.py": obs}
-    guarded = ("            with self._spill_lock:\n"
-               "                self._host_data[hpid] = host\n")
+    guarded = ("                with self._spill_lock:\n"
+               "                    self._host_data[hpid] = "
+               "(gen, host)\n")
     assert guarded in srv, "spill writer lost its _spill_lock guard?"
     mutated = srv.replace(
         guarded,
-        "            if True:\n"
-        "                self._host_data[hpid] = host\n")
+        "                if True:\n"
+        "                    self._host_data[hpid] = (gen, host)\n")
     sources["paddlefleetx_tpu/core/serving.py"] = mutated
     keys = {f.key for f in run_rules(_ctx(sources),
                                      select={"PFX301"})}

@@ -13,6 +13,7 @@ import dataclasses
 import json
 import os
 import re
+import threading
 import urllib.error
 import urllib.request
 
@@ -2057,6 +2058,130 @@ def test_prefix_store_persistence_roundtrip(paged512_model_and_params,
     assert load_prefix_store(path) is None
     srv3 = GenerationServer(model, params, gen_cfg, **kw)
     assert srv3.import_prefix_store(load_prefix_store(path)) == 0
+    srv3.close()
+
+
+def test_tiered_stale_host_generation_never_rehydrated(
+        paged512_model_and_params):
+    """The recycled-host-id race, pinned at the mechanism level: when
+    the LRU evicts and reuses a host id whose previous spill is still
+    in the writer queue, the OLD residency's bytes may publish under
+    the reused id. Generation tags must keep them from ever serving a
+    rehydrate (`_pop_host_bytes`) and keep an eviction drain from
+    clobbering the NEW residency's bytes (`_drop_evicted_host_data`)."""
+    model, params = paged512_model_and_params
+    gen_cfg = _greedy_cfg(max_dec=4)
+    srv = GenerationServer(model, params, gen_cfg, num_slots=2,
+                           rng=jax.random.key(5), page_size=128,
+                           pool_pages=5, prefill_chunk_pages=1,
+                           prefix_sharing=True, host_pool_bytes=1 << 20)
+    for w in _conv_trace(seed=3, users=2, turns=1):
+        srv.run(w)
+    srv._drain_spills()
+    srv._spill_q.join()
+    assert srv._alloc.host_pages_resident > 0
+    hpid = next(iter(srv._alloc._hosted))
+    gen = srv._alloc.host_generation(hpid)
+    live = srv._pop_host_bytes(hpid, gen)
+    assert live is not None
+    # a dead residency's bytes: discarded on pop, never returned
+    with srv._spill_lock:
+        srv._host_data[hpid] = (gen - 1, "stale")
+    assert srv._pop_host_bytes(hpid, gen) is None
+    with srv._spill_lock:
+        assert hpid not in srv._host_data
+    # the live residency's bytes survive a drain of the id's EARLIER
+    # eviction (the recycled-id case)...
+    with srv._spill_lock:
+        srv._host_data[hpid] = (gen, live)
+    srv._alloc._host_evicted.append(hpid)
+    srv._drop_evicted_host_data()
+    with srv._spill_lock:
+        assert srv._host_data[hpid][0] == gen
+    # ...while a dead generation's bytes are dropped by the same drain
+    with srv._spill_lock:
+        srv._host_data[hpid] = (gen - 1, "stale")
+    srv._alloc._host_evicted.append(hpid)
+    srv._drop_evicted_host_data()
+    with srv._spill_lock:
+        assert hpid not in srv._host_data
+        srv._host_data[hpid] = (gen, live)   # restore for close()
+    srv._alloc.check()
+    srv.close()
+
+
+def test_tiered_spill_writer_failure_never_hangs_or_corrupts(
+        paged512_model_and_params, monkeypatch):
+    """Injected ``jax.device_get`` failure on the kv-spill-writer:
+    every spill stage dies, yet the server neither deadlocks on
+    ``_spill_q.join()`` (export still returns — task_done runs on
+    every path) nor serves wrong tokens — failed pages are reaped
+    (evicted, registrations dropped) and their prompts re-prefill
+    cold, token-identical to the untiered reference."""
+    model, params = paged512_model_and_params
+    gen_cfg = _greedy_cfg(max_dec=4)
+    waves = _conv_trace(seed=7)
+    untiered, _ = _serve_tiered_trace(model, params, gen_cfg, waves,
+                                      pool_pages=64)
+    real = jax.device_get
+
+    def boom(x):
+        if threading.current_thread().name == "kv-spill-writer":
+            raise RuntimeError("injected spill-stage failure")
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", boom)
+    srv = GenerationServer(model, params, gen_cfg, num_slots=2,
+                           rng=jax.random.key(5), page_size=128,
+                           pool_pages=5, prefill_chunk_pages=1,
+                           prefix_sharing=True, host_pool_bytes=1 << 20)
+    out = [[c.tokens for c in srv.run(w)] for w in waves]
+    assert out == untiered
+    assert srv._alloc.stats["spills"] > 0   # spills were attempted
+    store = srv.export_prefix_store()       # join() must return
+    assert store is not None and store["pages"] == {}
+    assert srv._alloc.host_pages_resident == 0  # every failure reaped
+    assert srv._alloc.stats["rehydrates"] == 0  # nothing fake served
+    assert srv._spill_writer_thread.is_alive()  # writer survived
+    srv._alloc.check()
+    srv.close()
+
+
+def test_prefix_store_import_refuses_model_fingerprint_mismatch(
+        paged512_model_and_params, tmp_path):
+    """KV persisted under one deploy's weights must never warm-start
+    different weights with the same geometry — the store carries a
+    model fingerprint, it survives the disk round trip, and import
+    refuses a mismatch (starting cold) while identical weights on a
+    fresh server still adopt."""
+    from paddlefleetx_tpu.core.checkpoint import (
+        load_prefix_store, save_prefix_store,
+    )
+    model, params = paged512_model_and_params
+    gen_cfg = _greedy_cfg(max_dec=4)
+    kw = dict(num_slots=2, rng=jax.random.key(5), page_size=128,
+              pool_pages=5, prefill_chunk_pages=1, prefix_sharing=True,
+              host_pool_bytes=1 << 20)
+    srv1 = GenerationServer(model, params, gen_cfg, **kw)
+    for w in _conv_trace(seed=13, users=2, turns=1):
+        srv1.run(w)
+    store = srv1.export_prefix_store()
+    srv1.close()
+    assert store["pages"] and store["model_fingerprint"]
+    path = str(tmp_path / "store")
+    save_prefix_store(path, store)
+    loaded = load_prefix_store(path)
+    assert loaded["model_fingerprint"] == store["model_fingerprint"]
+    # same config and geometry, DIFFERENT weights: refused
+    other = model.init({"params": jax.random.key(42)},
+                       jnp.zeros((1, 8), jnp.int32))["params"]
+    srv2 = GenerationServer(model, other, gen_cfg, **kw)
+    assert srv2.import_prefix_store(loaded) == 0
+    assert srv2._alloc.host_pages_resident == 0
+    srv2.close()
+    # identical weights on a fresh server: adopted as before
+    srv3 = GenerationServer(model, params, gen_cfg, **kw)
+    assert srv3.import_prefix_store(loaded) > 0
     srv3.close()
 
 
