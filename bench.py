@@ -1559,7 +1559,14 @@ def bench_fleet():
     (replicas tick sequentially on the same host/chips, so the
     aggregate divides summed tokens by SUMMED decode time — the
     honest same-chips number) plus the fleet-level
-    ``fleet_ttft_p99_ms`` percentile and the router counters."""
+    ``fleet_ttft_p99_ms`` percentile and the router counters.
+
+    Unless ``PFX_BENCH_FLEET_ASYNC=0``, a third record runs the SAME
+    trace through an ``async_workers=True`` router — the
+    async-vs-lockstep A/B: overlapped worker ticks divide by the
+    slowest replica's decode time instead of the sum, and the record
+    carries ``speedup_vs_lockstep`` plus the d2d/host handoff
+    counters and ``handoff_p99_ms``."""
     from paddlefleetx_tpu.core.fleet import FleetRouter
     from paddlefleetx_tpu.core.serving import GenerationServer
     from paddlefleetx_tpu.models.gpt.generation import GenerationConfig
@@ -1672,6 +1679,36 @@ def bench_fleet():
     _log_success(result)
     print(json.dumps(result))
     fleet.close()
+
+    # -- async A/B: overlapped worker ticks on the identical trace ----
+    if bool(int(os.environ.get("PFX_BENCH_FLEET_ASYNC", "1"))):
+        afleet = FleetRouter(lambda name: _mk(num_slots), replicas,
+                             prefill_replicas=1 if split else 0,
+                             async_workers=True)
+        async_tps, async_total = _measure(
+            lambda: afleet.run(prompts), afleet.summary)
+        async_rec = {
+            "metric": "gpt345m_fleet_2replica_async_decode"
+                      "_tokens_per_sec_per_chip",
+            "value": round(async_tps, 1),
+            **common,
+            "replicas": replicas,
+            "prefill_split": split,
+            "slots_per_replica": num_slots,
+            "async_workers": True,
+            "handoffs": async_total["handoffs"],
+            "handoff_d2d": async_total["handoff_d2d"],
+            "handoff_host": async_total["handoff_host"],
+            "handoff_p99_ms": async_total.get("handoff_p99_ms", 0.0),
+            "fleet_ttft_p99_ms": async_total.get("ttft_p99_ms", 0.0),
+            "shed": async_total["shed"],
+            "lockstep_tokens_per_sec": round(fleet_tps, 1),
+            "speedup_vs_lockstep": round(async_tps / fleet_tps, 3)
+            if fleet_tps > 0 else None,
+        }
+        _log_success(async_rec)
+        print(json.dumps(async_rec))
+        afleet.close()
 
 
 def bench_pipeline():

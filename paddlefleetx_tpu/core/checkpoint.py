@@ -30,6 +30,7 @@ import json
 import os
 import re
 import shutil
+import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -62,17 +63,23 @@ def _checkpointer() -> ocp.Checkpointer:
 
 _ASYNC_CKPTR: Optional[ocp.AsyncCheckpointer] = None
 
+#: guards the process-wide async-save state below — serving worker
+#: threads reach it through a replica's prefix-store export while the
+#: training loop saves; the blocking drain itself runs outside it
+_CKPT_STATE_LOCK = threading.Lock()
+
 
 def _async_checkpointer() -> ocp.AsyncCheckpointer:
     """Process-wide async checkpointer (holds the background write
     thread pool); drained at interpreter exit so a fast-exiting run
     cannot truncate its last checkpoint."""
     global _ASYNC_CKPTR
-    if _ASYNC_CKPTR is None:
-        _ASYNC_CKPTR = ocp.AsyncCheckpointer(
-            ocp.CompositeCheckpointHandler())
-        atexit.register(wait_for_pending_save)
-    return _ASYNC_CKPTR
+    with _CKPT_STATE_LOCK:
+        if _ASYNC_CKPTR is None:
+            _ASYNC_CKPTR = ocp.AsyncCheckpointer(
+                ocp.CompositeCheckpointHandler())
+            atexit.register(wait_for_pending_save)
+        return _ASYNC_CKPTR
 
 
 #: (path, meta) of the async save whose manifest is not committed yet
@@ -83,12 +90,29 @@ def wait_for_pending_save() -> None:
     """Block until an in-flight async save (if any) is durable, then
     commit its manifest — the marker must postdate every byte it
     attests to."""
+    ckptr, pending = _take_pending()
+    _drain_pending(ckptr, pending)
+
+
+def _take_pending() -> Tuple[Optional[ocp.AsyncCheckpointer],
+                             Optional[Tuple[str, Dict[str, Any]]]]:
+    """Claim the in-flight save under the state lock; a claimed
+    manifest either commits in :func:`_drain_pending` or dies with
+    the failed save — a later wait must never re-commit it."""
     global _PENDING_MANIFEST
-    if _ASYNC_CKPTR is not None:
-        _ASYNC_CKPTR.wait_until_finished()
-    if _PENDING_MANIFEST is not None:
-        path, meta = _PENDING_MANIFEST
+    with _CKPT_STATE_LOCK:
+        ckptr = _ASYNC_CKPTR
+        pending = _PENDING_MANIFEST
         _PENDING_MANIFEST = None
+        return ckptr, pending
+
+
+def _drain_pending(ckptr, pending) -> None:
+    """The blocking half: wait for durability, then commit."""
+    if ckptr is not None:
+        ckptr.wait_until_finished()
+    if pending is not None:
+        path, meta = pending
         write_manifest(path, meta)
 
 
@@ -275,7 +299,8 @@ def save_checkpoint(output_dir: str, epoch: int, step: int, state,
     if async_save:
         ckptr = _async_checkpointer()
         ckptr.save(path, args=args, force=True)
-        _PENDING_MANIFEST = (path, dict(meta))
+        with _CKPT_STATE_LOCK:
+            _PENDING_MANIFEST = (path, dict(meta))
         logger.info("async checkpoint save started to %s", path)
     else:
         with _checkpointer() as ckptr:

@@ -56,12 +56,35 @@ identical sampled stream for a given nonce: fleet output is
 token-identical to a single lockstep server for greedy AND sampled
 decoding, under any routing interleaving, with or without failover
 (pinned in tests/test_fleet.py).
+
+**Async router** (``async_workers=True``, docs/fleet_serving.md):
+each replica gets its own worker thread running a bounded tick loop —
+admission, chunked prefill, decode and spill-drain all happen inside
+:meth:`GenerationServer.step`/``prefill_step`` under that server's
+surface lock, so N replicas' host-side Python and device dispatch
+genuinely overlap instead of summing.  The router thread keeps sole
+ownership of routing state (``_reqs``/``_local``/``_nonce``/counters):
+workers only tick their server and push completions through a
+thread-safe harvest queue; the router routes, pumps handoffs and
+resolves harvested completions.  Because nonces are assigned on the
+router thread in global submission order and a replica's output
+depends only on (prompt, resume tokens, nonce), the async fleet stays
+token-identical to the lockstep fleet no matter how worker ticks
+interleave.  The prefill→decode handoff is device-to-device by
+default (one stacked gather → ``jax.device_put`` between committed
+buffers → one scatter, zero host copies, ``fleet/handoff_d2d``);
+``handoff="host"`` survives as the foreign-mesh fallback but its
+``jax.device_get`` runs on a dedicated handoff-writer thread (the
+spill-writer pattern), never on the router's critical path
+(``fleet/handoff_host``).
 """
 
 from __future__ import annotations
 
 import os
+import queue
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -102,18 +125,28 @@ class FleetRouter:
         events_path: fleet-level events.jsonl for router spans and
             fleet events; point the factory's servers at the SAME file
             and one stream tells the whole story.
-        handoff: ``"device"`` hands the gathered page tree straight to
-            the peer's scatter (replicas share devices — the
-            ``copy_kv_pages`` regime); ``"host"`` stages it through
-            ``jax.device_get`` (foreign-mesh fallback).
+        handoff: ``"device"`` moves the gathered page tree between
+            committed device buffers (``jax.device_put``, zero host
+            copies — the ``copy_kv_pages`` regime for replicas sharing
+            devices); ``"host"`` stages it through ``jax.device_get``
+            on the handoff-writer thread (foreign-mesh fallback).
+        async_workers: give each replica its own worker thread running
+            a bounded tick loop so replica ticks overlap; the router
+            thread only routes, pumps handoffs and harvests
+            completions.  Off = the PR 13 lockstep round-robin.
     """
+
+    #: ticks one worker wake-up may run before re-checking its pause
+    #: flag — bounds how long restart_replica waits for quiescence
+    _WORKER_TICKS = 4
 
     def __init__(self, server_factory: Callable[[str], GenerationServer],
                  num_replicas: int = 2, *,
                  prefill_replicas: int = 0,
                  events_path: Optional[str] = None,
                  handoff: str = "device",
-                 prefix_store_dir: Optional[str] = None):
+                 prefix_store_dir: Optional[str] = None,
+                 async_workers: bool = False):
         if num_replicas < 1:
             raise ValueError(
                 f"num_replicas must be >= 1, got {num_replicas}")
@@ -162,7 +195,7 @@ class FleetRouter:
         self._counts = {k: 0 for k in (
             "submitted", "routed_affinity", "routed_least_depth",
             "spillover", "shed", "handoffs", "handoff_pages",
-            "failovers", "restarts")}
+            "handoff_d2d", "handoff_host", "failovers", "restarts")}
         # fleet-level latency histogram lives in an always-on local
         # registry, same discipline as the per-server ones
         self._metrics = metrics.MetricsRegistry(enabled=True)
@@ -171,12 +204,47 @@ class FleetRouter:
             else None
         self._tracer = Tracer(self._recorder)
         self._metrics_server = None
+        self._closed = False
+        # -- host-handoff writer (spill-writer pattern): the router
+        # enqueues gathered device trees, the writer runs the blocking
+        # jax.device_get off the router thread and publishes host
+        # bytes under _handoff_lock for the next pump to pick up
+        self._handoff_q: "queue.Queue" = queue.Queue()
+        self._handoff_lock = threading.Lock()
+        #: fleet id -> host-staged page tree, guarded by _handoff_lock
+        self._handoff_staged: Dict[int, object] = {}
+        self._handoff_writer: Optional[threading.Thread] = None
+        if handoff == "host":
+            self._handoff_writer = threading.Thread(
+                target=self._handoff_writer_loop,
+                name="fleet-handoff-writer", daemon=True)
+            self._handoff_writer.start()
+        # -- async workers: one tick-loop thread per replica index.
+        # The event lists are built once here and never reassigned;
+        # workers read replica slots under _health_lock and own no
+        # routing state.
+        self._async = bool(async_workers)
+        self._stop = threading.Event()
+        self._wake = [threading.Event() for _ in range(num_replicas)]
+        self._pause = [threading.Event() for _ in range(num_replicas)]
+        self._quiet = [threading.Event() for _ in range(num_replicas)]
+        self._harvest: "queue.Queue" = queue.Queue()
+        self._workers: List[threading.Thread] = []
+        if self._async:
+            for i in range(num_replicas):
+                t = threading.Thread(
+                    target=self._worker_loop, args=(i,),
+                    name=f"fleet-worker-{i}", daemon=True)
+                t.start()
+                self._workers.append(t)
         self._install_endpoint()
         self._emit("fleet_start", replicas=num_replicas,
-                   prefill_replicas=prefill_replicas, handoff=handoff)
+                   prefill_replicas=prefill_replicas, handoff=handoff,
+                   async_workers=self._async)
         logger.info(
-            "FleetRouter: %d replicas (%s), handoff=%s", num_replicas,
-            "/".join(r.role for r in self.replicas), handoff)
+            "FleetRouter: %d replicas (%s), handoff=%s, async=%s",
+            num_replicas, "/".join(r.role for r in self.replicas),
+            handoff, self._async)
 
     # -- bookkeeping ---------------------------------------------------
 
@@ -223,13 +291,28 @@ class FleetRouter:
         return {"status": "ok" if ok else "draining",
                 "replicas_ok": ok, "replicas": reps}
 
+    def _snapshot(self) -> List[FleetReplica]:
+        """The replica list copied under the health lock — the ONE way
+        any thread may iterate replicas.  restart_replica swaps list
+        entries under the same lock, so a snapshot never observes a
+        half-swapped fleet; per-replica reads then go through each
+        server's own thread-safe surface."""
+        with self._health_lock:
+            return list(self.replicas)
+
+    def _replica(self, idx: int) -> FleetReplica:
+        """One replica slot read under the health lock (worker-thread
+        entry point — the slot may be swapped by restart_replica)."""
+        with self._health_lock:
+            return self.replicas[idx]
+
     @property
     def pending(self) -> int:
-        """Requests queued on replicas plus handoffs awaiting a
-        decode-side slot."""
-        n = sum(r.server.pending for r in self.replicas)
+        """Requests queued on replicas plus handoffs staging or
+        awaiting a decode-side slot."""
+        n = sum(r.server.pending for r in self._snapshot())
         n += sum(1 for r in self._reqs.values()
-                 if r["stage"] == "pending_decode")
+                 if r["stage"] in ("staging", "pending_decode"))
         return n
 
     @property
@@ -245,7 +328,7 @@ class FleetRouter:
         first: highest registry affinity, then least queue depth, then
         index (a stable tiebreak keeps routing reproducible)."""
         scored = []
-        for i, rep in enumerate(self.replicas):
+        for i, rep in enumerate(self._snapshot()):
             if rep.role not in roles or rep.server.draining:
                 continue
             aff = rep.server.prefix_affinity(tokens)
@@ -269,7 +352,7 @@ class FleetRouter:
         roles = ("prefill",) if self._split else ("mixed",)
         for rank, (aff, depth, i) in enumerate(
                 self._ranked(prompt, roles)):
-            rep = self.replicas[i]
+            rep = self._replica(i)
             nonce = self._nonce
             try:
                 lid = rep.server.submit(
@@ -328,33 +411,143 @@ class FleetRouter:
             return None
         return self._finish(gid, c)
 
+    # -- async workers -------------------------------------------------
+
+    def _worker_loop(self, idx: int) -> None:
+        """One replica's event loop: wait for a wake (or the poll
+        timeout), tick the server up to ``_WORKER_TICKS`` times, push
+        completions — or the tick's exception — onto the harvest
+        queue, re-arm while the server still has work.  The worker
+        owns NO routing state; everything it touches in the server
+        runs under that server's surface lock.  A set pause flag
+        parks the loop outside the server (``_quiet`` acknowledges),
+        which is how restart_replica gets exclusive drain access."""
+        wake = self._wake[idx]
+        pause = self._pause[idx]
+        quiet = self._quiet[idx]
+        while not self._stop.is_set():
+            wake.wait(timeout=0.05)
+            wake.clear()
+            if self._stop.is_set():
+                return
+            if pause.is_set():
+                quiet.set()
+                continue
+            quiet.clear()
+            rep = self._replica(idx)
+            try:
+                for _ in range(self._WORKER_TICKS):
+                    if pause.is_set() or self._stop.is_set():
+                        break
+                    if rep.role == "prefill":
+                        rep.server.prefill_step()
+                    else:
+                        comps = rep.server.step()
+                        if comps:
+                            self._harvest.put((idx, comps))
+                    if not rep.server.work_pending():
+                        break
+                else:
+                    # tick budget spent with work left — re-arm so the
+                    # next wait returns immediately
+                    wake.set()
+                if rep.server.work_pending():
+                    wake.set()
+            except BaseException as e:   # surfaced on the router thread
+                self._harvest.put((idx, e))
+
+    def _harvest_drain(self, out: List[Completion],
+                       wait_s: float = 0.0) -> None:
+        """Resolve every harvested completion onto ``out`` (router
+        thread only — touches ``_local``/``_reqs``).  ``wait_s`` > 0
+        blocks for the FIRST item only, so an idle router tick yields
+        the CPU to the workers instead of spinning."""
+        while True:
+            try:
+                if wait_s > 0.0:
+                    idx, payload = self._harvest.get(timeout=wait_s)
+                    wait_s = 0.0
+                else:
+                    idx, payload = self._harvest.get_nowait()
+            except queue.Empty:
+                return   # drained — emptiness IS the exit condition
+            if isinstance(payload, BaseException):
+                raise payload
+            for c in payload:
+                comp = self._resolve(idx, c)
+                if comp is not None:
+                    out.append(comp)
+
     # -- the fleet loop ------------------------------------------------
 
     def step(self) -> List[Completion]:
-        """One fleet tick: pump prefill→decode handoffs, give prefill
-        replicas an admission+prefill turn, step everyone else, and
-        return finished requests under their fleet ids."""
+        """One fleet tick.  Lockstep: pump prefill→decode handoffs,
+        give prefill replicas an admission+prefill turn, step everyone
+        else in sequence.  Async: pump handoffs, wake every worker and
+        harvest whatever completions their overlapped ticks produced.
+        Either way, finished requests return under their fleet ids."""
         out: List[Completion] = []
         if self._split:
             self._pump_handoffs()
-        for i, rep in enumerate(self.replicas):
-            if rep.role == "prefill":
-                rep.server.prefill_step()
-            else:
-                for c in rep.server.step():
-                    comp = self._resolve(i, c)
-                    if comp is not None:
-                        out.append(comp)
+        live = self._snapshot()
+        if self._async:
+            for ev in self._wake:
+                ev.set()
+            self._harvest_drain(out, wait_s=0.002)
+        else:
+            for i, rep in enumerate(live):
+                if rep.role == "prefill":
+                    rep.server.prefill_step()
+                else:
+                    for c in rep.server.step():
+                        comp = self._resolve(i, c)
+                        if comp is not None:
+                            out.append(comp)
         reg = metrics.get_registry()
         reg.set_gauge("fleet/replicas_ok",
-                      sum(1 for r in self.replicas
+                      sum(1 for r in live
                           if not r.server.draining))
         reg.set_gauge("fleet/pending", self.pending)
         return out
 
+    def _handoff_writer_loop(self) -> None:
+        """The host-handoff writer (``handoff="host"``): pull gathered
+        device trees off the queue, run the blocking
+        ``jax.device_get`` HERE — never on the router thread — and
+        publish the host bytes for the next pump.  The gather already
+        materialised fresh buffers, so the bytes are immutable; a None
+        sentinel shuts the thread down."""
+        while True:
+            item = self._handoff_q.get()
+            if item is None:
+                return
+            gid, data = item
+            host = jax.device_get(data)
+            with self._handoff_lock:
+                self._handoff_staged[gid] = host
+
     def _pump_handoffs(self) -> None:
-        """Move every finished prefill to a decode replica and retry
-        handoffs that found no decode capacity last tick."""
+        """Move every finished prefill toward a decode replica:
+        initiate the gather for newly-ready prompts (d2d: one
+        ``jax.device_put`` between committed buffers, zero host
+        copies; host: enqueue to the handoff writer), adopt staged
+        host bytes the writer finished, and retry handoffs that found
+        no decode capacity last tick.
+
+        Async mode parks the source prefill worker for the export
+        window: between :meth:`kv_export`'s pins and
+        :meth:`kv_export_release` the source pool is transiently
+        smaller than its validated capacity, and a concurrently
+        free-running admission/prefill tick could starve it (the
+        lockstep router never overlapped those two phases)."""
+        parked: set = set()
+        try:
+            self._pump_handoffs_inner(parked)
+        finally:
+            for i in parked:
+                self._unpark_worker(i)
+
+    def _pump_handoffs_inner(self, parked: set) -> None:
         for gid in list(self._reqs):
             req = self._reqs.get(gid)
             if req is None:
@@ -362,19 +555,39 @@ class FleetRouter:
             if req["stage"] == "pending_decode":
                 self._dispatch_decode(gid, req)
                 continue
+            if req["stage"] == "staging":
+                with self._handoff_lock:
+                    host = self._handoff_staged.pop(gid, None)
+                if host is None:
+                    continue          # writer still copying
+                req["kv"] = (host, req["kv"][1], req["kv"][2])
+                req["stage"] = "pending_decode"
+                self.inc("fleet/handoff_host")
+                self._metrics.observe(
+                    "fleet/handoff_ms",
+                    (time.monotonic() - req.pop("handoff_t0"))
+                    * 1000.0)
+                self._emit("fleet_handoff_staged", request=gid,
+                           trace=req["trace_id"])
+                self._dispatch_decode(gid, req)
+                continue
             if req["stage"] != "prefill":
                 continue
             i = req["replica"]
-            srv = self.replicas[i].server
+            srv = self._replica(i).server
             # a failed-over partial re-prefills prompt+tokens, and
             # that full sequence is what the prompt registry holds
             seq = req["prompt"] + req["tokens"]
             if not srv.prompt_ready(seq):
                 continue
+            if self._async and i not in parked:
+                self._park_worker(i)
+                parked.add(i)
             exp = srv.kv_export(seq)
             if exp is None:
                 continue
             pages, last = exp
+            t0 = time.monotonic()
             partial = srv.preempt(req["local_id"])
             self._local.pop((i, req["local_id"]), None)
             if partial is not None:
@@ -383,19 +596,35 @@ class FleetRouter:
             # pins can drop as soon as it is dispatched — the data no
             # longer depends on the source pool's pages
             data = srv.kv_page_data(pages)
-            if self._handoff == "host":
-                data = jax.device_get(data)
             srv.kv_export_release(pages)
-            req["kv"] = (data, last, len(pages))
-            req["stage"] = "pending_decode"
             self.inc("fleet/handoffs")
             self.inc("fleet/handoff_pages", len(pages))
             span = self._tracer.start_trace(
                 "fleet/handoff", trace_id=req["trace_id"],
                 request=gid, pages=len(pages))
             self._emit("fleet_handoff", request=gid,
-                       replica=self.replicas[i].name,
-                       pages=len(pages), trace=req["trace_id"])
+                       replica=self._replica(i).name,
+                       pages=len(pages), mode=self._handoff,
+                       trace=req["trace_id"])
+            if self._handoff == "host":
+                # foreign-mesh fallback: the device_get happens on the
+                # writer thread; the request parks in "staging" until
+                # the bytes land
+                req["kv"] = (None, last, len(pages))
+                req["stage"] = "staging"
+                req["handoff_t0"] = t0
+                self._handoff_q.put((gid, data))
+                span.end(placed=False, staged=True)
+                continue
+            # d2d: commit the gathered tree to the decode pool's
+            # devices in one batched transfer — no host numpy leg
+            data = jax.device_put(data)
+            req["kv"] = (data, last, len(pages))
+            req["stage"] = "pending_decode"
+            self.inc("fleet/handoff_d2d")
+            self._metrics.observe(
+                "fleet/handoff_ms",
+                (time.monotonic() - t0) * 1000.0)
             self._dispatch_decode(gid, req)
             span.end(placed=req["stage"] == "decode")
 
@@ -409,7 +638,7 @@ class FleetRouter:
         roles = ("decode",) if self._split else ("mixed",)
         seq = req["prompt"] + req["tokens"]
         for aff, depth, i in self._ranked(seq, roles):
-            srv = self.replicas[i].server
+            srv = self._replica(i).server
             imported = data is not None and srv.kv_import(
                 seq, data, last, n_pages)
             try:
@@ -454,7 +683,8 @@ class FleetRouter:
         ranked = [r for role in roles
                   for r in self._ranked(seq, (role,))]
         for aff, depth, i in ranked:
-            srv = self.replicas[i].server
+            rep = self._replica(i)
+            srv = rep.server
             try:
                 lid = srv.submit(
                     req["prompt"],
@@ -464,16 +694,16 @@ class FleetRouter:
             except RequestShed:
                 continue
             self.inc("fleet/failovers")
-            span.end(replica=self.replicas[i].name)
+            span.end(replica=rep.name)
             req["replica"] = i
             req["local_id"] = lid
             # on a prefill-role replica the stream re-enters the
             # handoff pump once its re-prefill lands in the registry
             req["stage"] = "prefill" \
-                if self.replicas[i].role == "prefill" else "decode"
+                if rep.role == "prefill" else "decode"
             self._local[(i, lid)] = gid
             self._emit("fleet_failover", request=gid,
-                       replica=self.replicas[i].name,
+                       replica=rep.name,
                        tokens=len(req["tokens"]),
                        trace=req["trace_id"])
             return None
@@ -489,12 +719,22 @@ class FleetRouter:
         or fail over every in-flight request, swap in a fresh server
         from the factory and re-arm the fleet health endpoint.
         Returns whatever finished during the drain (failed-over
-        partials complete later through :meth:`step`)."""
-        rep = self.replicas[idx]
+        partials complete later through :meth:`step`).
+
+        Async mode: the replica's worker is parked first (pause flag →
+        quiet handshake) so the drain has exclusive use of the server,
+        and the harvest queue is flushed before the drain so no stale
+        (replica, local id) completion can alias a fresh submission on
+        the replacement server.  The OTHER workers keep ticking
+        throughout — the fleet serves while one replica restarts."""
+        done: List[Completion] = []
+        if self._async:
+            self._park_worker(idx)
+            self._harvest_drain(done)
+        rep = self._replica(idx)
         self._emit("fleet_restart_begin", replica=rep.name,
                    pending=rep.server.pending,
                    occupancy=rep.server.occupancy)
-        done: List[Completion] = []
         partials: List[Tuple[int, Completion]] = []
         for c in rep.server.drain(max_ticks=max_ticks):
             gid = self._local.pop((idx, c.request_id), None)
@@ -530,10 +770,26 @@ class FleetRouter:
         self.inc("fleet/restarts")
         # the new server's start_from_env stole /healthz — take it back
         self._install_endpoint()
+        if self._async:
+            self._unpark_worker(idx)
         self._emit("fleet_restart_end", replica=rep.name,
                    finished=len(done), failovers=len(partials),
                    warm_pages=adopted)
         return done
+
+    def _park_worker(self, idx: int) -> None:
+        """Pause one async worker and wait until it acknowledges it is
+        outside its server (the quiet handshake)."""
+        self._pause[idx].set()
+        self._wake[idx].set()
+        if not self._quiet[idx].wait(timeout=30.0):
+            raise RuntimeError(
+                f"fleet worker {idx} failed to quiesce for restart")
+
+    def _unpark_worker(self, idx: int) -> None:
+        self._quiet[idx].clear()
+        self._pause[idx].clear()
+        self._wake[idx].set()
 
     def rolling_restart(self, max_ticks: int = 0) -> List[Completion]:
         """Restart every replica in turn — the fleet keeps serving
@@ -557,18 +813,33 @@ class FleetRouter:
         return [done[i] for i in ids]
 
     def close(self) -> None:
-        """Detach every replica's OS-level hooks. Idempotent."""
-        for rep in self.replicas:
+        """Stop the worker and handoff-writer threads, then detach
+        every replica's OS-level hooks. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        for ev in self._wake:
+            ev.set()
+        for t in self._workers:
+            t.join(timeout=10.0)
+        self._workers = []
+        if self._handoff_writer is not None:
+            self._handoff_q.put(None)
+            self._handoff_writer.join(timeout=10.0)
+            self._handoff_writer = None
+        for rep in self._snapshot():
             rep.server.close()
 
     def summary(self) -> dict:
         """Fleet counters + aggregate throughput + fleet-level TTFT
-        percentiles + per-replica summaries (also emitted to the
-        flight recorder)."""
+        and handoff percentiles + per-replica summaries (also emitted
+        to the flight recorder)."""
         reps = []
         tokens = 0
         tick_time = 0.0
-        for rep in self.replicas:
+        max_tick_time = 0.0
+        for rep in self._snapshot():
             s = rep.server.summary()
             s["replica"] = rep.name
             s["role"] = rep.role
@@ -576,20 +847,26 @@ class FleetRouter:
             reps.append(s)
             tokens += s["decode_tokens"]
             tick_time += s["decode_time_sec"]
-        out = {"replicas": len(self.replicas),
+            max_tick_time = max(max_tick_time, s["decode_time_sec"])
+        # lockstep replicas tick sequentially on the same host/chips,
+        # so the honest aggregate divides by SUMMED decode time; async
+        # workers overlap, so wall time is the SLOWEST replica's
+        denom = max_tick_time if self._async else tick_time
+        out = {"replicas": len(reps),
                "prefill_split": self._split,
                "handoff": self._handoff,
+               "async_workers": self._async,
                "decode_tokens": tokens,
-               "decode_time_sec": round(tick_time, 4),
-               # replicas tick sequentially on the same host/chips, so
-               # the honest aggregate divides by SUMMED decode time
-               "tokens_per_sec": round(tokens / tick_time, 2)
-               if tick_time > 0 else 0.0,
+               "decode_time_sec": round(denom, 4),
+               "tokens_per_sec": round(tokens / denom, 2)
+               if denom > 0 else 0.0,
                **self._counts}
-        h = self._metrics.histogram("fleet/ttft_ms")
-        if h is not None and h.count:
-            out["ttft_p50_ms"] = round(h.percentile(50), 3)
-            out["ttft_p99_ms"] = round(h.percentile(99), 3)
+        for prefix, series in (("ttft", "fleet/ttft_ms"),
+                               ("handoff", "fleet/handoff_ms")):
+            h = self._metrics.histogram(series)
+            if h is not None and h.count:
+                out[f"{prefix}_p50_ms"] = round(h.percentile(50), 3)
+                out[f"{prefix}_p99_ms"] = round(h.percentile(99), 3)
         self._emit("fleet_summary", **out)
         out["per_replica"] = reps
         return out

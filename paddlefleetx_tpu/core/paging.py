@@ -184,12 +184,34 @@ class PageAllocator:
         except PagePoolExhausted:
             return None
 
+    def alloc_many(self, n: int) -> List[int]:
+        """Take ``n`` free pages at refcount 1 in one call — the
+        import half of a batched KV handoff (``kv_import`` scatters
+        all destination pages in one dispatch). All-or-nothing: an
+        exhausted pool raises before any page is taken."""
+        if n > len(self._free):
+            raise PagePoolExhausted(
+                f"page pool exhausted ({len(self._free)} free of "
+                f"{self.num_pages - 1} usable, {n} requested)")
+        return [self.alloc() for _ in range(n)]
+
     def retain(self, pid: int) -> int:
         """Add a reference to a live page; returns the new refcount."""
         if self._ref.get(pid, 0) < 1:
             raise ValueError(f"retain of free/unknown page {pid}")
         self._ref[pid] += 1
         return self._ref[pid]
+
+    def retain_many(self, pids: Sequence[int]) -> None:
+        """Pin a whole page set in one call — the export half of a
+        batched KV handoff. All-or-nothing: validates every id before
+        taking the first reference, so a bad id never leaves a
+        partially pinned set."""
+        for pid in pids:
+            if self._ref.get(pid, 0) < 1:
+                raise ValueError(f"retain of free/unknown page {pid}")
+        for pid in pids:
+            self._ref[pid] += 1
 
     def release(self, pid: int) -> bool:
         """Drop one reference; at zero the page returns to the free

@@ -109,6 +109,11 @@ class FaultInjector:
                         if s.strip()]
         self._rng = random.Random(seed)
         self._recorder = recorder
+        # hang faults wait on this rather than time.sleep so an
+        # injected stall stays interruptible (and, on a serving
+        # surface, sleeps inside the lock the way a real stalled
+        # step would — the watchdog must see the lock held)
+        self._hang_cv = threading.Condition()
         self.kill_mode = kill_mode or os.environ.get(
             "PFX_FAULTS_MODE", "kill")
         if self.kill_mode not in ("kill", "raise"):
@@ -155,7 +160,8 @@ class FaultInjector:
                 raise InjectedKill(f.spec)
             os.kill(os.getpid(), signal.SIGKILL)
         elif f.kind == "hang":
-            time.sleep(f.duration)
+            with self._hang_cv:
+                self._hang_cv.wait(timeout=f.duration)
         elif f.kind == "corrupt_ckpt":
             self._corrupt(ctx.get("path"))
         return f.kind

@@ -143,7 +143,8 @@ from ..models.gpt.generation import (
     _unrolled_twin, activate_slot, copy_kv_pages, decode_loop,
     decode_step, gather_kv_pages, init_page_pool, init_slot_cache,
     init_slot_state, prefill_chunk_paged, prefill_into_slots,
-    scatter_kv_pages, verify_loop, verify_step,
+    scatter_kv_pages, split_kv_pages, stack_kv_pages, verify_loop,
+    verify_step,
 )
 from ..observability import metrics
 from ..observability import server as obs_server
@@ -327,7 +328,15 @@ class GenerationServer:
                 # writer; the main loop evicts them at the next yield
                 # point (_reap_failed_spills). Under _spill_lock.
                 self._spill_failed: List[Tuple[int, int]] = []
-                self._spill_lock = threading.Lock()
+                # a Condition, not a bare Lock: the rehydrate slow
+                # path and prefix-store export WAIT on it for the
+                # writer's publishes instead of joining the queue, so
+                # the wait works from under the surface lock (the
+                # writer never takes that lock)
+                self._spill_lock = threading.Condition()
+                #: writer items shipped but not yet published/failed;
+                #: guarded by _spill_lock, notified on every change
+                self._spill_outstanding = 0
                 self._spill_q: queue.Queue = queue.Queue()
                 self._spill_writer_thread = threading.Thread(
                     target=self._spill_writer, name="kv-spill-writer",
@@ -421,6 +430,22 @@ class GenerationServer:
         self._recorder = FlightRecorder(events_path) if events_path \
             else None
         self._tracer = Tracer(self._recorder)
+        # async fleet surface (docs/fleet_serving.md "Async router"):
+        # every public entry point that touches queue/slot/pool state
+        # serializes on this re-entrant lock, so a fleet worker
+        # thread can drive step()/prefill_step() while the router
+        # thread calls submit()/kv_*()/summary() concurrently.
+        # Blocking primitives never run under it: _drain_spills only
+        # COLLECTS writer items into _spill_outbox, and the public
+        # wrappers ship them to the spill queue after releasing the
+        # lock (_ship_spills); writer waits go through the
+        # _spill_lock condition, which the writer thread can always
+        # take.
+        self._surface_lock = threading.RLock()
+        self._closed = False
+        #: batched writer items _drain_spills collected this entry —
+        #: surface-lock state, drained by _ship_spills
+        self._spill_outbox: List[tuple] = []
         # /healthz is answered on the metrics server's per-request
         # threads while the main loop mutates queue/slot state, so the
         # payload is an immutable snapshot the main loop republishes
@@ -439,6 +464,11 @@ class GenerationServer:
             else FaultInjector.from_env(recorder=self._recorder)
         self._watchdog = StepWatchdog.from_env(name="decode_tick",
                                                recorder=self._recorder)
+        if self._tiered:
+            # computed eagerly: the fingerprint's jax.device_get must
+            # never run under the surface lock, so the locked
+            # prefix-store paths read the cached value
+            self._model_fingerprint()
         self._emit("serving_start", slots=num_slots,
                    buckets=list(buckets),
                    max_dec_len=gen_cfg.max_dec_len,
@@ -544,18 +574,47 @@ class GenerationServer:
     @property
     def occupancy(self) -> int:
         """Number of slots currently holding a live request."""
-        return sum(s is not None for s in self._slots)
+        with self._surface_lock:
+            return sum(s is not None for s in self._slots)
 
     @property
     def pending(self) -> int:
         """Number of submitted requests still waiting for a slot."""
-        return len(self._queue)
+        with self._surface_lock:
+            return len(self._queue)
 
     @property
     def draining(self) -> bool:
         """True once drain mode is entered (SIGTERM or :meth:`drain`)
         — the fleet router stops routing to a draining replica."""
-        return self._draining
+        with self._surface_lock:
+            return self._draining
+
+    def work_pending(self) -> bool:
+        """True while a :meth:`step` could make progress: queued
+        admissions, an occupied slot, an unfinished chunked prefill,
+        or tiered spill work (pinned pages awaiting their yield-point
+        drain, or collected writer items awaiting shipment). Async
+        fleet worker threads poll this to park when their replica is
+        idle (docs/fleet_serving.md "Async router")."""
+        with self._surface_lock:
+            if self._queue or any(s is not None for s in self._slots):
+                return True
+            if self.paged and self._prefilling:
+                return True
+            if self._tiered and (self._spill_pin or
+                                 self._spill_outbox):
+                return True
+            return False
+
+    def check_alloc(self) -> None:
+        """Assert the page allocator's invariants under the surface
+        lock — the thread-safe spelling of the ``_alloc.check()``
+        test hook (async fleet worker ticks mutate the allocator
+        concurrently, so bare allocator reads race)."""
+        with self._surface_lock:
+            if self.paged:
+                self._alloc.check()
 
     def submit(self, prompt: Sequence[int],
                deadline_s: Optional[float] = None,
@@ -586,7 +645,19 @@ class GenerationServer:
         a fleet router (core/fleet.py) assigns nonces in GLOBAL
         submission order so sampled draws are replica-independent and
         a failed-over request keeps its stream — leave it None
-        everywhere else."""
+        everywhere else.
+
+        Thread-safe: serialized on the surface lock against a
+        concurrently ticking fleet worker thread."""
+        with self._surface_lock:
+            return self._submit_impl(prompt, deadline_s, resume_tokens,
+                                     trace_id, nonce)
+
+    def _submit_impl(self, prompt: Sequence[int],
+                     deadline_s: Optional[float],
+                     resume_tokens: Optional[Sequence[int]],
+                     trace_id: Optional[str],
+                     nonce: Optional[int]) -> int:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -640,11 +711,13 @@ class GenerationServer:
         """Preemption notice: flip into drain mode — the in-progress
         :meth:`run`/:meth:`step` driver stops admitting and returns
         partials (mirroring the Engine's save-on-preemption
-        contract)."""
-        self._draining = True
-        self._refresh_health()
-        self._emit("serving_drain_start", signum=signum,
-                   pending=self.pending, occupancy=self.occupancy)
+        contract). The surface lock is re-entrant, so a signal landing
+        mid-step on the main thread re-acquires it safely."""
+        with self._surface_lock:
+            self._draining = True
+            self._refresh_health()
+            self._emit("serving_drain_start", signum=signum,
+                       pending=self.pending, occupancy=self.occupancy)
 
     def _expire_deadlines(self) -> List[Completion]:
         """Evict every queued/running request whose deadline passed;
@@ -807,34 +880,37 @@ class GenerationServer:
                 if self._prefix_sharing else None
             if hit is not None:
                 pages, last = hit
-                n_host = sum(1 for p in pages if self._alloc.is_host(p))
+                host_ids = [p for p in pages
+                            if self._alloc.is_host(p)]
+                n_host = len(host_ids)
                 if n_host and self._alloc.free_pages < n_host:
                     # rehydration needs fresh HBM pages — block the
                     # queue head until they free (same starvation rule
                     # as the chunked path's owned-pages check)
                     break
                 self._queue.popleft()
-                mapped = []
                 try:
-                    for pid in pages:
-                        if self._alloc.is_host(pid):
-                            # spilled page: scatter the host copy back
-                            # into a fresh HBM id (refcount 1 = this
-                            # request's reference)
-                            mapped.append(self._rehydrate(pid))
-                        else:
-                            self._alloc.retain(pid)
-                            mapped.append(pid)
+                    # every spilled page of the hit comes back in ONE
+                    # stacked scatter; each fresh id's refcount-1
+                    # reference belongs to this request
+                    promoted = dict(zip(
+                        host_ids, self._rehydrate_many(host_ids)))
                 except _RehydrateMiss:
-                    # a failed spill surfaced mid-map: unwind the
-                    # references taken so far and retry this request
-                    # on the next pass — the reap dropped the dead
-                    # page's registrations, so it re-prefills cold
-                    for m in mapped:
-                        self._alloc.release(m)
+                    # a failed spill surfaced mid-batch: nothing was
+                    # mapped yet (the batch allocates only once every
+                    # page's bytes arrived) and the reap dropped the
+                    # dead page's registrations, so the retry
+                    # re-prefills cold on the next pass
                     self._drop_evicted_host_data()
                     self._queue.appendleft(req)
                     continue
+                mapped = []
+                for pid in pages:
+                    if pid in promoted:
+                        mapped.append(promoted[pid])
+                    else:
+                        self._alloc.retain(pid)
+                        mapped.append(pid)
                 self._pt[slot, :] = NULL_PAGE
                 self._pt[slot, :len(mapped)] = mapped
                 self._pt_dirty = True
@@ -879,25 +955,24 @@ class GenerationServer:
                 break
             self._queue.popleft()
             self._pt[slot, :] = NULL_PAGE
-            mapped = []
+            host_ids = [p for p in shared_pids
+                        if self._alloc.is_host(p)]
             try:
-                for j, pid in enumerate(shared_pids):
-                    if self._alloc.is_host(pid):
-                        pid = self._rehydrate(pid)
-                    else:
-                        self._alloc.retain(pid)
-                    mapped.append(pid)
-                    self._pt[slot, j] = pid
+                promoted = dict(zip(
+                    host_ids, self._rehydrate_many(host_ids)))
             except _RehydrateMiss:
                 # same unwind as the prompt-hit path: the dead prefix
                 # page's registration is gone, so the retry shares
                 # fewer pages and prefills the rest
-                for m in mapped:
-                    self._alloc.release(m)
-                self._pt[slot, :] = NULL_PAGE
                 self._drop_evicted_host_data()
                 self._queue.appendleft(req)
                 continue
+            for j, pid in enumerate(shared_pids):
+                if pid in promoted:
+                    pid = promoted[pid]
+                else:
+                    self._alloc.retain(pid)
+                self._pt[slot, j] = pid
             for j in range(len(shared_pids), total_pages):
                 self._pt[slot, j] = self._alloc.alloc()
             self._pt_dirty = True
@@ -998,37 +1073,48 @@ class GenerationServer:
     # main loop.
 
     def _spill_writer(self) -> None:
-        """Background spill writer: stage each gathered page tree to
-        host memory (``jax.device_get`` — the device sync the decode
-        tick must never pay) and publish it, tagged with its host
-        id's residency generation, under the spill lock. ``task_done``
-        is called on EVERY path (try/finally): a writer that died
-        mid-item would strand every later ``_spill_q.join()`` —
-        rehydrate slow path, prefix-store export — in a silent
-        deadlock. A failed stage is recorded instead (the main loop
-        evicts that host page at the next yield point, so the loss
+        """Background spill writer: stage each batched writer item —
+        ONE stacked :func:`gather_kv_pages` tree covering every page
+        of a yield's drain — to host memory with a single
+        ``jax.device_get`` (the device sync the decode tick must
+        never pay), split it back into per-page trees, and publish
+        each under the spill condition, tagged with its host id's
+        residency generation. The outstanding count drops and the
+        condition notifies on EVERY path, success or failure: the
+        rehydrate slow path and prefix-store export wait for
+        ``outstanding == 0`` instead of joining the queue, and a
+        writer that died mid-item must never strand them. A failed
+        stage records every page of the batch instead (the main loop
+        evicts those host pages at the next yield point, so the loss
         surfaces as a cold re-prefill, never a hang or wrong KV).
         ``None`` is the shutdown sentinel (:meth:`close`)."""
         while True:
             item = self._spill_q.get()
+            if item is None:
+                return
+            entries, data = item
             try:
-                if item is None:
-                    return
-                hpid, gen, data = item
-                try:
-                    host = jax.device_get(data)
-                except Exception:
-                    logger.exception(
-                        "kv-spill-writer: staging host page %d "
-                        "(gen %d) failed; its KV is lost and the "
-                        "page will be evicted", hpid, gen)
-                    with self._spill_lock:
-                        self._spill_failed.append((hpid, gen))
-                    continue
+                host = jax.device_get(data)
+                pages = split_kv_pages(host, len(entries))
+            except Exception:
+                logger.exception(
+                    "kv-spill-writer: staging %d host pages failed; "
+                    "their KV is lost and the pages will be evicted",
+                    len(entries))
                 with self._spill_lock:
-                    self._host_data[hpid] = (gen, host)
-            finally:
-                self._spill_q.task_done()
+                    self._spill_failed.extend(entries)
+                    self._spill_outstanding -= 1
+                    self._spill_lock.notify_all()
+                continue
+            with self._spill_lock:
+                for (hpid, gen), page in zip(entries, pages):
+                    cur = self._host_data.get(hpid)
+                    if cur is None or cur[0] <= gen:
+                        # never let a stale residency's late publish
+                        # clobber a recycled id's fresher bytes
+                        self._host_data[hpid] = (gen, page)
+                self._spill_outstanding -= 1
+                self._spill_lock.notify_all()
 
     def _release_page(self, pid: int) -> None:
         """Release one reference to a slot-mapped page. In tiered mode
@@ -1095,13 +1181,20 @@ class GenerationServer:
             return entry[1]
 
     def _drain_spills(self) -> None:
-        """Dispatch every pinned spill: per page, gather its KV on
-        device (async dispatch — the blocking copy runs on the writer
-        thread), move its registrations to a host id, free the HBM
-        page. Runs ONLY at the step-entry yield point, never between
-        decode ticks — the decode-never-blocks contract the event
-        timeline test pins (every ``serving_spill`` pairs with the
-        ``serving_yield`` that opened the drain)."""
+        """Collect every pinned spill into ONE batched writer item:
+        per page, move its registrations to a host id and free the
+        HBM page; then gather ALL spilled pages' KV in a single
+        stacked dispatch (async — the blocking copy runs on the
+        writer thread) and append the item to the spill outbox. Runs
+        under the surface lock at the step-entry yield point only;
+        the public wrappers ship the outbox to the writer queue AFTER
+        releasing the lock (:meth:`_ship_spills`), so the queue put
+        never runs under a lock. The event-timeline contract is
+        unchanged: every ``serving_spill`` pairs with the
+        ``serving_yield`` that opened the drain. Freeing the page ids
+        before the gather is safe — nothing allocates between, and
+        later decode writes build NEW functional cache arrays while
+        the dispatched gather keeps referencing these buffers."""
         if not self._tiered:
             return
         self._reap_failed_spills()
@@ -1110,6 +1203,8 @@ class GenerationServer:
         self._emit("serving_yield", ticks=self._ticks,
                    roundtrips=self._roundtrips,
                    pending_spills=len(self._spill_pin))
+        spilled: List[int] = []
+        entries: List[Tuple[int, int]] = []
         while self._spill_pin:
             pid = next(iter(self._spill_pin))   # FIFO: oldest pin first
             del self._spill_pin[pid]
@@ -1117,8 +1212,6 @@ class GenerationServer:
                 # re-shared while pinned: drop the pin, stay in HBM
                 self._alloc.release(pid)
                 continue
-            data = gather_kv_pages(self._cache,
-                                   jnp.asarray([pid], jnp.int32))
             hpid = self._alloc.spill(pid)
             if hpid is None:
                 # registrations died while pinned (a co-member freed);
@@ -1130,57 +1223,140 @@ class GenerationServer:
                 continue
             gen = self._alloc.host_generation(hpid)
             self._drop_evicted_host_data()
-            self._spill_q.put((hpid, gen, data))
+            spilled.append(pid)
+            entries.append((hpid, gen))
             metrics.inc("serving/spill")
             self._emit("serving_spill", page=pid, host_page=hpid,
                        ticks=self._ticks, roundtrips=self._roundtrips)
+        if spilled:
+            data = gather_kv_pages(self._cache,
+                                   jnp.asarray(spilled, jnp.int32))
+            self._spill_outbox.append((entries, data))
         metrics.get_registry().set_gauge(
             "serving/host_pages", self._alloc.host_pages_resident)
 
-    def _rehydrate(self, hpid: int) -> int:
-        """Bring one host-resident page back into HBM under a fresh
-        page id: pop the staged bytes (waiting out the writer if the
-        spill is still in flight — admission time only, never between
-        decode ticks), scatter them into a newly allocated page, and
-        move the registrations back. The fresh page's refcount-1
-        reference belongs to the admitting request. The caller checks
-        ``free_pages`` first, so the alloc always succeeds."""
+    def _ship_spills(self) -> None:
+        """Hand the writer items :meth:`_drain_spills` collected to
+        the spill queue. Called by the public wrappers AFTER the
+        surface lock is released — the outstanding-count bump and the
+        queue puts are the only cross-thread edges, and neither runs
+        under it."""
+        with self._surface_lock:
+            items, self._spill_outbox = self._spill_outbox, []
+        if not items:
+            return
+        with self._spill_lock:
+            self._spill_outstanding += len(items)
+        for item in items:
+            self._spill_q.put(item)
+
+    #: upper bound on waiting for the writer to publish a page's
+    #: bytes at rehydrate/export time — generous next to a single
+    #: device_get, only ever reached if the writer thread died
+    _SPILL_WAIT_S = 30.0
+
+    def _outbox_page(self, hpid: int, gen: int):
+        """A page's device tree from a writer item still sitting in
+        the spill outbox — a spill collected THIS step entry whose
+        ship happens only after the surface lock releases. Rehydrating
+        straight from the pending gather skips the host round trip;
+        the item stays queued untouched (its eventual publish of this
+        residency is discarded by the generation guards once the
+        promote recycles the id)."""
+        for entries, data in self._spill_outbox:
+            for i, (h, g) in enumerate(entries):
+                if h == hpid and g == gen:
+                    return split_kv_pages(data, len(entries))[i]
+        return None
+
+    def _await_host_bytes(self, hpid: int, gen: int):
+        """Wait (admission time only, never between decode ticks) for
+        the writer to publish the CURRENT residency of ``hpid`` and
+        pop it. None once the bytes are known gone: the residency's
+        failure was recorded, a fresher residency owns the id, the
+        writer went idle with nothing published, or the wait timed
+        out. Waits on the spill condition — the writer publishes
+        under it and never takes the surface lock, so waiting here
+        from under the surface lock cannot deadlock."""
+        deadline = time.monotonic() + self._SPILL_WAIT_S
+        with self._spill_lock:
+            while True:
+                entry = self._host_data.get(hpid)
+                if entry is not None:
+                    if entry[0] == gen:
+                        del self._host_data[hpid]
+                        return entry[1]
+                    if entry[0] < gen:
+                        # a recycled id's stale spill raced the
+                        # eviction drain: discard, keep waiting
+                        del self._host_data[hpid]
+                    else:
+                        return None   # this residency is dead
+                elif (hpid, gen) in self._spill_failed:
+                    return None
+                elif self._spill_outstanding == 0:
+                    return None
+                if time.monotonic() >= deadline:
+                    return None
+                self._spill_lock.wait(timeout=0.05)
+
+    def _rehydrate_many(self, hpids: Sequence[int]) -> List[int]:
+        """Bring N host-resident pages back into HBM with ONE stacked
+        scatter: pop (or await) every page's staged bytes, allocate N
+        fresh page ids, scatter the stacked tree in a single
+        dispatch, and move each page's registrations back. Every
+        fresh page's refcount-1 reference belongs to the admitting
+        request; the callers check ``free_pages`` first, so the
+        allocs always succeed. Raises :class:`_RehydrateMiss` — with
+        every already-popped page's bytes restored, those residencies
+        stay live — when any page's stage failed; the caller unwinds
+        and retries cold."""
+        if not hpids:
+            return []
         t0 = time.time()
-        pid = self._alloc.alloc()
-        gen = self._alloc.host_generation(hpid)
-        data = self._pop_host_bytes(hpid, gen)
-        if data is None:
-            # gathered but not yet staged (or a dead residency's
-            # stale bytes were in the way): wait for the writer to
-            # finish the queue (must NOT hold _spill_lock here — the
-            # writer needs it to publish) and retry
-            self._spill_q.join()
+        popped: List[Tuple[int, int, object]] = []
+        miss: Optional[int] = None
+        for hpid in hpids:
+            gen = self._alloc.host_generation(hpid)
             data = self._pop_host_bytes(hpid, gen)
-        if data is None:
+            if data is None:
+                data = self._outbox_page(hpid, gen)
+            if data is None:
+                data = self._await_host_bytes(hpid, gen)
+            if data is None:
+                miss = hpid
+                break
+            popped.append((hpid, gen, data))
+        if miss is not None:
+            with self._spill_lock:
+                for hpid, gen, data in popped:
+                    self._host_data[hpid] = (gen, data)
             # the one legitimate way here: the spill's device_get
             # failed on the writer after this page was looked up but
-            # before the failure was reaped. Reap now (evicts hpid,
-            # drops its registrations) and let admission unwind — the
-            # prompt re-prefills cold. Anything else is an invariant
-            # bug and must fail loudly.
+            # before the failure was reaped. Reap now (evicts the
+            # page, drops its registrations) and let admission unwind
+            # — the prompt re-prefills cold. Anything else is an
+            # invariant bug and must fail loudly.
             self._reap_failed_spills()
-            self._alloc.release(pid)
-            if self._alloc.is_host(hpid):
+            if self._alloc.is_host(miss):
                 raise RuntimeError(
-                    f"host page {hpid} (gen {gen}) resident but its "
-                    f"bytes are gone")
-            raise _RehydrateMiss(hpid)
+                    f"host page {miss} resident but its bytes are "
+                    f"gone")
+            raise _RehydrateMiss(miss)
+        pids = self._alloc.alloc_many(len(popped))
+        stacked = stack_kv_pages([d for _, _, d in popped])
         self._cache = scatter_kv_pages(
-            self._cache, data, jnp.asarray([pid], jnp.int32))
-        self._alloc.promote(hpid, pid)
-        metrics.inc("serving/rehydrate")
+            self._cache, stacked, jnp.asarray(pids, jnp.int32))
+        for (hpid, _, _), pid in zip(popped, pids):
+            self._alloc.promote(hpid, pid)
+            self._emit("serving_rehydrate", host_page=hpid, page=pid,
+                       ticks=self._ticks)
+        metrics.inc("serving/rehydrate", len(pids))
         self._metrics.observe("serving/rehydrate_ms",
                               (time.time() - t0) * 1000.0)
-        self._emit("serving_rehydrate", host_page=hpid, page=pid,
-                   ticks=self._ticks)
         metrics.get_registry().set_gauge(
             "serving/host_pages", self._alloc.host_pages_resident)
-        return pid
+        return pids
 
     def _alloc_or_preempt(self, needy_slot: int) -> int:
         """A free page, preempting the youngest OTHER occupied slot
@@ -1322,6 +1498,10 @@ class GenerationServer:
         """Cancel a request (client abort / scheduler decision): evict
         its slot — or drop it from the queue — and return the partial
         completion. None when the id is unknown/already finished."""
+        with self._surface_lock:
+            return self._preempt_impl(request_id)
+
+    def _preempt_impl(self, request_id: int) -> Optional[Completion]:
         for slot, req in enumerate(self._slots):
             if req is not None and req["id"] == request_id:
                 return self._evict(slot, "preempted")
@@ -1357,17 +1537,18 @@ class GenerationServer:
         leading full-page prefix-registry hits, or past-the-table
         ``max_kv_pages + 1`` for a whole-prompt registry hit (zero
         prefill beats any partial share). 0 on contiguous servers."""
-        if not self.paged or not self._prefix_sharing:
-            return 0
-        seq = [int(t) for t in tokens]
-        if self._alloc.lookup_prompt(prompt_key(seq)) is not None:
-            return self._max_pages + 1
-        n = 0
-        for kk in page_prefix_keys(seq, self._page):
-            if self._alloc.lookup_prefix(kk) is None:
-                break
-            n += 1
-        return n
+        with self._surface_lock:
+            if not self.paged or not self._prefix_sharing:
+                return 0
+            seq = [int(t) for t in tokens]
+            if self._alloc.lookup_prompt(prompt_key(seq)) is not None:
+                return self._max_pages + 1
+            n = 0
+            for kk in page_prefix_keys(seq, self._page):
+                if self._alloc.lookup_prefix(kk) is None:
+                    break
+                n += 1
+            return n
 
     def prefill_step(self) -> None:
         """Admission plus at most one prefill chunk, NO decode tick —
@@ -1375,20 +1556,25 @@ class GenerationServer:
         fleet: the router calls this until :meth:`prompt_ready`, then
         exports the KV and hands the request to a decode replica
         before a single token is decoded here."""
-        if not self._draining:
-            self._admit()
-        if self.paged:
-            self._prefill_pump()
-            metrics.get_registry().set_gauge(
-                "serving/pages_in_use", self._alloc.pages_in_use)
+        with self._surface_lock:
+            if self._closed:
+                return
+            if not self._draining:
+                self._admit()
+            if self.paged:
+                self._prefill_pump()
+                metrics.get_registry().set_gauge(
+                    "serving/pages_in_use", self._alloc.pages_in_use)
+        self._ship_spills()
 
     def prompt_ready(self, tokens: Sequence[int]) -> bool:
         """True when a finished prefill of exactly ``tokens`` sits in
         the prompt registry — i.e. :meth:`kv_export` would succeed."""
-        return bool(
-            self.paged and self._prefix_sharing and
-            self._alloc.lookup_prompt(
-                prompt_key([int(t) for t in tokens])) is not None)
+        with self._surface_lock:
+            return bool(
+                self.paged and self._prefix_sharing and
+                self._alloc.lookup_prompt(
+                    prompt_key([int(t) for t in tokens])) is not None)
 
     def kv_export(self, tokens: Sequence[int]):
         """Pin a finished prefill for handoff: look ``tokens`` up in
@@ -1397,32 +1583,37 @@ class GenerationServer:
         flight. Returns ``(pages, last_logits)`` or None on a miss;
         the caller must :meth:`kv_export_release` the pages once the
         peer holds a copy (or on any failure path)."""
-        if not self.paged:
-            return None
-        hit = self._alloc.lookup_prompt(
-            prompt_key([int(t) for t in tokens]))
-        if hit is None:
-            return None
-        pages, last = hit
-        for pid in pages:
-            self._alloc.retain(pid)
-        self._emit("serving_kv_export", pages=len(pages))
-        return list(pages), last
+        with self._surface_lock:
+            if not self.paged:
+                return None
+            hit = self._alloc.lookup_prompt(
+                prompt_key([int(t) for t in tokens]))
+            if hit is None:
+                return None
+            pages, last = hit
+            # one batched pin for the whole page set — the export
+            # half of the d2d handoff never loops the allocator
+            self._alloc.retain_many(pages)
+            self._emit("serving_kv_export", pages=len(pages))
+            return list(pages), last
 
     def kv_export_release(self, pages: Sequence[int]) -> None:
         """Drop the transfer references :meth:`kv_export` took (in
         tiered mode a registered page's last pin spills instead of
         freeing, keeping the exported prefix warm)."""
-        for pid in pages:
-            self._release_page(int(pid))
+        with self._surface_lock:
+            for pid in pages:
+                self._release_page(int(pid))
 
     def kv_page_data(self, pages: Sequence[int]):
         """Device-side gather of ``pages``' contents (KV plus int8
-        scale leaves) as a cache-shaped tree — hand it to a peer's
-        :meth:`kv_import` directly (same devices) or via
-        ``jax.device_get`` (host-staged, foreign mesh)."""
-        return gather_kv_pages(self._cache,
-                               jnp.asarray(list(pages), jnp.int32))
+        scale leaves) as a cache-shaped tree — ONE stacked dispatch
+        whatever the page count. Hand it to a peer's
+        :meth:`kv_import` directly (same devices, the d2d path) or
+        via ``jax.device_get`` (host-staged, foreign mesh)."""
+        with self._surface_lock:
+            return gather_kv_pages(self._cache,
+                                   jnp.asarray(list(pages), jnp.int32))
 
     def kv_import(self, tokens: Sequence[int], page_data,
                   last_logits, n_pages: int) -> bool:
@@ -1436,36 +1627,38 @@ class GenerationServer:
         request churn. False — caller falls back to plain re-prefill
         — when this server is not paged/sharing, the pool cannot host
         ``n_pages``, or the prompt is already resident."""
-        if not self.paged or not self._prefix_sharing:
-            return False
-        seq = [int(t) for t in tokens]
-        key = prompt_key(seq)
-        if self._alloc.lookup_prompt(key) is not None:
-            return False
-        if n_pages > self._max_pages or \
-                self._alloc.free_pages < n_pages:
-            return False
-        pids = [self._alloc.alloc() for _ in range(n_pages)]
-        self._cache = scatter_kv_pages(
-            self._cache, page_data, jnp.asarray(pids, jnp.int32))
-        for j, kk in enumerate(page_prefix_keys(seq, self._page)):
-            self._alloc.register_prefix(kk, pids[j])
-        self._alloc.register_prompt(
-            key, pids, np.asarray(last_logits, np.float32))
-        self._imports[key] = pids
-        self._emit("serving_kv_import", pages=n_pages)
-        return True
+        with self._surface_lock:
+            if not self.paged or not self._prefix_sharing:
+                return False
+            seq = [int(t) for t in tokens]
+            key = prompt_key(seq)
+            if self._alloc.lookup_prompt(key) is not None:
+                return False
+            if n_pages > self._max_pages or \
+                    self._alloc.free_pages < n_pages:
+                return False
+            pids = self._alloc.alloc_many(n_pages)
+            self._cache = scatter_kv_pages(
+                self._cache, page_data, jnp.asarray(pids, jnp.int32))
+            for j, kk in enumerate(page_prefix_keys(seq, self._page)):
+                self._alloc.register_prefix(kk, pids[j])
+            self._alloc.register_prompt(
+                key, pids, np.asarray(last_logits, np.float32))
+            self._imports[key] = pids
+            self._emit("serving_kv_import", pages=n_pages)
+            return True
 
     def kv_import_release(self, tokens: Sequence[int]) -> None:
         """Unpin an import once the handed-off request completed (or
         to evict a stale shared prefix): the registry entries fall
         away with the last reference. No-op on unknown keys."""
-        if not self.paged:
-            return
-        pids = self._imports.pop(
-            prompt_key([int(t) for t in tokens]), None)
-        for pid in pids or ():
-            self._release_page(pid)
+        with self._surface_lock:
+            if not self.paged:
+                return
+            pids = self._imports.pop(
+                prompt_key([int(t) for t in tokens]), None)
+            for pid in pids or ():
+                self._release_page(pid)
 
     # -- restart-persistent prefix store ------------------------------
     #
@@ -1506,18 +1699,38 @@ class GenerationServer:
             self._model_fp = h.hexdigest()[:16]
         return self._model_fp
 
+    def _await_spill_writer(self) -> None:
+        """Wait (bounded) for the writer to finish every shipped item
+        — the prefix-store export's quiesce point, replacing the old
+        queue join. Runs at an UNLOCKED position: the writer never
+        needs the surface lock, but waiting under it would still
+        stall a concurrently ticking fleet worker for the whole
+        device_get."""
+        deadline = time.monotonic() + self._SPILL_WAIT_S
+        with self._spill_lock:
+            while self._spill_outstanding > 0 and \
+                    time.monotonic() < deadline:
+                self._spill_lock.wait(timeout=0.05)
+
     def export_prefix_store(self) -> Optional[dict]:
         """Snapshot the host tier for a restart warm start: drain any
         pending spill pins first (a just-drained server's shareable
-        pages are still pinned), wait out the writer, and return page
-        bytes (flat numpy leaf lists in cache tree order) plus the
-        host-resident registry entries. None on non-tiered servers."""
-        if not self.paged or not self._tiered:
-            return None
-        self._drain_spills()
-        self._spill_q.join()
-        # the join flushed every publish AND every failure record —
-        # reap now so dead pages drop out of the snapshot
+        pages are still pinned), ship the batch and wait out the
+        writer, and return page bytes (flat numpy leaf lists in cache
+        tree order) plus the host-resident registry entries. None on
+        non-tiered servers."""
+        with self._surface_lock:
+            if not self.paged or not self._tiered:
+                return None
+            self._drain_spills()
+        self._ship_spills()
+        self._await_spill_writer()
+        with self._surface_lock:
+            return self._export_prefix_store_impl()
+
+    def _export_prefix_store_impl(self) -> dict:
+        # the writer quiesce flushed every publish AND every failure
+        # record — reap now so dead pages drop out of the snapshot
         self._reap_failed_spills()
         prefixes, prompts = self._alloc.host_snapshot()
         needed = set(prefixes.values())
@@ -1531,7 +1744,10 @@ class GenerationServer:
         store = {
             "page_size": self._page,
             "kv_cache_dtype": cfg.kv_cache_dtype,
-            "model_fingerprint": self._model_fingerprint(),
+            # cached at construction (tiered servers fingerprint
+            # eagerly) — the device_get inside _model_fingerprint
+            # must not run under the surface lock
+            "model_fingerprint": self._model_fp,
             "pages": {h: jax.tree_util.tree_leaves(t)
                       for h, t in data.items()},
             "prefixes": {k: h for k, h in prefixes.items()
@@ -1557,6 +1773,10 @@ class GenerationServer:
         geometry scatters cleanly but serves silently wrong
         attention, the one failure mode a disk round-trip across
         deploys invites. Returns the pages adopted."""
+        with self._surface_lock:
+            return self._import_prefix_store_impl(store)
+
+    def _import_prefix_store_impl(self, store: Optional[dict]) -> int:
         if not store or not self.paged or not self._tiered:
             return 0
         cfg = self.model.config
@@ -1568,7 +1788,7 @@ class GenerationServer:
                 store.get("page_size"), store.get("kv_cache_dtype"),
                 self._page, cfg.kv_cache_dtype)
             return 0
-        fp = self._model_fingerprint()
+        fp = self._model_fp
         if store.get("model_fingerprint") != fp:
             logger.warning(
                 "prefix store model fingerprint mismatch (%s vs %s): "
@@ -1624,11 +1844,24 @@ class GenerationServer:
 
         With ``device_loop_ticks > 1`` one call runs up to that many
         ticks in a single fused device program (:meth:`_step_loop`) —
-        same committed tokens, T× fewer host round-trips."""
-        if self._loop_ticks > 1:
-            out = self._step_loop()
-            self._refresh_health()
-            return out
+        same committed tokens, T× fewer host round-trips.
+
+        Thread-safe: the whole tick runs under the surface lock;
+        spill shipping (the one blocking queue put) happens after the
+        lock is released so the writer thread can never be fed from
+        inside the critical section."""
+        with self._surface_lock:
+            if self._closed:
+                return []
+            if self._loop_ticks > 1:
+                out = self._step_loop()
+                self._refresh_health()
+            else:
+                out = self._step_impl()
+        self._ship_spills()
+        return out
+
+    def _step_impl(self) -> List[Completion]:
         step_t0 = time.time()
         expired = self._expire_deadlines()
         if self._faults is not None:
@@ -2009,6 +2242,13 @@ class GenerationServer:
         everything at once. Partials re-enter a restarted paged server
         via ``submit(resume_tokens=...)`` with no committed token
         lost."""
+        with self._surface_lock:
+            out = self._drain_impl(max_ticks)
+        self._ship_spills()
+        return out
+
+    def _drain_impl(self, max_ticks: Optional[int]
+                    ) -> List[Completion]:
         if not self._draining:
             self._draining = True
             self._refresh_health()
@@ -2016,8 +2256,8 @@ class GenerationServer:
                        pending=self.pending, occupancy=self.occupancy)
         out: List[Completion] = self._flush_queue()
         ticks = 0
-        while self.occupancy and (max_ticks is None
-                                  or ticks < max_ticks):
+        while not self._closed and self.occupancy and \
+                (max_ticks is None or ticks < max_ticks):
             out.extend(self.step())
             ticks += 1
         for slot in range(self.num_slots):
@@ -2052,8 +2292,14 @@ class GenerationServer:
 
     def close(self) -> None:
         """Detach OS-level hooks: stop the watchdog and spill-writer
-        threads and restore a ``drain_on_sigterm`` handler.
-        Idempotent."""
+        threads and restore a ``drain_on_sigterm`` handler. Marks the
+        server closed — a racing step() from another thread returns
+        [] instead of touching torn-down state. Idempotent."""
+        with self._surface_lock:
+            self._closed = True
+        # last outboxed spills still reach the writer before the
+        # sentinel below shuts it down
+        self._ship_spills()
         if self._watchdog is not None:
             self._watchdog.stop()
         if self._tiered and self._spill_writer_thread is not None:
@@ -2072,8 +2318,8 @@ class GenerationServer:
         loop early with partials in place of unfinished requests."""
         ids = [self.submit(p) for p in prompts]
         done: Dict[int, Completion] = {}
-        while self._queue or self.occupancy:
-            if self._draining:
+        while self.pending or self.occupancy:
+            if self.draining:
                 for c in self.drain():
                     done[c.request_id] = c
                 break
@@ -2086,6 +2332,10 @@ class GenerationServer:
         server's lifetime so far (also emitted to the flight
         recorder). Paged servers add pool occupancy and the allocator
         sharing stats."""
+        with self._surface_lock:
+            return self._summary_impl()
+
+    def _summary_impl(self) -> dict:
         tps = self._decode_tokens / self._tick_time \
             if self._tick_time > 0 else 0.0
         s = {"slots": self.num_slots, "occupancy": self.occupancy,

@@ -19,6 +19,7 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...parallel.sharding import with_logical_constraint
 from .config import GPTConfig
@@ -1175,6 +1176,46 @@ def gather_kv_pages(cache, pids: jax.Array):
             return leaf[sel + (pids,)]
         return leaf
     return jax.tree_util.tree_map_with_path(g, cache)
+
+
+def split_kv_pages(page_data, num_pages: int):
+    """Split an N-page :func:`gather_kv_pages` tree into ``num_pages``
+    single-page trees (page axis ``ndim - 4`` of every KV leaf,
+    non-pool leaves shared). Pure indexing — it works on device
+    arrays and ``device_get``'d numpy alike, so the spill writer can
+    carve one batched host transfer back into per-page byte-store
+    entries (``core/serving.py``)."""
+    def cut(i):
+        def g(path, leaf):
+            name = getattr(path[-1], "key", "")
+            if name in ("cached_key", "cached_value",
+                        "cached_key_scale", "cached_value_scale"):
+                ax = leaf.ndim - 4
+                sel = (slice(None),) * ax
+                return leaf[sel + (slice(i, i + 1),)]
+            return leaf
+        return jax.tree_util.tree_map_with_path(g, page_data)
+    return [cut(i) for i in range(num_pages)]
+
+
+def stack_kv_pages(page_trees):
+    """Concatenate single-page trees back into one N-page tree along
+    the page axis — the inverse of :func:`split_kv_pages`, built so a
+    batched rehydrate issues ONE :func:`scatter_kv_pages` dispatch
+    for all N pages instead of N. Host-side concatenation (numpy):
+    the inputs are staged host pages and the single scatter uploads
+    the stacked result."""
+    if len(page_trees) == 1:
+        return page_trees[0]
+    def cat(path, *leaves):
+        name = getattr(path[-1], "key", "")
+        if name in ("cached_key", "cached_value",
+                    "cached_key_scale", "cached_value_scale"):
+            ax = leaves[0].ndim - 4
+            return np.concatenate(
+                [np.asarray(leaf) for leaf in leaves], axis=ax)
+        return leaves[0]
+    return jax.tree_util.tree_map_with_path(cat, *page_trees)
 
 
 @jax.jit

@@ -22,6 +22,7 @@ exact. ``tests/test_histogram.py`` pins the estimates against
 from __future__ import annotations
 
 import math
+import threading
 from typing import Any, Dict, Iterator, Tuple
 
 #: default range, tuned for millisecond-denominated latencies:
@@ -36,7 +37,7 @@ class LogHistogram:
     quantiles within one bucket's relative width."""
 
     __slots__ = ("lo", "hi", "ratio", "_log_ratio", "_n", "_counts",
-                 "_count", "_sum", "_min", "_max")
+                 "_count", "_sum", "_min", "_max", "_lock")
 
     def __init__(self, lo: float = _DEFAULT_LO,
                  hi: float = _DEFAULT_HI,
@@ -56,6 +57,11 @@ class LogHistogram:
         # everything past hi
         self._n = 1 + int(math.ceil(
             math.log(self.hi / self.lo) / self._log_ratio))
+        # the histogram synchronizes itself: the registry observes
+        # under its own lock, but always-on local registries (the
+        # fleet router's) are read from other threads too — reentrant
+        # because snapshot() walks quantile()/cumulative() inline
+        self._lock = threading.RLock()
         self._counts = [0] * (self._n + 1)
         self._count = 0
         self._sum = 0.0
@@ -75,40 +81,46 @@ class LogHistogram:
             idx = 1 + int(math.log(v / self.lo) / self._log_ratio)
             if idx > self._n:
                 idx = self._n
-        self._counts[idx] += 1
-        self._count += 1
-        self._sum += v
-        if v < self._min:
-            self._min = v
-        if v > self._max:
-            self._max = v
+        with self._lock:
+            self._counts[idx] += 1
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
 
     def reset(self) -> None:
         """Zero every bucket and the running stats, in place."""
-        self._counts = [0] * (self._n + 1)
-        self._count = 0
-        self._sum = 0.0
-        self._min = math.inf
-        self._max = -math.inf
+        with self._lock:
+            self._counts = [0] * (self._n + 1)
+            self._count = 0
+            self._sum = 0.0
+            self._min = math.inf
+            self._max = -math.inf
 
     # -- reading -------------------------------------------------------
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def sum(self) -> float:
-        return self._sum
+        with self._lock:
+            return self._sum
 
     @property
     def min(self) -> float:
         """Smallest observed sample (``inf`` when empty)."""
-        return self._min
+        with self._lock:
+            return self._min
 
     @property
     def max(self) -> float:
         """Largest observed sample (``-inf`` when empty)."""
-        return self._max
+        with self._lock:
+            return self._max
 
     def bounds(self, idx: int) -> Tuple[float, float]:
         """``(lower, upper)`` value bounds of bucket ``idx``."""
@@ -121,28 +133,30 @@ class LogHistogram:
         """Estimated value at quantile ``q`` in [0, 1]; 0.0 when the
         histogram is empty. Monotonic in ``q``; exact at 0 and 1
         (clamped to the observed min/max)."""
-        if self._count == 0:
-            return 0.0
-        q = min(max(float(q), 0.0), 1.0)
-        # rank of the target sample among count samples (midpoint
-        # convention keeps single-sample histograms exact)
-        target = q * (self._count - 1)
-        cum = 0
-        for idx, c in enumerate(self._counts):
-            if c == 0:
-                continue
-            if cum + c > target:
-                lower, upper = self.bounds(idx)
-                if idx == 0:
-                    est = self.lo / 2.0
-                else:
-                    # geometric interpolation inside the bucket: the
-                    # error bound is the bucket's relative width
-                    frac = (target - cum + 0.5) / c
-                    est = lower * (upper / lower) ** min(frac, 1.0)
-                return min(max(est, self._min), self._max)
-            cum += c
-        return self._max
+        with self._lock:
+            if self._count == 0:
+                return 0.0
+            q = min(max(float(q), 0.0), 1.0)
+            # rank of the target sample among count samples (midpoint
+            # convention keeps single-sample histograms exact)
+            target = q * (self._count - 1)
+            cum = 0
+            for idx, c in enumerate(self._counts):
+                if c == 0:
+                    continue
+                if cum + c > target:
+                    lower, upper = self.bounds(idx)
+                    if idx == 0:
+                        est = self.lo / 2.0
+                    else:
+                        # geometric interpolation inside the bucket:
+                        # the error bound is the bucket's relative
+                        # width
+                        frac = (target - cum + 0.5) / c
+                        est = lower * (upper / lower) ** min(frac, 1.0)
+                    return min(max(est, self._min), self._max)
+                cum += c
+            return self._max
 
     def percentile(self, p: float) -> float:
         """``quantile(p / 100)`` — the ``np.percentile`` spelling."""
@@ -151,28 +165,30 @@ class LogHistogram:
     def cumulative(self) -> Iterator[Tuple[float, int]]:
         """``(upper_bound, cumulative_count)`` over non-empty buckets,
         ascending — the Prometheus ``le`` bucket series."""
-        cum = 0
-        for idx, c in enumerate(self._counts):
-            if c == 0:
-                continue
-            cum += c
-            yield self.bounds(idx)[1], cum
+        with self._lock:
+            cum = 0
+            for idx, c in enumerate(self._counts):
+                if c == 0:
+                    continue
+                cum += c
+                yield self.bounds(idx)[1], cum
 
     def snapshot(self) -> Dict[str, Any]:
         """Point-in-time summary dict (count/sum/min/max + p50/p90/p99
         + cumulative ``buckets``), the shape the registry snapshot,
         ``/vars``, and the Prometheus exporter consume — exporters on
         other threads read this copy, never the live bucket arrays."""
-        if self._count == 0:
-            return {"count": 0, "sum": 0.0, "buckets": []}
-        return {
-            "count": self._count,
-            "sum": round(self._sum, 6),
-            "min": round(self._min, 6),
-            "max": round(self._max, 6),
-            "p50": round(self.quantile(0.50), 6),
-            "p90": round(self.quantile(0.90), 6),
-            "p99": round(self.quantile(0.99), 6),
-            "buckets": [[upper, cum]
-                        for upper, cum in self.cumulative()],
-        }
+        with self._lock:
+            if self._count == 0:
+                return {"count": 0, "sum": 0.0, "buckets": []}
+            return {
+                "count": self._count,
+                "sum": round(self._sum, 6),
+                "min": round(self._min, 6),
+                "max": round(self._max, 6),
+                "p50": round(self.quantile(0.50), 6),
+                "p90": round(self.quantile(0.90), 6),
+                "p99": round(self.quantile(0.99), 6),
+                "buckets": [[upper, cum]
+                            for upper, cum in self.cumulative()],
+            }

@@ -36,9 +36,11 @@ With ``--fleet`` a serving leg drills the fleet's availability story
 (docs/fleet_serving.md) in-process: two paged interpret-mode
 GenerationServer replicas — tiered, with a pinned-host spill pool and
 the router's ``prefix_store_dir`` round-tripping each dying replica's
-prefix store through disk — behind a FleetRouter serve a
-shared-prefix trace while EVERY replica is rolling-restarted
-mid-stream. Asserted: every completion is token-identical to the
+prefix store through disk — behind an ``async_workers=True``
+FleetRouter (each replica served from its own worker thread,
+docs/fleet_serving.md "Async router") serve a shared-prefix trace
+while EVERY replica is rolling-restarted mid-stream under the
+overlapped load. Asserted: every completion is token-identical to the
 single-batch lockstep reference (zero dropped committed tokens),
 nothing was shed (the peer always had capacity), at least one request
 actually failed over, and events.jsonl ALONE reconstructs one trace
@@ -62,6 +64,7 @@ import signal
 import subprocess
 import sys
 import tempfile
+import time
 
 import numpy as np
 
@@ -278,10 +281,11 @@ def ptq_leg(work, chaos_out, cfg_path):
 
 def fleet_leg(work):
     """In-process fleet drill: rolling-restart a 2-replica tiered
-    fleet mid-stream and prove zero token loss + trace continuity
-    from the event log alone, then a warm second wave that must
-    rehydrate from the restart-persisted prefix store before it
-    prefills anything."""
+    ASYNC fleet mid-stream — each replica serving from its own worker
+    thread, so the restart happens under genuinely overlapped load —
+    and prove zero token loss + trace continuity from the event log
+    alone, then a warm second wave that must rehydrate from the
+    restart-persisted prefix store before it prefills anything."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.setdefault("PFX_PALLAS_INTERPRET", "1")
     sys.path.insert(0, REPO)
@@ -340,10 +344,17 @@ def fleet_leg(work):
 
     stores = os.path.join(work, "fleet_stores")
     fleet = FleetRouter(factory, 2, events_path=events,
-                        prefix_store_dir=stores)
+                        prefix_store_dir=stores,
+                        async_workers=True)
     gids = [fleet.submit(p) for p in prompts]
     done = {}
-    for _ in range(3):                  # commit some tokens first
+    # commit some tokens first — with async workers the router tick
+    # commits nothing itself, so poll until the worker threads have
+    # decoded mid-stream state worth failing over (~1 token/request)
+    deadline = time.monotonic() + 120.0
+    while (fleet.summary()["decode_tokens"] < len(prompts)
+           and len(done) < len(prompts)
+           and time.monotonic() < deadline):
         for c in fleet.step():
             done[c.request_id] = c
     # the drill: EVERY replica goes down in turn while serving
@@ -372,6 +383,9 @@ def fleet_leg(work):
     if summ["restarts"] != 2:
         fail(f"expected 2 replica restarts, recorded "
              f"{summ['restarts']}")
+    if not summ.get("async_workers"):
+        fail("fleet leg ran lockstep — the drill must restart "
+             "replicas under overlapped worker-thread load")
 
     # trace continuity, reconstructed from events.jsonl ALONE
     with open(events) as f:
@@ -440,8 +454,9 @@ def fleet_leg(work):
              f"served from the host tier first")
 
     sys.stdout.write(
-        f"FLEET LEG OK: rolling restart of 2 tiered replicas under "
-        f"load — {len(gids)} requests lockstep-exact, shed=0, "
+        f"FLEET LEG OK: rolling restart of 2 tiered ASYNC replicas "
+        f"under overlapped load — {len(gids)} requests "
+        f"lockstep-exact, shed=0, "
         f"failovers={summ['failovers']}, per-request traces "
         f"reconstruct from {os.path.basename(events)}; warm wave "
         f"re-served {len(gids2)} prompts with {rehydrates} "

@@ -58,6 +58,9 @@ def main() -> int:
     ap.add_argument("--prefix-len", type=int, default=128)
     ap.add_argument("--tail-len", type=int, default=16)
     ap.add_argument("--max-dec-len", type=int, default=16)
+    ap.add_argument("--async-workers", action="store_true",
+                    help="overlapped per-replica worker threads "
+                         "(docs/fleet_serving.md \"Async router\")")
     ap.add_argument("--rolling-restart", action="store_true",
                     help="restart every replica mid-run (drain -> "
                          "failover -> fresh server)")
@@ -107,7 +110,8 @@ def main() -> int:
     fleet = FleetRouter(factory, args.replicas,
                         prefill_replicas=args.prefill_replicas,
                         events_path=args.events or None,
-                        handoff=args.handoff)
+                        handoff=args.handoff,
+                        async_workers=args.async_workers)
     prompts = build_trace(args.requests, args.prefixes,
                           args.prefix_len, args.tail_len, vocab,
                           args.seed)

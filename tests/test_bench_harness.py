@@ -715,15 +715,16 @@ def test_bench_serving_env_knobs_pin_trace(monkeypatch, capsys):
 
 def test_bench_fleet_runs_offline(monkeypatch, capsys):
     """The fleet bench's tiny CPU path must execute end to end and
-    emit the pinned A/B pair — the same-chips single-server baseline
-    row first, then the 2-replica router headline with the fleet-level
-    TTFT percentiles and router counters (the same record shapes the
-    on-chip 345M run emits)."""
+    emit the pinned A/B/C triple — the same-chips single-server
+    baseline row first, then the 2-replica lockstep router headline
+    with the fleet-level TTFT percentiles and router counters, then
+    the async-router A/B row (the same record shapes the on-chip
+    345M run emits)."""
     monkeypatch.setenv("PFX_BENCH_FLEET_REQUESTS", "4")
     bench.bench_fleet()
     lines = capsys.readouterr().out.strip().splitlines()
     recs = [json.loads(ln) for ln in lines if ln.startswith("{")]
-    base, rec = recs[-2], recs[-1]
+    base, rec, arec = recs[-3], recs[-2], recs[-1]
     assert base["metric"] == \
         ("gpt345m_fleet_single_server_baseline_decode"
          "_tokens_per_sec_per_chip")
@@ -746,12 +747,41 @@ def test_bench_fleet_runs_offline(monkeypatch, capsys):
     assert rec["shed"] == 0
     assert rec["baseline_single_server_tokens_per_sec"] == \
         base["value"]
+    # async A/B row: same trace replayed through the overlapped
+    # router, self-describing against the lockstep headline
+    assert arec["metric"] == \
+        ("gpt345m_fleet_2replica_async_decode"
+         "_tokens_per_sec_per_chip")
+    assert arec["value"] > 0 and arec["unit"] == "tokens/s"
+    assert arec["async_workers"] is True
+    assert arec["replicas"] == 2 and arec["shed"] == 0
+    assert arec["lockstep_tokens_per_sec"] == rec["value"]
+    assert arec["speedup_vs_lockstep"] == pytest.approx(
+        arec["value"] / rec["value"], rel=5e-2)
+    assert "handoff_p99_ms" in arec and "handoff_d2d" in arec
+
+
+def test_bench_fleet_async_knob_off(monkeypatch, capsys):
+    """PFX_BENCH_FLEET_ASYNC=0 suppresses the async A/B row, leaving
+    the original baseline + lockstep pair as the last two records."""
+    monkeypatch.setenv("PFX_BENCH_FLEET_REQUESTS", "3")
+    monkeypatch.setenv("PFX_BENCH_FLEET_DEC_LEN", "4")
+    monkeypatch.setenv("PFX_BENCH_FLEET_ASYNC", "0")
+    bench.bench_fleet()
+    lines = capsys.readouterr().out.strip().splitlines()
+    recs = [json.loads(ln) for ln in lines if ln.startswith("{")]
+    assert recs[-1]["metric"] == bench.METRIC_BY_MODE["fleet"]
+    assert recs[-2]["metric"] == \
+        ("gpt345m_fleet_single_server_baseline_decode"
+         "_tokens_per_sec_per_chip")
+    assert not any("async" in r.get("metric", "") for r in recs)
 
 
 def test_bench_fleet_knobs(monkeypatch, capsys):
     """PFX_BENCH_FLEET_REPLICAS / PFX_BENCH_FLEET_PREFILL_SPLIT pin
     the fleet shape and are echoed back; split mode actually moves
-    every prompt through the KV handoff path."""
+    every prompt through the KV handoff path — in both the lockstep
+    headline and the async A/B row."""
     monkeypatch.setenv("PFX_BENCH_FLEET_REPLICAS", "2")
     monkeypatch.setenv("PFX_BENCH_FLEET_PREFILL_SPLIT", "1")
     monkeypatch.setenv("PFX_BENCH_FLEET_REQUESTS", "3")
@@ -759,13 +789,18 @@ def test_bench_fleet_knobs(monkeypatch, capsys):
     bench.bench_fleet()
     lines = capsys.readouterr().out.strip().splitlines()
     recs = [json.loads(ln) for ln in lines if ln.startswith("{")]
-    rec = recs[-1]
+    rec, arec = recs[-2], recs[-1]
     assert rec["replicas"] == 2 and rec["prefill_split"] is True
     assert rec["max_dec_len"] == 4 and rec["requests"] == 3
     # warm + measured pass: every request prefilled on the prefill
     # replica and handed its KV pages to the decode replica
     assert rec["handoffs"] >= 3
     assert rec["shed"] == 0 and rec["value"] > 0
+    # the async row rides the same split shape and the default
+    # device handoff stays device-to-device end to end
+    assert arec["prefill_split"] is True and arec["handoffs"] >= 3
+    assert arec["handoff_d2d"] >= 3 and arec["handoff_host"] == 0
+    assert arec["handoff_p99_ms"] > 0
 
 
 def test_bench_serving_kv_dtype_ab_record(monkeypatch, capsys):

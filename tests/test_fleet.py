@@ -186,6 +186,118 @@ def test_fleet_failover_parity(model_and_params, make_cfg):
     fleet.close()
 
 
+# -- async router: overlapped worker ticks, identical tokens -----------
+
+
+@pytest.mark.parametrize("tiered", [False, True],
+                         ids=["plain", "tiered"])
+@pytest.mark.parametrize("failover", [False, True],
+                         ids=["steady", "failover"])
+@pytest.mark.parametrize("make_cfg", [_greedy_cfg, _sampling_cfg],
+                         ids=["greedy", "sampled"])
+def test_fleet_async_parity_matrix(model_and_params,
+                                   paged512_model_and_params,
+                                   make_cfg, failover, tiered):
+    """The async acceptance pin: an ``async_workers=True`` fleet —
+    every replica ticking on its own worker thread, interleaving
+    however the scheduler pleases — produces token-identical output
+    to the lockstep fleet AND to a single server, greedy and sampled,
+    with and without a mid-run rolling restart, with and without the
+    tiered host pool underneath."""
+    gen_cfg = make_cfg(max_dec=4)
+    if tiered:
+        model, params = paged512_model_and_params
+        rng = np.random.default_rng(21)
+        system = rng.integers(0, EOS, 130).tolist()
+        prompts = [system + rng.integers(0, EOS, 7 + i).tolist()
+                   for i in range(4)]
+        kw = dict(page_size=128, pool_pages=5,
+                  prefill_chunk_pages=1, prefix_sharing=True,
+                  host_pool_bytes=1 << 20)
+        single = GenerationServer(model, params, gen_cfg,
+                                  num_slots=4,
+                                  rng=jax.random.PRNGKey(7),
+                                  page_size=128, pool_pages=64,
+                                  prefill_chunk_pages=1,
+                                  prefix_sharing=True)
+    else:
+        model, params = model_and_params
+        prompts = PROMPTS
+        kw = {}
+        single = GenerationServer(model, params, gen_cfg,
+                                  num_slots=6,
+                                  rng=jax.random.PRNGKey(7))
+    ref = [c.tokens for c in single.run(prompts)]
+    single.close()
+    factory = _mixed_factory(model, params, gen_cfg, **kw)
+
+    def serve(async_workers):
+        fleet = FleetRouter(factory, 2, async_workers=async_workers)
+        ids = [fleet.submit(p) for p in prompts]
+        done = {}
+        if failover:
+            for _ in range(2):
+                for c in fleet.step():
+                    done[c.request_id] = c
+            for c in fleet.restart_replica(0):
+                done[c.request_id] = c
+        _drain_fleet(fleet, done)
+        summ = fleet.summary()
+        fleet.close()
+        return [done[i].tokens for i in ids], summ
+
+    lock_toks, _ = serve(async_workers=False)
+    async_toks, summ = serve(async_workers=True)
+    assert lock_toks == ref
+    assert async_toks == ref
+    assert summ["async_workers"] is True and summ["shed"] == 0
+    if failover:
+        assert summ["restarts"] == 1
+
+
+def test_fleet_async_trace_span_ordering(model_and_params, tmp_path):
+    """Trace reconstruction under interleaved worker ticks: the
+    recorder's per-request story must stay causally ordered even
+    though replica ticks come from N threads — for every request
+    trace, the fleet/route span opens before any serving/request
+    lifetime, every span's begin precedes its end, and the first
+    serving/first_token point lands inside its request lifetime."""
+    model, params = model_and_params
+    gen_cfg = _greedy_cfg()
+    events = tmp_path / "events.jsonl"
+
+    def factory(name):
+        return GenerationServer(model, params, gen_cfg, num_slots=2,
+                                rng=jax.random.PRNGKey(7),
+                                events_path=str(events))
+
+    fleet = FleetRouter(factory, 2, events_path=str(events),
+                        async_workers=True)
+    ids = [fleet.submit(p) for p in PROMPTS]
+    done = _drain_fleet(fleet, {})
+    fleet.close()
+    assert set(done) == set(ids)
+    evs = read_events(str(events))
+    traces = {done[i].trace_id for i in ids}
+    assert len(traces) == len(ids)
+    for tid in traces:
+        tevs = [(n, e) for n, e in enumerate(evs)
+                if e.get("trace") == tid]
+        routes = [n for n, e in tevs if e["event"] == "span_begin"
+                  and e["name"] == "fleet/route"]
+        req_begins = [n for n, e in tevs
+                      if e["event"] == "span_begin"
+                      and e["name"] == "serving/request"]
+        req_ends = [n for n, e in tevs if e["event"] == "span_end"
+                    and e["name"] == "serving/request"]
+        firsts = [n for n, e in tevs if e["event"] == "span_point"
+                  and e["name"] == "serving/first_token"]
+        assert len(routes) == 1
+        assert len(req_begins) == len(req_ends) == 1
+        assert routes[0] < req_begins[0] < req_ends[0]
+        assert firsts and req_begins[0] < firsts[0] < req_ends[0]
+
+
 # -- prefill/decode disaggregation -------------------------------------
 
 
@@ -218,6 +330,67 @@ def test_fleet_split_handoff_parity(paged512_model_and_params,
         rep.server._alloc.check()
         assert rep.server._alloc.pages_in_use == 0
     fleet.close()
+
+
+def test_fleet_async_d2d_handoff_smoke(paged512_model_and_params,
+                                       tmp_path, monkeypatch):
+    """CI smoke (`-k smoke`), async d2d edition: a 1 prefill + 1
+    decode ASYNC fleet moves every KV handoff device-to-device with
+    ZERO host copies — `jax.device_get` never runs for a handoff (the
+    handoff-writer thread stays idle and is counted), the d2d/host
+    counters split 3/0, the handoff histogram fills, no
+    `serving_spill`-style host staging appears on the trace, and the
+    tokens still equal the lockstep rows. events.jsonl lands under
+    tmp_path for CI's failure-diagnostics artifact."""
+    model, params = paged512_model_and_params
+    gen_cfg = _greedy_cfg()
+    prompts = _long_prompts()
+    ref = _lockstep(model, params, prompts, gen_cfg)
+    events = tmp_path / "events.jsonl"
+    host_copies = []
+    real = jax.device_get
+
+    def counting_get(x):
+        import threading as _t
+        name = _t.current_thread().name
+        if name.startswith("fleet-"):
+            host_copies.append(name)
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counting_get)
+
+    def factory(name):
+        return GenerationServer(model, params, gen_cfg, num_slots=2,
+                                rng=jax.random.PRNGKey(7),
+                                page_size=128, pool_pages=17,
+                                prefill_chunk_pages=1,
+                                events_path=str(events))
+
+    fleet = FleetRouter(factory, 2, prefill_replicas=1,
+                        handoff="device", async_workers=True,
+                        events_path=str(events))
+    comps = fleet.run(prompts)
+    summ = fleet.summary()
+    fleet.close()
+    assert [c.tokens for c in comps] == ref
+    assert summ["handoffs"] == 3
+    assert summ["handoff_d2d"] == 3      # every handoff stayed d2d
+    assert summ["handoff_host"] == 0
+    assert summ["handoff_p99_ms"] >= summ["handoff_p50_ms"] > 0
+    assert not host_copies               # zero host copies, any thread
+    for rep in fleet.replicas:
+        rep.server.check_alloc()        # the surface-locked spelling
+        assert rep.server._alloc.pages_in_use == 0
+    evs = read_events(str(events))
+    kinds = {e["event"] for e in evs}
+    assert "fleet_handoff" in kinds
+    # no host staging anywhere near the handoff trace: neither the
+    # fleet's staging stage nor a serving-side spill ever fired
+    assert "fleet_handoff_staged" not in kinds
+    assert "serving_spill" not in kinds
+    for e in evs:
+        if e["event"] == "fleet_handoff":
+            assert e["mode"] == "device"
 
 
 def test_fleet_split_handoff_int8_scales(paged512_model_and_params):

@@ -913,18 +913,44 @@ def test_serving_spill_lock_mutation_trips_gate():
                encoding="utf-8").read()
     sources = {"paddlefleetx_tpu/core/serving.py": srv,
                "paddlefleetx_tpu/observability/server.py": obs}
-    guarded = ("                with self._spill_lock:\n"
-               "                    self._host_data[hpid] = "
-               "(gen, host)\n")
+    guarded = ("            with self._spill_lock:\n"
+               "                for (hpid, gen), page in "
+               "zip(entries, pages):\n")
     assert guarded in srv, "spill writer lost its _spill_lock guard?"
     mutated = srv.replace(
         guarded,
-        "                if True:\n"
-        "                    self._host_data[hpid] = (gen, host)\n")
+        "            if True:\n"
+        "                for (hpid, gen), page in "
+        "zip(entries, pages):\n")
     sources["paddlefleetx_tpu/core/serving.py"] = mutated
     keys = {f.key for f in run_rules(_ctx(sources),
                                      select={"PFX301"})}
     assert any("_host_data" in k for k in keys), keys
+
+
+def test_fleet_snapshot_lock_mutation_trips_gate():
+    """Async-fleet pin: worker threads read replica slots through
+    ``_snapshot``/``_replica`` under ``_health_lock`` while
+    ``restart_replica`` swaps entries under the same lock on the
+    router thread — dropping the guards must re-race ``replicas``
+    (PFX301)."""
+    flt = open(os.path.join(REPO, "paddlefleetx_tpu", "core",
+                            "fleet.py"), encoding="utf-8").read()
+    srv = open(os.path.join(REPO, "paddlefleetx_tpu", "core",
+                            "serving.py"), encoding="utf-8").read()
+    obs = open(os.path.join(REPO, "paddlefleetx_tpu",
+                            "observability", "server.py"),
+               encoding="utf-8").read()
+    sources = {"paddlefleetx_tpu/core/fleet.py": flt,
+               "paddlefleetx_tpu/core/serving.py": srv,
+               "paddlefleetx_tpu/observability/server.py": obs}
+    assert run_rules(_ctx(sources), select={"PFX301"}) == []
+    mutated = flt.replace("with self._health_lock:", "if True:")
+    assert mutated != flt, "fleet.py lost its _health_lock guards?"
+    sources["paddlefleetx_tpu/core/fleet.py"] = mutated
+    keys = {f.key for f in run_rules(_ctx(sources),
+                                     select={"PFX301"})}
+    assert any("replicas" in k for k in keys), keys
 
 
 def test_metrics_registry_lock_mutation_trips_gate():
