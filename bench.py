@@ -66,6 +66,7 @@ import optax  # noqa: E402
 from paddlefleetx_tpu.models.gpt import (  # noqa: E402
     GPTConfig, GPTForPretraining, cross_entropy_loss,
 )
+from paddlefleetx_tpu.observability import timeline  # noqa: E402
 
 BASELINE_TOKENS_PER_SEC = 16200.0
 HEADLINE_METRIC = "gpt345m_pretrain_tokens_per_sec_per_chip"
@@ -206,6 +207,10 @@ def _failure_record(kind: str, detail: str) -> str:
         "vs_baseline": None, "error_kind": kind,
         "error": detail[-2000:],
     }
+    if kind == "backend_unavailable":
+        # an environment outage, not a code regression — trajectory
+        # tooling must not read this round as a perf cliff
+        rec["outage"] = True
     if recorder is not None:
         # the run's last recorded breadcrumbs ride inside the failure
         # record, so the driver-side report shows WHAT the bench was
@@ -223,6 +228,8 @@ def _emit_failure(kind: str, detail: str, rc: int = 1):
         # append it to the audit trail like any other on-chip result
         rec = dict(_headline_result)
         rec["secondaries_interrupted"] = detail[-300:]
+        if kind == "backend_unavailable":
+            rec["outage"] = True
         _log_success(rec)
         print(json.dumps(rec))
         sys.stdout.flush()
@@ -416,7 +423,11 @@ def _init_main_backend(probe_timeout: float = None):
     done = threading.Event()
 
     def _watchdog():
-        if not done.wait(probe_timeout):
+        tl = timeline.track("bench-backend-watchdog")
+        t0 = tl.begin()
+        expired = not done.wait(probe_timeout)
+        tl.add("wait", t0)
+        if expired:
             print(_failure_record(
                 "backend_unavailable",
                 f"main-process backend init hung "
@@ -1566,10 +1577,14 @@ def bench_fleet():
     async-vs-lockstep A/B: overlapped worker ticks divide by the
     slowest replica's decode time instead of the sum, and the record
     carries ``speedup_vs_lockstep`` plus the d2d/host handoff
-    counters and ``handoff_p99_ms``."""
+    counters and ``handoff_p99_ms``.  The thread-timeline recorder
+    (observability/timeline.py) runs for both fleet rows, so each
+    carries ``overlap_ratio`` (1/N under lockstep, toward 1 under
+    async — WHY the A/B wins) and per-thread utilization."""
     from paddlefleetx_tpu.core.fleet import FleetRouter
     from paddlefleetx_tpu.core.serving import GenerationServer
     from paddlefleetx_tpu.models.gpt.generation import GenerationConfig
+    timeline.set_enabled(True)
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu:
         cfg = _gpt345m(True)
@@ -1675,6 +1690,7 @@ def bench_fleet():
         "baseline_single_server_tokens_per_sec": round(base_tps, 1),
         "speedup_vs_single_server": round(fleet_tps / base_tps, 3)
         if base_tps > 0 else None,
+        "overlap_ratio": fleet_total.get("overlap_ratio"),
     }
     _log_success(result)
     print(json.dumps(result))
@@ -1705,6 +1721,10 @@ def bench_fleet():
             "lockstep_tokens_per_sec": round(fleet_tps, 1),
             "speedup_vs_lockstep": round(async_tps / fleet_tps, 3)
             if fleet_tps > 0 else None,
+            "overlap_ratio": async_total.get("overlap_ratio"),
+            "lockstep_overlap_ratio":
+                fleet_total.get("overlap_ratio"),
+            "thread_util": async_total.get("thread_util"),
         }
         _log_success(async_rec)
         print(json.dumps(async_rec))

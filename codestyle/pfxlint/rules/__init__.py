@@ -43,13 +43,13 @@ def resolve_call(ctx, fn, call: ast.Call):
 
 from . import (counters, docstrings, donation, fallbacks,   # noqa: E402
                host_sync, knobs, locks, nondeterminism, races,
-               silent_except, tracer_branch, tracer_escape)
+               silent_except, timeline, tracer_branch, tracer_escape)
 
 #: ordered registry; docs/static_analysis.md mirrors this table
 ALL_RULES = [
     host_sync, nondeterminism, tracer_branch,
     donation, tracer_escape,
-    races, locks,
+    races, locks, timeline,
     counters, knobs, fallbacks, silent_except, docstrings,
 ]
 

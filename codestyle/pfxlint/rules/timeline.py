@@ -1,0 +1,93 @@
+"""PFX304 — a thread entrypoint without a timeline track.
+
+Every ``threading.Thread``/``Timer`` target the thread graph
+enumerates (``threadgraph.thread_roots``) is a long-lived flow of
+wall-clock time the per-thread timeline
+(``paddlefleetx_tpu/observability/timeline.py``) exists to attribute.
+A spawned entrypoint that never registers a track is a blind spot:
+its time shows up nowhere in the ``/timeline`` view or the Perfetto
+export, and the fleet ``overlap_ratio`` silently under-counts. The
+rule walks the resolved call closure of each ``thread:`` root looking
+for a reachable ``timeline.track(...)`` /
+``ThreadTimeline.track(...)`` call and fires on roots that never get
+there.
+
+HTTP-handler contexts (``http:`` roots — every method of a
+``BaseHTTPRequestHandler`` subclass) are exempt: per-request threads
+are covered by instrumenting the shared dispatch path (the metrics
+server's ``_handle`` registers the ``pfx-metrics`` track), and
+holding every tiny ``do_GET``/``log_message`` override to a
+registration of its own would be noise, not coverage.
+
+The finding anchors on the root function's ``def`` line; its stable
+key is the root qualname, so the fingerprint survives edits that move
+the function.
+"""
+
+from __future__ import annotations
+
+from typing import List, Set
+
+from ..engine import Finding
+
+CODES = ("PFX304",)
+
+#: function-name suffixes (after the ``mod:`` split) that register a
+#: timeline track
+_TRACK_FNS = {"track", "ThreadTimeline.track"}
+
+
+def _is_track_call(qual: str) -> bool:
+    """Whether a resolved callee qualname is the timeline module's
+    track registration (matched by module basename so the in-memory
+    fixture trees of the test suite count too)."""
+    if ":" not in qual:
+        return False
+    mod, name = qual.split(":", 1)
+    return mod.rsplit(".", 1)[-1] == "timeline" and name in _TRACK_FNS
+
+
+def _reaches_track(tg, root: str) -> bool:
+    """BFS over the resolved call edges from ``root``."""
+    seen: Set[str] = {root}
+    stack = [root]
+    while stack:
+        qual = stack.pop()
+        for nxt in tg._edges(qual):
+            if _is_track_call(nxt):
+                return True
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append(nxt)
+    return False
+
+
+def check(ctx) -> List[Finding]:
+    """PFX304 over every ``thread:`` root the thread graph found.
+
+    Args:
+        ctx: the lint context (thread graph already built).
+
+    Returns:
+        One finding per uninstrumented thread entrypoint.
+    """
+    tg = ctx.threadgraph
+    findings: List[Finding] = []
+    for root, label in sorted(tg.thread_roots.items()):
+        if not label.startswith("thread:"):
+            continue
+        if _reaches_track(tg, root):
+            continue
+        fn = ctx.callgraph.functions.get(root)
+        if fn is None:
+            continue
+        findings.append(Finding(
+            path=fn.path, line=fn.node.lineno, code="PFX304",
+            message=(
+                f"thread entrypoint `{root.split(':', 1)[1]}` never "
+                f"registers a timeline track — call "
+                f"`observability.timeline.track(<name>)` at loop "
+                f"start so the thread's time is attributable "
+                f"(docs/observability.md, Thread timeline)"),
+            key=root))
+    return findings

@@ -87,6 +87,11 @@ _THREADSAFE_FACTORIES = {
     "queue.SimpleQueue": "Queue",
     "queue.LifoQueue": "Queue",
     "queue.PriorityQueue": "Queue",
+    # deque append/popleft/iteration-copy are single GIL-atomic C
+    # calls (CPython documents deques as thread-safe for these); the
+    # timeline ring buffers (observability/timeline.py) ride exactly
+    # this, writer-appends racing snapshot copies without a lock
+    "collections.deque": "Deque",
 }
 
 #: thread-spawning callables whose function argument runs off-main

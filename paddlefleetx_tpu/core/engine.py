@@ -39,6 +39,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..observability import flops as obs_flops
 from ..observability import metrics as obs_metrics
 from ..observability import server as obs_server
+from ..observability import timeline as obs_timeline
 from ..observability.memory import device_memory_stats, format_bytes
 from ..observability.recorder import FlightRecorder
 from ..observability.spans import NULL_SPAN, Tracer
@@ -744,6 +745,9 @@ class Engine(BasicEngine):
                          valid_data_loader=None):
         step_start = time.time()
         window_clean = True
+        # the training loop's own timeline track — "main" next to the
+        # watchdog/loader/server rows in the merged Perfetto view
+        tl = obs_timeline.track("main")
         # host-side mirror of state["step"]: reading the device scalar
         # every iteration would sync and kill async dispatch
         step = self._host_step
@@ -761,6 +765,7 @@ class Engine(BasicEngine):
                     self._watchdog.arm(tag=f"step {step + 1}")
                 step_span = self._fit_span.start_span(
                     "engine/step", step=step + 1)
+                tl_t0 = tl.begin()
                 t_call = time.time()
                 with annotate("train_step"):
                     self.state, metrics = self._train_step(
@@ -828,6 +833,7 @@ class Engine(BasicEngine):
                             hbm=mem)
                     window_clean = True
                     step_start = time.time()
+                tl.add("step", tl_t0)
                 step_span.end()
                 if self.run_mode == "step" and \
                         step % self.eval_freq == 0 and \

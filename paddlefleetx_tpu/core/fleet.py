@@ -92,6 +92,7 @@ import jax
 
 from ..observability import metrics
 from ..observability import server as obs_server
+from ..observability import timeline
 from ..observability.recorder import FlightRecorder
 from ..observability.spans import Tracer
 from ..utils.log import logger
@@ -199,6 +200,16 @@ class FleetRouter:
         # fleet-level latency histogram lives in an always-on local
         # registry, same discipline as the per-server ones
         self._metrics = metrics.MetricsRegistry(enabled=True)
+        # thread-timeline wiring: the router registers its own track
+        # up front; summary() scopes the global snapshot to this
+        # router's lifetime so sequential routers in one process don't
+        # read each other's intervals
+        self._t0 = time.time()
+        self._tl = timeline.track("fleet-router")
+        #: worker park stamps (router thread only): park start
+        #: monotonic time, consumed by _unpark_worker into the
+        #: fleet/park_ms histogram
+        self._park_t0: Dict[int, float] = {}
         self._events_path = events_path
         self._recorder = FlightRecorder(events_path) if events_path \
             else None
@@ -422,27 +433,43 @@ class FleetRouter:
         runs under that server's surface lock.  A set pause flag
         parks the loop outside the server (``_quiet`` acknowledges),
         which is how restart_replica gets exclusive drain access."""
+        tl = timeline.track(f"fleet-worker-{idx}")
         wake = self._wake[idx]
         pause = self._pause[idx]
         quiet = self._quiet[idx]
         while not self._stop.is_set():
+            t0 = tl.begin()
             wake.wait(timeout=0.05)
             wake.clear()
             if self._stop.is_set():
                 return
             if pause.is_set():
+                tl.add("park", t0)
                 quiet.set()
                 continue
+            tl.add("idle", t0)
             quiet.clear()
             rep = self._replica(idx)
+            rearm = True
             try:
                 for _ in range(self._WORKER_TICKS):
                     if pause.is_set() or self._stop.is_set():
                         break
+                    t0 = tl.begin()
                     if rep.role == "prefill":
-                        rep.server.prefill_step()
+                        # a no-progress poll (queue head blocked on
+                        # pool pages, nothing admittable) is not a
+                        # tick: recording it would flood the timeline
+                        # ring and re-arming would spin the loop at
+                        # full speed — back off to the poll timeout
+                        # until the fleet moves
+                        rearm = rep.server.prefill_step()
+                        if not rearm:
+                            break
+                        tl.add("tick", t0)
                     else:
                         comps = rep.server.step()
+                        tl.add("tick", t0)
                         if comps:
                             self._harvest.put((idx, comps))
                     if not rep.server.work_pending():
@@ -451,7 +478,7 @@ class FleetRouter:
                     # tick budget spent with work left — re-arm so the
                     # next wait returns immediately
                     wake.set()
-                if rep.server.work_pending():
+                if rearm and rep.server.work_pending():
                     wake.set()
             except BaseException as e:   # surfaced on the router thread
                 self._harvest.put((idx, e))
@@ -465,7 +492,18 @@ class FleetRouter:
         while True:
             try:
                 if wait_s > 0.0:
-                    idx, payload = self._harvest.get(timeout=wait_s)
+                    tl_t0 = self._tl.begin()
+                    w0 = time.monotonic()
+                    try:
+                        idx, payload = self._harvest.get(
+                            timeout=wait_s)
+                    finally:
+                        # the wait happened whether or not an item
+                        # arrived — both outcomes are attribution
+                        self._tl.add("harvest_wait", tl_t0)
+                        self._metrics.observe(
+                            "fleet/harvest_wait_ms",
+                            (time.monotonic() - w0) * 1000.0)
                     wait_s = 0.0
                 else:
                     idx, payload = self._harvest.get_nowait()
@@ -496,10 +534,18 @@ class FleetRouter:
             self._harvest_drain(out, wait_s=0.002)
         else:
             for i, rep in enumerate(live):
+                # lockstep ticks record under the same per-lane track
+                # names the async workers use, so the overlap-ratio
+                # A/B compares the two schedules on equal footing
+                tl = timeline.track(f"fleet-worker-{i}")
+                t0 = tl.begin()
                 if rep.role == "prefill":
                     rep.server.prefill_step()
+                    tl.add("tick", t0)
                 else:
-                    for c in rep.server.step():
+                    comps = rep.server.step()
+                    tl.add("tick", t0)
+                    for c in comps:
                         comp = self._resolve(i, c)
                         if comp is not None:
                             out.append(comp)
@@ -517,14 +563,19 @@ class FleetRouter:
         publish the host bytes for the next pump.  The gather already
         materialised fresh buffers, so the bytes are immutable; a None
         sentinel shuts the thread down."""
+        tl = timeline.track("fleet-handoff-writer")
         while True:
+            t0 = tl.begin()
             item = self._handoff_q.get()
+            tl.add("idle", t0)
             if item is None:
                 return
-            gid, data = item
+            gid, trace_id, data = item
+            t0 = tl.begin()
             host = jax.device_get(data)
             with self._handoff_lock:
                 self._handoff_staged[gid] = host
+            tl.add("handoff_host", t0, trace=trace_id)
 
     def _pump_handoffs(self) -> None:
         """Move every finished prefill toward a decode replica:
@@ -588,6 +639,7 @@ class FleetRouter:
                 continue
             pages, last = exp
             t0 = time.monotonic()
+            tl_t0 = self._tl.begin()
             partial = srv.preempt(req["local_id"])
             self._local.pop((i, req["local_id"]), None)
             if partial is not None:
@@ -613,7 +665,7 @@ class FleetRouter:
                 req["kv"] = (None, last, len(pages))
                 req["stage"] = "staging"
                 req["handoff_t0"] = t0
-                self._handoff_q.put((gid, data))
+                self._handoff_q.put((gid, req["trace_id"], data))
                 span.end(placed=False, staged=True)
                 continue
             # d2d: commit the gathered tree to the decode pool's
@@ -622,6 +674,8 @@ class FleetRouter:
             req["kv"] = (data, last, len(pages))
             req["stage"] = "pending_decode"
             self.inc("fleet/handoff_d2d")
+            self._tl.add("handoff_d2d", tl_t0,
+                         trace=req["trace_id"])
             self._metrics.observe(
                 "fleet/handoff_ms",
                 (time.monotonic() - t0) * 1000.0)
@@ -780,6 +834,7 @@ class FleetRouter:
     def _park_worker(self, idx: int) -> None:
         """Pause one async worker and wait until it acknowledges it is
         outside its server (the quiet handshake)."""
+        self._park_t0[idx] = time.monotonic()
         self._pause[idx].set()
         self._wake[idx].set()
         if not self._quiet[idx].wait(timeout=30.0):
@@ -787,6 +842,10 @@ class FleetRouter:
                 f"fleet worker {idx} failed to quiesce for restart")
 
     def _unpark_worker(self, idx: int) -> None:
+        t0 = self._park_t0.pop(idx, None)
+        if t0 is not None:
+            self._metrics.observe("fleet/park_ms",
+                                  (time.monotonic() - t0) * 1000.0)
         self._quiet[idx].clear()
         self._pause[idx].clear()
         self._wake[idx].set()
@@ -867,6 +926,26 @@ class FleetRouter:
             if h is not None and h.count:
                 out[f"{prefix}_p50_ms"] = round(h.percentile(50), 3)
                 out[f"{prefix}_p99_ms"] = round(h.percentile(99), 3)
+        # thread-timeline attribution (recorder on only): overlap
+        # ratio over the fleet-worker lanes plus per-track utilization
+        # — scoped to THIS router's lifetime so back-to-back routers
+        # (the lockstep-vs-async A/B) don't read each other's runs
+        if timeline.enabled():
+            snap = timeline.get_timeline().snapshot(since=self._t0)
+            ratio = timeline.overlap_ratio(snap)
+            if ratio is not None:
+                out["overlap_ratio"] = round(ratio, 4)
+                metrics.get_registry().set_gauge(
+                    "fleet/overlap_ratio", out["overlap_ratio"])
+            util = {name: round(u["util"], 4)
+                    for name, u in timeline.utilization(snap).items()
+                    if u["window_s"] > 0}
+            if util:
+                out["thread_util"] = util
+                reg = metrics.get_registry()
+                for name, u in util.items():
+                    safe = name.replace("-", "_").replace(":", "_")
+                    reg.set_gauge(f"timeline/util/{safe}", u)
         self._emit("fleet_summary", **out)
         out["per_replica"] = reps
         return out

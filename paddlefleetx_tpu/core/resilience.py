@@ -38,6 +38,7 @@ from collections import deque
 from typing import Dict, List, Optional
 
 from ..observability import metrics
+from ..observability import timeline
 from ..utils.log import logger
 
 
@@ -318,23 +319,35 @@ class StepWatchdog:
             self._thread = None
 
     def _run(self) -> None:
+        tl = timeline.track(f"watchdog:{self.name}")
         poll = min(1.0, max(0.02, self.min_interval_s / 5.0))
-        while not self._stop.wait(poll):
-            with self._lock:
-                armed_at, tag, gen = self._armed_at, self._tag, \
-                    self._gen
-                already = gen == self._stalled_gen
-            if armed_at is None or already:
-                continue
-            waited = time.monotonic() - armed_at
-            deadline = self.deadline_s()
-            if waited <= deadline:
-                continue
-            with self._lock:
-                if self._gen != gen:   # phase ended while we decided
-                    continue
-                self._stalled_gen = gen
-            self._on_stall(tag, waited, deadline)
+        while True:
+            t0 = tl.begin()
+            stopped = self._stop.wait(poll)
+            tl.add("poll", t0)
+            if stopped:
+                return
+            t0 = tl.begin()
+            self._run_once()
+            tl.add("check", t0)
+
+    def _run_once(self) -> None:
+        """One deadline check (the body of each monitor poll)."""
+        with self._lock:
+            armed_at, tag, gen = self._armed_at, self._tag, \
+                self._gen
+            already = gen == self._stalled_gen
+        if armed_at is None or already:
+            return
+        waited = time.monotonic() - armed_at
+        deadline = self.deadline_s()
+        if waited <= deadline:
+            return
+        with self._lock:
+            if self._gen != gen:   # phase ended while we decided
+                return
+            self._stalled_gen = gen
+        self._on_stall(tag, waited, deadline)
 
     def _on_stall(self, tag: Optional[str], waited: float,
                   deadline: float) -> None:

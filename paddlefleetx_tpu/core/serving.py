@@ -148,6 +148,7 @@ from ..models.gpt.generation import (
 )
 from ..observability import metrics
 from ..observability import server as obs_server
+from ..observability import timeline
 from ..observability.recorder import FlightRecorder
 from ..observability.spans import Tracer
 from ..utils.log import logger
@@ -1088,11 +1089,15 @@ class GenerationServer:
         evicts those host pages at the next yield point, so the loss
         surfaces as a cold re-prefill, never a hang or wrong KV).
         ``None`` is the shutdown sentinel (:meth:`close`)."""
+        tl = timeline.track("kv-spill-writer")
         while True:
+            t0 = tl.begin()
             item = self._spill_q.get()
+            tl.add("idle", t0)
             if item is None:
                 return
             entries, data = item
+            t0 = tl.begin()
             try:
                 host = jax.device_get(data)
                 pages = split_kv_pages(host, len(entries))
@@ -1105,6 +1110,7 @@ class GenerationServer:
                     self._spill_failed.extend(entries)
                     self._spill_outstanding -= 1
                     self._spill_lock.notify_all()
+                tl.add("spill_device_get", t0)
                 continue
             with self._spill_lock:
                 for (hpid, gen), page in zip(entries, pages):
@@ -1115,6 +1121,7 @@ class GenerationServer:
                         self._host_data[hpid] = (gen, page)
                 self._spill_outstanding -= 1
                 self._spill_lock.notify_all()
+            tl.add("spill_device_get", t0)
 
     def _release_page(self, pid: int) -> None:
         """Release one reference to a slot-mapped page. In tiered mode
@@ -1550,22 +1557,35 @@ class GenerationServer:
                 n += 1
             return n
 
-    def prefill_step(self) -> None:
+    def prefill_step(self) -> bool:
         """Admission plus at most one prefill chunk, NO decode tick —
         the drive loop of a prefill-role replica in a disaggregated
         fleet: the router calls this until :meth:`prompt_ready`, then
         exports the KV and hands the request to a decode replica
-        before a single token is decoded here."""
+        before a single token is decoded here.
+
+        Returns:
+            True when the call made progress — admitted a request or
+            advanced a prefill chunk. The async fleet worker uses
+            False (queue head blocked on pool pages, nothing to do)
+            to back off instead of spinning, and to keep no-op polls
+            off the thread timeline."""
         with self._surface_lock:
             if self._closed:
-                return
+                return False
+            q0 = len(self._queue)
+            chunks0 = self._prefill_chunk_count if self.paged else 0
             if not self._draining:
                 self._admit()
+            progress = len(self._queue) != q0
             if self.paged:
                 self._prefill_pump()
+                progress = progress or \
+                    self._prefill_chunk_count != chunks0
                 metrics.get_registry().set_gauge(
                     "serving/pages_in_use", self._alloc.pages_in_use)
         self._ship_spills()
+        return progress
 
     def prompt_ready(self, tokens: Sequence[int]) -> bool:
         """True when a finished prefill of exactly ``tokens`` sits in

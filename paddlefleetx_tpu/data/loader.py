@@ -36,6 +36,7 @@ import threading
 from concurrent.futures import ProcessPoolExecutor
 from typing import Callable, Iterator, Optional
 
+from ..observability import timeline
 from ..utils.log import logger
 
 
@@ -101,12 +102,19 @@ class DataLoader:
         return False
 
     def _produce(self, q: "queue.Queue", stop: threading.Event) -> None:
+        tl = timeline.track("data-loader")
         try:
             for indices in self.batch_sampler:
                 if stop.is_set():
                     return
-                batch = [self.dataset[i] for i in indices]
-                if not self._put(q, stop, ("batch", self.collate_fn(batch))):
+                t0 = tl.begin()
+                item = ("batch", self.collate_fn(
+                    [self.dataset[i] for i in indices]))
+                tl.add("load", t0)
+                t0 = tl.begin()
+                ok = self._put(q, stop, item)
+                tl.add("wait", t0)
+                if not ok:
                     return
         except BaseException as e:  # surface worker errors to consumer
             self._put(q, stop, ("error", e))

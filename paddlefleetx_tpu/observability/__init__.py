@@ -10,7 +10,7 @@ grep-able log lines plus out-of-band scripts. See
 names.
 """
 
-from . import export, metrics, server
+from . import export, metrics, server, timeline
 from .flops import (
     PEAK_FLOPS_BY_KIND, causal_attn_flops, model_flops_per_token,
     peak_flops,
@@ -21,13 +21,14 @@ from .metrics import MetricsRegistry, get_registry
 from .recorder import FlightRecorder, read_events, read_tail
 from .server import MetricsServer
 from .spans import NULL_SPAN, Span, Tracer
+from .timeline import ThreadTimeline, get_timeline
 from .trace import annotate
 
 __all__ = [
     "FlightRecorder", "LogHistogram", "MetricsRegistry",
     "MetricsServer", "NULL_SPAN", "PEAK_FLOPS_BY_KIND", "Span",
-    "Tracer", "annotate", "causal_attn_flops", "device_memory_stats",
-    "export", "format_bytes", "get_registry", "metrics",
-    "model_flops_per_token", "peak_flops", "read_events", "read_tail",
-    "server",
+    "ThreadTimeline", "Tracer", "annotate", "causal_attn_flops",
+    "device_memory_stats", "export", "format_bytes", "get_registry",
+    "get_timeline", "metrics", "model_flops_per_token", "peak_flops",
+    "read_events", "read_tail", "server", "timeline",
 ]

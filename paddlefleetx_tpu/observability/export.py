@@ -14,8 +14,11 @@ Two render paths over the in-process telemetry, both stdlib-only:
 - :func:`chrome_trace` converts flight-recorder span records
   (``observability/spans.py``) into the Chrome trace-event JSON that
   Perfetto / ``chrome://tracing`` loads directly — each trace id gets
-  its own track, so a request's submit→evict life reads as one row
-  next to the ``jax.profiler`` device trace.
+  its own track under a ``requests`` process, and a thread-timeline
+  snapshot (``observability/timeline.py``) adds one track per
+  instrumented thread under a ``threads`` process, so a request's
+  submit→evict life reads next to the worker/writer threads that
+  served it (pid/tid assignment is stable across exports).
 
 Both are served live by ``observability/server.py`` (``/metrics`` and
 ``/trace``).
@@ -118,40 +121,54 @@ def _span_args(rec: Dict[str, Any]) -> Dict[str, Any]:
                          "parent", "dur_ms")}
 
 
-def chrome_trace(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
-    """Chrome trace-event JSON from flight-recorder records.
+#: stable Perfetto process ids: request/span tracks vs thread tracks
+_PID_REQUESTS = 1
+_PID_THREADS = 2
+
+
+def chrome_trace(records: Iterable[Dict[str, Any]],
+                 timeline: Any = None) -> Dict[str, Any]:
+    """Chrome trace-event JSON from flight-recorder records, plus
+    (optionally) per-thread activity tracks.
 
     Args:
         records: parsed events.jsonl records (non-span events are
             skipped); ``observability.recorder.read_events`` provides
             them rotation-aware.
+        timeline: optional ``{track name: [(state, t0, t1, trace)]}``
+            snapshot from ``observability.timeline`` — each track
+            renders as a thread row under a second ``threads``
+            process, interval states as ``X`` slices (trace-tagged
+            slices carry the request's trace id in ``args`` so a
+            handoff lines up against its span row).
 
     Returns:
         ``{"traceEvents": [...], "displayTimeUnit": "ms"}`` — loadable
-        by Perfetto and ``chrome://tracing``. Each trace id becomes a
-        thread (track), named ``trace <id>``; ``span``/``span_begin``/
-        ``span_end`` map to phases ``X``/``B``/``E``, points to ``i``.
+        by Perfetto and ``chrome://tracing``. pid/tid assignment is
+        STABLE: span tracks live in pid 1 (``requests``) with tids
+        assigned 1..N over the sorted trace ids, timeline tracks in
+        pid 2 (``threads``) with tids 1..M over the sorted track
+        names — two exports of the same data group identically.
+        ``span``/``span_begin``/``span_end`` map to phases ``X``/
+        ``B``/``E``, points to ``i``.
     """
-    tids: Dict[str, int] = {}
+    recs = [r for r in records if r.get("event") in _SPAN_EVENTS]
+    tids = {key: i + 1 for i, key in enumerate(
+        sorted({str(r.get("trace")) for r in recs}))}
     events: List[Dict[str, Any]] = []
-
-    def tid_for(trace: Any) -> int:
-        key = str(trace)
-        if key not in tids:
-            tids[key] = len(tids) + 1
-            events.append({
-                "ph": "M", "name": "thread_name", "pid": 1,
-                "tid": tids[key],
-                "args": {"name": f"trace {key}"}})
-        return tids[key]
-
-    for rec in records:
+    if recs:
+        events.append({"ph": "M", "name": "process_name",
+                       "pid": _PID_REQUESTS, "tid": 0,
+                       "args": {"name": "requests"}})
+        for key, tid in sorted(tids.items(), key=lambda kv: kv[1]):
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": _PID_REQUESTS, "tid": tid,
+                           "args": {"name": f"trace {key}"}})
+    for rec in recs:
         kind = rec.get("event")
-        if kind not in _SPAN_EVENTS:
-            continue
         ts_us = float(rec.get("ts", 0.0)) * 1e6
-        base = {"name": rec.get("name", "?"), "pid": 1,
-                "tid": tid_for(rec.get("trace")),
+        base = {"name": rec.get("name", "?"), "pid": _PID_REQUESTS,
+                "tid": tids[str(rec.get("trace"))],
                 "args": _span_args(rec)}
         if kind == "span_begin":
             events.append({**base, "ph": "B", "ts": ts_us})
@@ -163,4 +180,19 @@ def chrome_trace(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
                            "ts": ts_us - dur_us, "dur": dur_us})
         else:   # span_point
             events.append({**base, "ph": "i", "ts": ts_us, "s": "t"})
+    if timeline:
+        events.append({"ph": "M", "name": "process_name",
+                       "pid": _PID_THREADS, "tid": 0,
+                       "args": {"name": "threads"}})
+        for tid, name in enumerate(sorted(timeline), start=1):
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": _PID_THREADS, "tid": tid,
+                           "args": {"name": name}})
+            for state, t0, t1, trace in timeline[name]:
+                events.append({
+                    "ph": "X", "name": state, "pid": _PID_THREADS,
+                    "tid": tid, "ts": t0 * 1e6,
+                    "dur": max(0.0, t1 - t0) * 1e6,
+                    "args": ({"trace": trace}
+                             if trace is not None else {})})
     return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -13,8 +13,13 @@ daemon-threaded ``ThreadingHTTPServer`` next to the run so Prometheus
   ``{"status": "draining", ...}`` once the generation server enters
   drain (docs/robustness.md) — the signal a load balancer needs to
   stop routing to a preempted worker while in-flight requests finish;
-- ``/trace`` — the span records of the attached events.jsonl as
-  Perfetto/Chrome trace-event JSON.
+- ``/trace`` — the span records of the attached events.jsonl plus
+  the live thread-timeline tracks, merged into one Perfetto/Chrome
+  trace-event JSON (spans under the ``requests`` process, thread
+  activity under ``threads``);
+- ``/timeline`` — the raw thread-timeline snapshot as JSON
+  (``tracks`` + derived ``utilization`` and ``overlap_ratio``), for
+  tooling that wants the intervals without the Chrome envelope.
 
 Wiring: ``PFX_METRICS_PORT`` names the port (``0`` = ephemeral, read
 it back from ``get_server().port``); when unset nothing starts and
@@ -33,6 +38,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from . import export
 from . import metrics as metrics_mod
+from . import timeline as timeline_mod
 from .recorder import read_events
 
 
@@ -124,6 +130,10 @@ class MetricsServer:
         handler.wfile.write(data)
 
     def _handle(self, handler) -> None:
+        # per-request handler threads share one timeline track (the
+        # deque append is atomic, so interleaved scrapes are safe)
+        tl = timeline_mod.track("pfx-metrics")
+        tl_t0 = tl.begin()
         path = handler.path.split("?", 1)[0]
         # snapshot the wiring under the lock, then render and answer
         # outside it — _respond blocks on the client socket and must
@@ -157,10 +167,24 @@ class MetricsServer:
                                   "application/json")
                     return
                 trace = export.chrome_trace(
-                    read_events(events_path))
+                    read_events(events_path),
+                    timeline=timeline_mod.get_timeline().snapshot())
                 self._respond(handler, 200,
                               json.dumps(trace, default=str),
                               "application/json")
+            elif path == "/timeline":
+                snap = timeline_mod.get_timeline().snapshot()
+                ratio = timeline_mod.overlap_ratio(snap)
+                self._respond(
+                    handler, 200,
+                    json.dumps({
+                        "enabled": timeline_mod.enabled(),
+                        "tracks": snap,
+                        "utilization":
+                            timeline_mod.utilization(snap),
+                        "overlap_ratio": ratio,
+                    }, default=str),
+                    "application/json")
             else:
                 self._respond(handler, 404, '{"error": "not found"}',
                               "application/json")
@@ -172,6 +196,8 @@ class MetricsServer:
                               "application/json")
             except OSError:
                 pass   # client hung up mid-answer
+        finally:
+            tl.add("serve", tl_t0)
 
 
 #: the process-wide server (every component shares one port)

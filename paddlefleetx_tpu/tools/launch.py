@@ -41,6 +41,8 @@ import threading
 import time
 from typing import List, Optional
 
+from ..observability import timeline
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -50,9 +52,12 @@ def _free_port() -> int:
 
 def _stream(proc: subprocess.Popen, rank: int) -> threading.Thread:
     def pump():
+        tl = timeline.track("launch-log-pump")
         for line in proc.stdout:  # type: ignore[union-attr]
+            t0 = tl.begin()
             sys.stdout.write(f"[rank {rank}] {line.decode(errors='replace')}")
             sys.stdout.flush()
+            tl.add("pump", t0)
 
     t = threading.Thread(target=pump, daemon=True)
     t.start()

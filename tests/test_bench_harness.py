@@ -74,6 +74,7 @@ def test_consecutive_hangs_trip_circuit_breaker(monkeypatch, capsys):
     assert e.value.code == 1
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["error_kind"] == "backend_unavailable"
+    assert rec["outage"] is True
     assert "3 consecutive probes hung" in rec["error"]
 
 
@@ -118,6 +119,7 @@ def test_wrong_platform_probe_counts_toward_hang_streak(
     assert e.value.code == 1
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["error_kind"] == "backend_unavailable"
+    assert rec["outage"] is True
     assert "3 consecutive probes hung" in rec["error"]
     assert "expected tpu" in rec["error"]
 
@@ -138,6 +140,7 @@ def test_nontransient_emits_structured_exception(monkeypatch, capsys):
     assert e.value.code == 1
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["error_kind"] == "exception"
+    assert "outage" not in rec        # code bugs never wear the flag
     assert rec["value"] is None and rec["metric"] == bench.HEADLINE_METRIC
 
 
@@ -170,6 +173,7 @@ def test_resource_exhausted_probe_classifies_as_outage(
         bench.wait_for_backend()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["error_kind"] == "backend_unavailable"
+    assert rec["outage"] is True
 
 
 def test_mfu_6p7b_reraises_non_resource_errors(monkeypatch):
@@ -210,6 +214,7 @@ def test_budget_exhaustion_is_backend_unavailable(monkeypatch, capsys):
         bench.wait_for_backend()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["error_kind"] == "backend_unavailable"
+    assert rec["outage"] is True
     assert "UNAVAILABLE" in rec["error"]
 
 
@@ -228,6 +233,7 @@ def test_cpu_fallback_treated_as_outage_when_tpu_expected(
         bench.wait_for_backend()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["error_kind"] == "backend_unavailable"
+    assert rec["outage"] is True
     assert "expected tpu" in rec["error"]
 
 
@@ -258,6 +264,7 @@ def test_failure_metric_tracks_mode(monkeypatch, capsys):
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["metric"] == bench.METRIC_BY_MODE["moe"]
     assert rec["error_kind"] == "backend_unavailable"
+    assert rec["outage"] is True
 
 
 def test_is_transient_classification():
@@ -532,6 +539,7 @@ def test_banked_headline_emitted_on_failure(monkeypatch, capsys):
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["value"] == 50178.1
     assert "tunnel dropped" in rec["secondaries_interrupted"]
+    assert rec["outage"] is True      # the interruption was environmental
     assert "error_kind" not in rec
     assert logged and logged[-1]["value"] == 50178.1
 
@@ -759,6 +767,18 @@ def test_bench_fleet_runs_offline(monkeypatch, capsys):
     assert arec["speedup_vs_lockstep"] == pytest.approx(
         arec["value"] / rec["value"], rel=5e-2)
     assert "handoff_p99_ms" in arec and "handoff_d2d" in arec
+    # PR 18: the async row self-describes its concurrency — overlap
+    # ratio from the thread timeline (exactly 1/N under lockstep),
+    # plus per-thread utilization so a regression to accidental
+    # serialization is visible in the record itself, not just in a
+    # Perfetto trace
+    assert rec["overlap_ratio"] == pytest.approx(1 / 2)
+    assert arec["lockstep_overlap_ratio"] == rec["overlap_ratio"]
+    assert arec["lockstep_overlap_ratio"] < \
+        arec["overlap_ratio"] <= 1.0
+    util = arec["thread_util"]
+    assert {"fleet-worker-0", "fleet-worker-1"} <= set(util)
+    assert all(0.0 <= u <= 1.0 for u in util.values())
 
 
 def test_bench_fleet_async_knob_off(monkeypatch, capsys):

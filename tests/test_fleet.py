@@ -30,8 +30,10 @@ from paddlefleetx_tpu.models.gpt import GPTConfig, GPTForPretraining
 from paddlefleetx_tpu.models.gpt.generation import (
     GenerationConfig, generate, left_pad_batch,
 )
+from paddlefleetx_tpu.observability import export
 from paddlefleetx_tpu.observability import metrics
 from paddlefleetx_tpu.observability import server as obs_server
+from paddlefleetx_tpu.observability import timeline
 from paddlefleetx_tpu.observability.recorder import read_events
 
 CFG = GPTConfig(vocab_size=96, hidden_size=32, num_layers=2,
@@ -391,6 +393,116 @@ def test_fleet_async_d2d_handoff_smoke(paged512_model_and_params,
     for e in evs:
         if e["event"] == "fleet_handoff":
             assert e["mode"] == "device"
+
+
+def test_fleet_async_overlap_ratio_beats_lockstep(model_and_params,
+                                                  tmp_path):
+    """The overlap A/B pin (docs/observability.md, "Thread
+    timeline"): serving the SAME trace, the lockstep router scores
+    exactly 1/N on ``overlap_ratio`` (one lane mid-tick at a time by
+    construction) and the async router must score STRICTLY more —
+    worker threads whose tick intervals never overlap would mean the
+    async fleet is lockstep with extra steps. Also pins the
+    ``summary()`` plumbing the fleet bench records ride
+    (``overlap_ratio`` + per-thread ``thread_util``) and dumps the
+    async run's merged Perfetto timeline as timeline_fleet_async.json
+    for CI's failure-diagnostics artifact."""
+    model, params = model_and_params
+    gen_cfg = _greedy_cfg()
+    factory = _mixed_factory(model, params, gen_cfg)
+    was = timeline.enabled()
+    timeline.set_enabled(True)
+    try:
+        def serve(async_workers):
+            fleet = FleetRouter(factory, 2,
+                                async_workers=async_workers)
+            ids = [fleet.submit(p) for p in PROMPTS]
+            done = _drain_fleet(fleet, {})
+            assert set(done) == set(ids)
+            summ = fleet.summary()
+            snap = timeline.get_timeline().snapshot(since=fleet._t0)
+            fleet.close()
+            return summ, snap
+
+        lock_summ, _ = serve(async_workers=False)
+        async_summ, async_snap = serve(async_workers=True)
+    finally:
+        timeline.set_enabled(was)
+
+    # lockstep floor: depth never exceeds 1 => exactly 1/N
+    assert lock_summ["overlap_ratio"] == pytest.approx(1 / 2)
+    # the tentpole claim, falsifiable: async genuinely overlaps
+    assert async_summ["overlap_ratio"] > lock_summ["overlap_ratio"]
+    assert async_summ["overlap_ratio"] <= 1.0
+    # per-thread utilization rides the same summary
+    util = async_summ["thread_util"]
+    assert {"fleet-worker-0", "fleet-worker-1"} <= set(util)
+    assert all(0.0 <= u <= 1.0 for u in util.values())
+
+    # one Perfetto thread row per instrumented thread, artifact-ready
+    trace = export.chrome_trace([], timeline=async_snap)
+    rows = {e["args"]["name"] for e in trace["traceEvents"]
+            if e.get("name") == "thread_name"}
+    assert {"fleet-router", "fleet-worker-0",
+            "fleet-worker-1"} <= rows
+    out = tmp_path / "timeline_fleet_async.json"
+    out.write_text(json.dumps(trace))
+    assert json.loads(out.read_text())["displayTimeUnit"] == "ms"
+
+
+def test_fleet_async_handoff_reconstructs_from_timeline(
+        paged512_model_and_params, tmp_path):
+    """Handoff reconstruction from the thread timeline ALONE — the
+    event stream only mints the trace ids: each host-staged handoff
+    shows up as a trace-tagged ``handoff_host`` interval on the
+    writer track, preceded by prefill-lane tick work and followed by
+    decode-lane tick work, with the router's harvest waits accounted
+    on its own track."""
+    model, params = paged512_model_and_params
+    gen_cfg = _greedy_cfg()
+    prompts = _long_prompts()
+    events = tmp_path / "events.jsonl"
+    factory = _mixed_factory(model, params, gen_cfg, page_size=128,
+                             pool_pages=17, prefill_chunk_pages=1)
+    was = timeline.enabled()
+    timeline.set_enabled(True)
+    try:
+        fleet = FleetRouter(factory, 2, prefill_replicas=1,
+                            handoff="host", async_workers=True,
+                            events_path=str(events))
+        comps = fleet.run(prompts)
+        summ = fleet.summary()
+        snap = timeline.get_timeline().snapshot(since=fleet._t0)
+        fleet.close()
+    finally:
+        timeline.set_enabled(was)
+
+    assert summ["handoffs"] == 3 and summ["handoff_host"] == 3
+    traces = {c.trace_id for c in comps}
+    handoffs = [iv for iv in snap["fleet-handoff-writer"]
+                if iv[0] == "handoff_host"]
+    # one staged interval per handoff, each tagged with the trace id
+    # of a real completion — and all three requests distinct
+    assert len(handoffs) == 3
+    assert {iv[3] for iv in handoffs} <= traces
+    assert len({iv[3] for iv in handoffs}) == 3
+    roles = [r["role"] for r in summ["per_replica"]]
+    pticks = [iv for iv in snap[f"fleet-worker-{roles.index('prefill')}"]
+              if iv[0] == "tick"]
+    dticks = [iv for iv in snap[f"fleet-worker-{roles.index('decode')}"]
+              if iv[0] == "tick"]
+    for _, h0, h1, tr in handoffs:
+        assert h1 >= h0 and tr is not None
+        # the prefill lane was ticking before the staging began, and
+        # the decode lane ticked on past its completion — the
+        # prefill -> stage -> decode story reads off the intervals
+        assert any(t0 < h0 for _, t0, _, _ in pticks)
+        assert any(t1 > h1 for _, _, t1, _ in dticks)
+    # the writer's idle waits and the router's harvest waits are
+    # attributed, not invisible
+    assert any(iv[0] == "idle" for iv in snap["fleet-handoff-writer"])
+    assert any(iv[0] == "harvest_wait"
+               for iv in snap["fleet-router"])
 
 
 def test_fleet_split_handoff_int8_scales(paged512_model_and_params):

@@ -1,0 +1,190 @@
+"""Unit pins for the per-thread timeline recorder (PR 18).
+
+Covers the ring-buffer mechanics (bounded memory, wraparound,
+mid-flight enable semantics), the disabled-mode cost discipline
+(same <1%-of-a-step contract the metrics registry holds), and the
+two derived views — per-track utilization and the fleet
+``overlap_ratio`` whose lockstep-vs-async calibration
+(`1/N` vs -> `1.0`) `tests/test_fleet.py` exercises end to end.
+"""
+
+import timeit
+
+import pytest
+
+from paddlefleetx_tpu.observability import timeline
+from paddlefleetx_tpu.observability.timeline import (
+    ThreadTimeline, overlap_ratio, utilization)
+
+
+def _fill(track, state, pairs, trace=None):
+    for t0, t1 in pairs:
+        track.add(state, t0, t1, trace=trace)
+
+
+# -- ring mechanics ----------------------------------------------------
+
+
+def test_ring_is_bounded_and_wraps_oldest_first():
+    tl = ThreadTimeline(enabled=True, cap=4)
+    tr = tl.track("w")
+    for i in range(10):
+        tr.add(f"s{i}", 1.0 + i, 2.0 + i)
+    ivs = tr.intervals()
+    assert len(ivs) == 4                       # bounded at cap
+    assert [iv[0] for iv in ivs] == ["s6", "s7", "s8", "s9"]
+    # and the ring keeps rolling: one more append drops s6
+    tr.add("s10", 20.0, 21.0)
+    assert [iv[0] for iv in tr.intervals()][0] == "s7"
+
+
+def test_track_registration_is_idempotent():
+    tl = ThreadTimeline(enabled=True, cap=8)
+    a = tl.track("worker")
+    b = tl.track("worker")
+    assert a is b                   # a restarted thread reattaches
+    a.add("tick", 1.0, 2.0)
+    assert len(b.intervals()) == 1
+
+
+def test_interval_carries_state_times_and_trace():
+    tl = ThreadTimeline(enabled=True, cap=8)
+    tr = tl.track("w")
+    tr.add("handoff_host", 5.0, 6.5, trace="abcd" * 4)
+    state, t0, t1, trace = tr.intervals()[0]
+    assert (state, t0, t1, trace) == ("handoff_host", 5.0, 6.5,
+                                      "abcd" * 4)
+    # t1 defaults to "now" for the begin()/add() pair idiom
+    t0 = tr.begin()
+    tr.add("tick", t0)
+    _, s, e, _ = tr.intervals()[-1]
+    assert e >= s > 0
+
+
+def test_snapshot_since_scopes_and_keeps_empty_tracks():
+    tl = ThreadTimeline(enabled=True, cap=8)
+    tl.track("old").add("tick", 1.0, 2.0)
+    tl.track("new").add("tick", 10.0, 11.0)
+    tl.track("registered-but-idle")
+    snap = tl.snapshot(since=5.0)
+    assert snap["old"] == []               # ended before the window
+    assert len(snap["new"]) == 1
+    # an instrumented-but-idle thread still earns its Perfetto row
+    assert snap["registered-but-idle"] == []
+
+
+# -- enable/disable discipline -----------------------------------------
+
+
+def test_disabled_records_nothing_and_begin_is_zero():
+    tl = ThreadTimeline(enabled=False, cap=8)
+    tr = tl.track("w")
+    assert tr.begin() == 0.0
+    tr.add("tick", tr.begin())
+    tr.add("tick", 123.0, 124.0)           # even explicit stamps drop
+    assert tr.intervals() == []
+
+
+def test_mid_interval_enable_never_fabricates_interval():
+    tl = ThreadTimeline(enabled=False, cap=8)
+    tr = tl.track("w")
+    t0 = tr.begin()                        # 0.0: recorder was off
+    tl.set_enabled(True)
+    tr.add("tick", t0)                     # must NOT become an
+    assert tr.intervals() == []            # epoch-long interval
+    t0 = tr.begin()                        # begun while on: recorded
+    tr.add("tick", t0)
+    assert len(tr.intervals()) == 1
+    tl.set_enabled(False)
+    tr.add("tick", tr.begin())
+    assert len(tr.intervals()) == 1        # off again: dropped
+
+
+def test_disabled_overhead_under_one_percent_of_step():
+    """Same cost contract as the disabled metrics registry: the
+    begin/add pair on a hot loop must stay far below 1% of the
+    fastest steady-state step this suite observes (~10 ms)."""
+    was = timeline.enabled()     # earlier in-process bench/fleet runs
+    timeline.set_enabled(False)  # may have left the recorder on
+    tr = timeline.track("tt-overhead-probe")
+    n = 10_000
+
+    def begin_add():
+        tr.add("tick", tr.begin())
+
+    try:
+        # best-of-5 to dodge scheduler jitter on shared CI hosts
+        per_call = min(
+            timeit.timeit(begin_add, number=n) for _ in range(5)) / n
+    finally:
+        timeline.set_enabled(was)
+    step_budget_s = 0.010
+    assert per_call < 0.01 * step_budget_s, per_call
+    assert tr.intervals() == []
+
+
+# -- derived views -----------------------------------------------------
+
+
+def test_utilization_splits_busy_from_wait_states():
+    tl = ThreadTimeline(enabled=True, cap=16)
+    w = tl.track("fleet-worker-0")
+    _fill(w, "tick", [(10.0, 13.0)])
+    _fill(w, "idle", [(13.0, 14.0)])
+    _fill(w, "park", [(14.0, 16.0)])
+    u = utilization(tl.snapshot())["fleet-worker-0"]
+    assert u["busy_s"] == pytest.approx(3.0)
+    assert u["wait_s"] == pytest.approx(3.0)
+    assert u["util"] == pytest.approx(0.5)
+    assert u["window_s"] == pytest.approx(6.0)
+    # every documented wait state counts as wait, nothing else does
+    assert timeline.WAIT_STATES == {
+        "idle", "wait", "park", "poll", "harvest_wait"}
+
+
+def test_utilization_empty_track_is_zero_not_nan():
+    tl = ThreadTimeline(enabled=True, cap=4)
+    tl.track("quiet")
+    u = utilization(tl.snapshot())["quiet"]
+    assert u["util"] == 0.0 and u["window_s"] == 0.0
+
+
+def test_overlap_ratio_lockstep_floor_is_one_over_n():
+    tl = ThreadTimeline(enabled=True, cap=16)
+    # back-to-back ticks, never concurrent: the lockstep shape
+    _fill(tl.track("fleet-worker-0"), "tick", [(10.0, 11.0), (12.0, 13.0)])
+    _fill(tl.track("fleet-worker-1"), "tick", [(11.0, 12.0), (13.0, 14.0)])
+    assert overlap_ratio(tl.snapshot()) == pytest.approx(1 / 2)
+
+
+def test_overlap_ratio_full_overlap_is_one():
+    tl = ThreadTimeline(enabled=True, cap=16)
+    for i in range(3):
+        _fill(tl.track(f"fleet-worker-{i}"), "tick", [(10.0, 12.0)])
+    assert overlap_ratio(tl.snapshot()) == pytest.approx(1.0)
+
+
+def test_overlap_ratio_partial_overlap_lands_between():
+    tl = ThreadTimeline(enabled=True, cap=16)
+    _fill(tl.track("fleet-worker-0"), "tick", [(10.0, 11.0)])
+    _fill(tl.track("fleet-worker-1"), "tick", [(10.5, 11.5)])
+    # depth 1 over half the busy window, depth 2 over the other
+    # half: mean depth 4/3 over 2 tracks
+    assert overlap_ratio(tl.snapshot()) == pytest.approx(2 / 3)
+
+
+def test_overlap_ratio_ignores_other_tracks_and_states():
+    tl = ThreadTimeline(enabled=True, cap=16)
+    _fill(tl.track("fleet-worker-0"), "tick", [(10.0, 11.0)])
+    _fill(tl.track("fleet-worker-0"), "idle", [(11.0, 19.0)])
+    _fill(tl.track("fleet-worker-1"), "park", [(10.0, 19.0)])
+    _fill(tl.track("kv-spill-writer"), "tick", [(10.0, 19.0)])
+    # only worker TICKS count: one contributing track => ratio 1.0
+    assert overlap_ratio(tl.snapshot()) == pytest.approx(1.0)
+
+
+def test_overlap_ratio_none_without_data():
+    tl = ThreadTimeline(enabled=True, cap=4)
+    assert overlap_ratio(tl.snapshot()) is None
+    tl.track("fleet-worker-0").add("tick", 5.0, 5.0)   # zero-width
+    assert overlap_ratio(tl.snapshot()) is None

@@ -19,6 +19,7 @@ import pytest
 from paddlefleetx_tpu.observability import export
 from paddlefleetx_tpu.observability import metrics
 from paddlefleetx_tpu.observability import server as obs_server
+from paddlefleetx_tpu.observability import timeline as obs_timeline
 from paddlefleetx_tpu.observability.recorder import (
     FlightRecorder, read_events, read_tail)
 from paddlefleetx_tpu.observability.spans import NULL_SPAN, Span, Tracer
@@ -304,12 +305,22 @@ def test_chrome_trace_shapes_and_json_validity(tmp_path):
     assert json.loads(blob)["displayTimeUnit"] == "ms"
     evs = trace["traceEvents"]
     phases = [e["ph"] for e in evs]
-    # one thread_name metadata row per trace id => per track
-    assert phases.count("M") == 2
+    # metadata: ONE process_name row (pid 1 = "requests") plus one
+    # thread_name row per trace id => per track, tids stable over the
+    # sorted trace ids
     meta = [e for e in evs if e["ph"] == "M"]
-    assert {e["args"]["name"] for e in meta} == \
+    assert phases.count("M") == 3
+    assert all(e["pid"] == 1 for e in meta)
+    pname = [e for e in meta if e["name"] == "process_name"]
+    assert len(pname) == 1 and pname[0]["args"]["name"] == "requests" \
+        and pname[0]["tid"] == 0
+    tmeta = [e for e in meta if e["name"] == "thread_name"]
+    assert {e["args"]["name"] for e in tmeta} == \
         {f"trace {r1.trace_id}", f"trace {r2.trace_id}"}
-    assert {e["tid"] for e in meta} == {1, 2}
+    assert {e["tid"] for e in tmeta} == {1, 2}
+    assert [e["tid"] for e in tmeta] == \
+        [t for _, t in sorted((e["args"]["name"], e["tid"])
+                              for e in tmeta)]   # sorted-id order
     # begins pair with ends; the complete span is one X with dur
     assert phases.count("B") == phases.count("E") == 3
     x = [e for e in evs if e["ph"] == "X"]
@@ -322,6 +333,47 @@ def test_chrome_trace_shapes_and_json_validity(tmp_path):
     # timestamps are microseconds (wall-clock seconds * 1e6)
     b0 = next(e for e in evs if e["ph"] == "B")
     assert b0["ts"] > 1e15
+
+
+def test_chrome_trace_merges_timeline_tracks(tmp_path):
+    rec, path = _recorded(tmp_path)
+    r1 = Tracer(rec).start_trace("serving/request")
+    r1.end()
+    rec.close()
+
+    snap = {
+        "zz-worker-1": [("tick", 10.0, 10.5, r1.trace_id),
+                        ("idle", 10.5, 10.6, None)],
+        "aa-writer": [("handoff_host", 10.1, 10.2, r1.trace_id)],
+    }
+    trace = export.chrome_trace(read_events(path), timeline=snap)
+    json.dumps(trace)                         # Perfetto-loadable
+    evs = trace["traceEvents"]
+    # the two processes are named and disjoint by pid
+    pnames = {e["pid"]: e["args"]["name"] for e in evs
+              if e.get("name") == "process_name"}
+    assert pnames == {1: "requests", 2: "threads"}
+    tmeta = [e for e in evs
+             if e.get("name") == "thread_name" and e["pid"] == 2]
+    # one thread row per track, tids 1..M over SORTED track names
+    assert [(e["tid"], e["args"]["name"]) for e in tmeta] == \
+        [(1, "aa-writer"), (2, "zz-worker-1")]
+    slices = [e for e in evs if e["ph"] == "X" and e["pid"] == 2]
+    assert len(slices) == 3
+    tick = next(e for e in slices if e["name"] == "tick")
+    assert tick["tid"] == 2
+    assert tick["ts"] == pytest.approx(10.0 * 1e6)
+    assert tick["dur"] == pytest.approx(0.5 * 1e6)
+    # trace-tagged intervals carry the request's trace id; untagged
+    # ones carry no args noise
+    assert tick["args"] == {"trace": r1.trace_id}
+    idle = next(e for e in slices if e["name"] == "idle")
+    assert idle["args"] == {}
+    # span rows never leak into the threads pid
+    assert all(e["pid"] == 1 for e in evs if e["ph"] in ("B", "E"))
+    # without a timeline snapshot the threads process is absent
+    bare = export.chrome_trace(read_events(path))
+    assert all(e["pid"] == 1 for e in bare["traceEvents"])
 
 
 # -- the live HTTP server ---------------------------------------------
@@ -394,6 +446,62 @@ def test_metrics_server_without_events_stream(tmp_path):
         code, _, body = _get(srv.url("/healthz"))
         assert code == 200                   # default health is ok
         assert json.loads(body)["status"] == "ok"
+    finally:
+        srv.close()
+
+
+def test_timeline_endpoint_and_trace_merge(tmp_path):
+    rec, events_path = _recorded(tmp_path)
+    root = Tracer(rec).start_trace("serving/request")
+    root.end()
+    rec.close()
+
+    obs_timeline.set_enabled(True)
+    srv = obs_server.MetricsServer(port=0, events_path=events_path)
+    try:
+        tl = obs_timeline.track("tt-endpoint-worker")
+        t0 = tl.begin()
+        tl.add("tick", t0, trace=root.trace_id)
+
+        code, ctype, body = _get(srv.url("/timeline"))
+        assert code == 200 and ctype.startswith("application/json")
+        snap = json.loads(body)
+        assert snap["enabled"] is True
+        states = [iv[0] for iv in snap["tracks"]["tt-endpoint-worker"]]
+        assert "tick" in states
+        # the serving thread instruments itself: the GET above ran
+        # under the shared pfx-metrics track
+        util = snap["utilization"]
+        assert util["tt-endpoint-worker"]["util"] == pytest.approx(1.0)
+        assert "pfx-metrics" in snap["tracks"]
+
+        # /trace now merges the thread tracks behind the span rows
+        code, _, body = _get(srv.url("/trace"))
+        assert code == 200
+        evs = json.loads(body)["traceEvents"]
+        assert any(e.get("name") == "process_name"
+                   and e["args"]["name"] == "threads" for e in evs)
+        assert any(e["ph"] == "X" and e["pid"] == 2
+                   and e["name"] == "tick"
+                   and e["args"].get("trace") == root.trace_id
+                   for e in evs)
+    finally:
+        srv.close()
+        obs_timeline.set_enabled(False)
+
+
+def test_timeline_endpoint_reports_disabled(tmp_path):
+    obs_timeline.set_enabled(False)   # earlier in-process runs may
+    srv = obs_server.MetricsServer(port=0)   # have left it on
+    try:
+        code, _, body = _get(srv.url("/timeline"))
+        assert code == 200
+        snap = json.loads(body)
+        # the endpoint stays up and truthful with recording off; the
+        # tracks dict may retain intervals recorded while enabled
+        # earlier in the process, so only the flag is pinned
+        assert snap["enabled"] is False
+        assert isinstance(snap["tracks"], dict)
     finally:
         srv.close()
 
