@@ -76,7 +76,7 @@ METRIC_BY_MODE = {
     "generation": "gpt345m_generation_decode_tokens_per_sec",
     "serving": "gpt345m_serving_decode_tokens_per_sec_per_chip",
     "fleet": "gpt345m_fleet_2replica_decode_tokens_per_sec_per_chip",
-    "pipeline": "gpt345m_pp4_pipeline_zb_tokens_per_sec_per_chip",
+    "pipeline": "gpt345m_pp4_pipeline_zb_h2_tokens_per_sec_per_chip",
     "convergence": "gpt345m_convergence_loss_at_300",
     "67b": "gpt3_6p7b_geometry_mfu",
     "longctx": "gpt345m_long_context_s8192_mfu",
@@ -1732,20 +1732,24 @@ def bench_fleet():
 
 
 def bench_pipeline():
-    """``--mode pipeline``: zero-bubble vs 1F1B schedule A/B on a
-    pipeline mesh.
+    """``--mode pipeline``: three-arm schedule A/B on a pipeline mesh —
+    zb_h2 vs zb vs 1F1B.
 
     Runs the explicit-schedule training step
-    (``pipelined_lm_loss_and_grad``) twice on the same pp mesh, params
-    and batch — first ``schedule="1F1B"`` (the same-memory baseline),
-    then ``schedule="zb"`` — and emits two records: the 1F1B baseline
-    row, then the zb headline carrying
-    ``baseline_1f1b_tokens_per_sec`` and ``speedup_vs_1f1b``.  Both
-    rows also report the analytic slot-occupancy split from
-    :func:`pipeline_tick_stats` (``bubble_share``); the zb row adds
-    ``bubble_fill_ratio`` — the fraction of the 1F1B bubble the
-    deferred-dW drain reclaims, >= 0.5 at the default M=8, K=4 shape
-    (at ``M < 2K-1`` the drain window is shorter than the dW backlog).
+    (``pipelined_lm_loss_and_grad``) three times on the same pp mesh,
+    params and batch — ``schedule="1F1B"`` (the same-memory baseline),
+    ``schedule="zb"``, then ``schedule="zb_h2"`` at full depth — and
+    emits three records: the 1F1B baseline row, the zb row, then the
+    zb_h2 headline carrying ``baseline_1f1b_tokens_per_sec`` and
+    ``speedup_vs_1f1b``.  Every row reports the analytic slot-occupancy
+    split from :func:`pipeline_tick_stats` (``bubble_share``) plus the
+    per-stage HBM picture: ``predicted_stage_bytes`` from the analytic
+    model (parallel/pp_memory.py) next to the measured
+    ``hbm_peak_bytes`` watermark (``device_memory_stats``; null
+    offline), pinned to agree within ``memory_tolerance`` on the
+    dryrun topology.  The zb/zb_h2 rows add ``bubble_fill_ratio`` —
+    the fraction of the 1F1B bubble reclaimed (dW drain for zb; extra
+    warm-up forwards on top for zb_h2, strictly higher at M >= K).
     On lockstep SPMD — one jitted program driving every stage — the
     wall-clock delta is muted, so the occupancy split is the honest
     headline; see docs/pipeline.md.
@@ -1758,8 +1762,11 @@ def bench_pipeline():
     from paddlefleetx_tpu.models.gpt.model import (
         pipelined_lm_loss_and_grad,
     )
+    from paddlefleetx_tpu.observability.memory import (
+        device_memory_stats,
+    )
     from paddlefleetx_tpu.parallel import (
-        TopologyConfig, build_mesh, make_sharding_rules,
+        TopologyConfig, build_mesh, make_sharding_rules, pp_memory,
     )
     from paddlefleetx_tpu.parallel.mesh import set_mesh
     from paddlefleetx_tpu.parallel.pipeline import (
@@ -1808,12 +1815,14 @@ def bench_pipeline():
     ids, labels, mask = (jax.device_put(x, data_sharding)
                          for x in (ids, labels, mask))
 
-    def _measure(schedule):
-        """Mean step seconds (after a compile+warm call) and loss."""
+    def _measure(schedule, h2_depth=-1):
+        """Mean step seconds (after a compile+warm call), loss, and
+        the post-run HBM watermark (None offline)."""
         def f(p, i, l, m):
             return pipelined_lm_loss_and_grad(
                 cfg, p, i, l, m, pp=pp, num_microbatches=M, vpp=1,
-                deterministic=True, schedule=schedule)
+                deterministic=True, schedule=schedule,
+                h2_depth=h2_depth)
 
         with mesh, nn.logical_axis_rules(list(rules)):
             fn = jax.jit(f)
@@ -1824,10 +1833,28 @@ def bench_pipeline():
                 loss, grads = fn(params, ids, labels, mask)
             jax.block_until_ready((loss, grads))
             dt = (time.perf_counter() - t0) / n_steps
-        return dt, float(loss)
+        stats = device_memory_stats()
+        peak = stats["peak_bytes_in_use"] if stats else None
+        return dt, float(loss), peak
 
+    h2_d = pp - 1  # full depth: zero fill-phase bubble at M >= 2pp-1
     ts_1f1b = pipeline_tick_stats(M, pp, schedule="1f1b")
     ts_zb = pipeline_tick_stats(M, pp, schedule="zb")
+    ts_h2 = pipeline_tick_stats(M, pp, schedule="zb_h2", h2_depth=h2_d)
+    param_count = sum(int(x.size) for x in jax.tree.leaves(params))
+    mem_kwargs = dict(
+        microbatch_tokens=batch // M * seq, hidden_size=cfg.hidden_size,
+        param_count=param_count, compute_dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype)
+
+    def _predicted(schedule, d=0):
+        return pp_memory.stage_memory_bytes(
+            schedule=schedule, pp=pp, vpp=1, h2_depth=d,
+            **mem_kwargs)["total_bytes"]
+
+    # the watermark comparison only means something when the allocator
+    # reports real HBM (TPU); tolerance is the pinned acceptance band
+    mem_tolerance = 0.5
     common = {
         "unit": "tokens/s",
         "vs_baseline": None,   # the reference publishes no zb number
@@ -1837,9 +1864,10 @@ def bench_pipeline():
         "batch": batch,
         "seq_len": seq,
         "steps": n_steps,
+        "memory_tolerance": mem_tolerance,
     }
 
-    dt_1f1b, loss_1f1b = _measure("1F1B")
+    dt_1f1b, loss_1f1b, peak_1f1b = _measure("1F1B")
     base_tps = batch * seq / dt_1f1b / pp
     base_rec = {
         "metric": "gpt345m_pp4_pipeline_1f1b_baseline_tokens_per_sec"
@@ -1849,16 +1877,20 @@ def bench_pipeline():
         "step_time_ms": round(dt_1f1b * 1e3, 3),
         "bubble_share": round(ts_1f1b["bubble_ticks"]
                               / ts_1f1b["total_slot_ticks"], 4),
+        "predicted_stage_bytes": _predicted("1f1b"),
+        "hbm_peak_bytes": peak_1f1b,
         "loss": round(loss_1f1b, 6),
     }
     _log_success(base_rec)
     print(json.dumps(base_rec))
 
-    dt_zb, loss_zb = _measure("zb")
+    b1 = ts_1f1b["bubble_ticks"]
+
+    dt_zb, loss_zb, peak_zb = _measure("zb")
     zb_tps = batch * seq / dt_zb / pp
-    b1, bz = ts_1f1b["bubble_ticks"], ts_zb["bubble_ticks"]
-    result = {
-        "metric": METRIC_BY_MODE["pipeline"],
+    bz = ts_zb["bubble_ticks"]
+    zb_rec = {
+        "metric": "gpt345m_pp4_pipeline_zb_tokens_per_sec_per_chip",
         "value": round(zb_tps, 1),
         **common,
         "step_time_ms": round(dt_zb * 1e3, 3),
@@ -1867,9 +1899,43 @@ def bench_pipeline():
         "bubble_ticks_zb": bz,
         "bubble_fill_ratio": round((b1 - bz) / b1, 4) if b1 else 0.0,
         "dw_queue_bound": zb_queue_bound(M, pp),
+        "predicted_stage_bytes": _predicted("zb"),
+        "hbm_peak_bytes": peak_zb,
         "loss_delta_vs_1f1b": abs(loss_zb - loss_1f1b),
         "baseline_1f1b_tokens_per_sec": round(base_tps, 1),
         "speedup_vs_1f1b": round(zb_tps / base_tps, 3)
+        if base_tps > 0 else None,
+    }
+    _log_success(zb_rec)
+    print(json.dumps(zb_rec))
+
+    dt_h2, loss_h2, peak_h2 = _measure("zb_h2", h2_depth=h2_d)
+    h2_tps = batch * seq / dt_h2 / pp
+    bh = ts_h2["bubble_ticks"]
+    pred_h2 = _predicted("zb_h2", h2_d)
+    result = {
+        "metric": METRIC_BY_MODE["pipeline"],
+        "value": round(h2_tps, 1),
+        **common,
+        "step_time_ms": round(dt_h2 * 1e3, 3),
+        "h2_depth": h2_d,
+        "bubble_share": round(bh / ts_h2["total_slot_ticks"], 4),
+        "bubble_ticks_1f1b": b1,
+        "bubble_ticks_zb": bz,
+        "bubble_ticks_zb_h2": bh,
+        "bubble_fill_ratio": round((b1 - bh) / b1, 4) if b1 else 0.0,
+        "dw_queue_bound": zb_queue_bound(M, pp, h2_depth=h2_d),
+        "predicted_stage_bytes": pred_h2,
+        "hbm_peak_bytes": peak_h2,
+        "hbm_budget_bytes": pp_memory.hbm_budget_bytes(),
+        # peak_bytes_in_use is per-device, i.e. per physical stage —
+        # the same unit the analytic model predicts
+        "memory_within_tolerance": (
+            abs(peak_h2 - pred_h2) <= mem_tolerance * pred_h2
+            if peak_h2 is not None else None),
+        "loss_delta_vs_1f1b": abs(loss_h2 - loss_1f1b),
+        "baseline_1f1b_tokens_per_sec": round(base_tps, 1),
+        "speedup_vs_1f1b": round(h2_tps / base_tps, 3)
         if base_tps > 0 else None,
     }
     _log_success(result)

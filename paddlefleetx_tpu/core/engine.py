@@ -391,13 +391,33 @@ class Engine(BasicEngine):
         mcfg = getattr(getattr(self.module, "model", None), "config",
                        None)
         if self.topo.pp_degree > 1 and mcfg is not None:
+            from ..parallel import pp_memory
             from ..parallel.pipeline import pipeline_tick_stats
-            sched = {"1F1B": "1f1b", "zb": "zb"}.get(
-                getattr(mcfg, "pipeline_schedule", "1F1B"), "gpipe")
+            cfg_sched = getattr(mcfg, "pipeline_schedule", "1F1B")
+            h2_depth = 0
+            if cfg_sched in ("zb_h2", "zb_auto"):
+                # schedule decision: the budget-aware resolution (live
+                # param count + batch shape) happens in the module at
+                # step-build time; this engine-side pick uses the same
+                # ladder without byte inputs — optimistic full depth —
+                # purely for the bubble-share estimate and the log line
+                pick = pp_memory.resolve_pipeline_schedule(
+                    cfg_sched, pp=self.topo.pp_degree,
+                    vpp=getattr(mcfg, "virtual_pp_degree", 1),
+                    requested_depth=getattr(mcfg, "zb_h2_depth", -1))
+                h2_depth = pick["h2_depth"]
+                logger.info(
+                    "[engine] pipeline schedule %s -> %s "
+                    "(h2_depth=%d): %s", cfg_sched, pick["schedule"],
+                    h2_depth, pick["reason"])
+                cfg_sched = pick["schedule"]
+            sched = {"1F1B": "1f1b", "zb": "zb",
+                     "zb_h2": "zb_h2"}.get(cfg_sched, "gpipe")
             k_total = self.topo.pp_degree * getattr(
                 mcfg, "virtual_pp_degree", 1)
             ts = pipeline_tick_stats(max(1, self.accumulate_steps),
-                                     k_total, schedule=sched)
+                                     k_total, schedule=sched,
+                                     h2_depth=h2_depth)
             self._pipeline_bubble_share = (
                 ts["bubble_ticks"] / ts["total_slot_ticks"])
         tx, schedule = self.tx, self.lr_schedule

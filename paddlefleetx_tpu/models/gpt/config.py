@@ -48,9 +48,21 @@ class GPTConfig:
     #: schedule), "zb" (zero-bubble: dX stays on the 1F1B critical
     #: path, dW is deferred into a bounded per-stage queue and drained
     #: during former bubble ticks — grads identical to 1F1B; see
-    #: docs/pipeline.md), or "GPipe" (all-forwards-then-autodiff).
-    #: Case-insensitive; canonicalized in __post_init__.
+    #: docs/pipeline.md), "zb_h2" (zero-bubble H2: extra warm-up
+    #: forwards spend HBM headroom to also fill the fill-phase bubble;
+    #: depth from ``zb_h2_depth``, validated against the device budget
+    #: by parallel/pp_memory.py), "zb_auto" (pick the deepest feasible
+    #: 1F1B -> zb -> zb_h2@depth rung for the memory budget and log
+    #: the decision), or "GPipe" (all-forwards-then-autodiff).
+    #: Case-insensitive, '-' and '_' interchangeable; canonicalized in
+    #: __post_init__.
     pipeline_schedule: str = "1F1B"
+    #: zb_h2 warm-up depth d: stage k may run up to
+    #: min(2(pp*vpp-k)-1, (pp*vpp-k)+d) forwards ahead of its backward
+    #: wave (bubble (K-1-d)(K-d)/2, zero at d = K-1). -1 = deepest
+    #: depth the HBM budget admits (full depth when no budget is
+    #: known). Ignored by the other schedules.
+    zb_h2_depth: int = -1
     # TPU-specific knobs (absent in reference):
     scan_layers: bool = True              # lax.scan over layers
     use_flash_attention: bool = False     # Pallas kernel on TPU
@@ -136,13 +148,19 @@ class GPTConfig:
             raise ValueError(
                 f"unknown recompute_granularity "
                 f"{self.recompute_granularity!r}")
-        canon = {"1f1b": "1F1B", "gpipe": "GPipe", "zb": "zb"}.get(
-            str(self.pipeline_schedule).lower())
+        canon = {"1f1b": "1F1B", "gpipe": "GPipe", "zb": "zb",
+                 "zb_h2": "zb_h2", "zb_auto": "zb_auto"}.get(
+            str(self.pipeline_schedule).lower().replace("-", "_"))
         if canon is None:
             raise ValueError(
                 f"unknown pipeline_schedule {self.pipeline_schedule!r} "
-                f"(expected '1F1B', 'zb' or 'GPipe')")
+                f"(expected '1F1B', 'zb', 'zb_h2', 'zb_auto' or "
+                f"'GPipe')")
         object.__setattr__(self, "pipeline_schedule", canon)
+        if self.zb_h2_depth < -1:
+            raise ValueError(
+                f"zb_h2_depth must be >= -1 (-1 = deepest feasible), "
+                f"got {self.zb_h2_depth}")
         if self.context_parallel_algo not in ("ring", "ulysses"):
             raise ValueError(
                 f"unknown context_parallel_algo "

@@ -941,9 +941,10 @@ def pipelined_lm_loss_and_grad(
         cfg: GPTConfig, params, input_ids, labels, loss_mask, *,
         pp: int, num_microbatches: int, vpp: int = 1, rng=None,
         position_ids=None, deterministic: bool = True,
-        schedule: str = "1F1B"):
+        schedule: str = "1F1B", h2_depth: int = -1):
     """Loss AND parameter gradients under the explicit 1F1B (or
-    zero-bubble ``"zb"``) schedule.
+    zero-bubble ``"zb"``/``"zb_h2"``; ``h2_depth`` is the ZB-H2
+    warm-up depth, -1 = full) schedule.
 
     ``jax.grad(pipelined_lm_loss)`` differentiates through the GPipe
     scan, which stashes every microbatch's stage activations before any
@@ -990,7 +991,7 @@ def pipelined_lm_loss_and_grad(
         pp=pp, num_microbatches=num_microbatches, vpp=vpp,
         loss_and_grad=head_loss_and_grad,
         extras=(labels, loss_mask), rng=pipe_rng,
-        schedule=schedule, layer_has_aux=has_aux)
+        schedule=schedule, h2_depth=h2_depth, layer_has_aux=has_aux)
 
     (demb,) = emb_pull(dx.astype(x.dtype))
     # fold the tied LM head's word-embedding gradient into the

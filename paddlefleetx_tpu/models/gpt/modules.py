@@ -142,34 +142,76 @@ class GPTModule(LanguageModule):
         m = acc if tokens.shape[0] % acc == 0 else 1
         return pp, m, deterministic
 
+    def _resolve_pp_schedule(self, sched, params, tokens, *, pp,
+                             num_microbatches):
+        """Budget-aware ``(schedule, h2_depth)`` for the pipelined
+        train step.
+
+        ``1F1B``/``zb`` pass through. ``zb_h2``/``zb_auto`` consult
+        the analytic per-stage byte model (parallel/pp_memory.py) with
+        the LIVE param count and microbatch shape: an explicitly
+        requested depth that exceeds the device budget raises here —
+        a config error at step-build time, not an OOM mid-trace —
+        while ``zb_auto`` (and ``zb_h2_depth: -1``) pick the deepest
+        feasible depth and log the decision.
+        """
+        if sched in ("1F1B", "zb"):
+            return sched, 0
+        from ...observability import metrics
+        from ...parallel import pp_memory
+        from ...utils.log import logger
+        mc = self.model_config
+        param_count = sum(int(x.size) for x in jax.tree.leaves(params))
+        mb = max(tokens.shape[0] // num_microbatches, 1)
+        pick = pp_memory.resolve_pipeline_schedule(
+            sched, pp=pp, vpp=mc.virtual_pp_degree,
+            requested_depth=mc.zb_h2_depth,
+            budget_bytes=pp_memory.hbm_budget_bytes(),
+            mem_kwargs=dict(
+                microbatch_tokens=mb * tokens.shape[1],
+                hidden_size=mc.hidden_size, param_count=param_count,
+                compute_dtype=mc.dtype, param_dtype=mc.param_dtype))
+        if sched == "zb_auto":
+            metrics.inc("pipeline/auto_schedule_picks")
+        logger.info(
+            "[pipeline] schedule %s -> %s (h2_depth=%d): %s "
+            "(predicted %s bytes/stage, budget %s)", sched,
+            pick["schedule"], pick["h2_depth"], pick["reason"],
+            pick["predicted_stage_bytes"], pick["budget_bytes"])
+        return pick["schedule"], pick["h2_depth"]
+
     def loss_and_grad(self, params, batch, rng):
         """One-pass (loss, grads) for the engine's train step.
 
-        With pp>1 under ``pipeline_schedule: 1F1B`` (default) or
-        ``zb`` this drives the explicit schedule in
-        ``pipeline_value_and_grad`` (bounded activation memory; zb
-        additionally drains deferred weight-grads into the bubble);
-        otherwise it is plain ``jax.value_and_grad`` of ``loss_fn``.
+        With pp>1 under ``pipeline_schedule: 1F1B`` (default), ``zb``,
+        ``zb_h2`` or ``zb_auto`` this drives the explicit schedule in
+        ``pipeline_value_and_grad`` (bounded activation memory; the zb
+        family additionally drains deferred weight-grads into the
+        bubble — zb_h2 after memory-model depth resolution, see
+        ``_resolve_pp_schedule``); otherwise it is plain
+        ``jax.value_and_grad`` of ``loss_fn``.
         """
         pp, m, deterministic = self._pp_setup(batch[0], train=True)
         sched = self.model_config.pipeline_schedule
-        if pp > 1 and sched in ("1F1B", "zb"):
+        if pp > 1 and sched in ("1F1B", "zb", "zb_h2", "zb_auto"):
             from .model import pipelined_lm_loss_and_grad
             tokens, position_ids, labels, loss_mask = batch
+            sched, h2_depth = self._resolve_pp_schedule(
+                sched, params, tokens, pp=pp, num_microbatches=m)
             return pipelined_lm_loss_and_grad(
                 self.model_config, params, tokens, labels, loss_mask,
                 pp=pp, num_microbatches=m,
                 vpp=self.model_config.virtual_pp_degree, rng=rng,
                 position_ids=position_ids, deterministic=deterministic,
-                schedule=sched)
+                schedule=sched, h2_depth=h2_depth)
         if pp > 1 and self.model_config.moe_num_experts:
             # GPipe trains via autodiff through pipeline_forward, which
             # discards the router aux — refuse rather than silently
             # train without the load-balance term
             raise ValueError(
                 "MoE with pipeline parallelism requires "
-                "pipeline_schedule '1F1B' or 'zb' (GPipe's autodiff "
-                "path drops the router aux loss)")
+                "pipeline_schedule '1F1B', 'zb', 'zb_h2' or 'zb_auto' "
+                "(GPipe's autodiff path drops the router aux loss)")
         return jax.value_and_grad(
             lambda p: self.loss_fn(p, batch, rng, train=True))(params)
 

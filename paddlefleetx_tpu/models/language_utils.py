@@ -79,9 +79,9 @@ def process_model_configs(config) -> None:
                 "gpipe":
             raise ValueError(
                 "MoE with pipeline parallelism requires "
-                "pipeline_schedule '1F1B' or 'zb' (GPipe trains via "
-                "autodiff through the forward-only schedule, which "
-                "drops the per-layer router aux loss)")
+                "pipeline_schedule '1F1B', 'zb', 'zb_h2' or 'zb_auto' "
+                "(GPipe trains via autodiff through the forward-only "
+                "schedule, which drops the per-layer router aux loss)")
         ep = config.Distributed.get("ep_degree") or 1
         if n_experts % ep != 0:
             raise ValueError(

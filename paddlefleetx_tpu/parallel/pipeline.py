@@ -68,6 +68,28 @@ precomputed host-side (``zb_dw_schedule``) and fed to the scan as
 per-tick indices; the same host math yields the
 ``pipeline/{fwd,bwd_dx,bwd_dw,bubble}_ticks`` trace-time counters
 that make the occupancy win auditable (docs/pipeline.md).
+
+ZB-H2 schedule (``schedule="zb_h2"``, same family): spend HBM
+headroom to also kill the *fill-phase* bubble. Virtual stage ``k``
+runs up to ``h2_depth`` extra warm-up forwards ahead of the 1F1B
+pattern — its in-flight forward cap rises from ``K - k`` to
+``min(2(K - k) - 1, (K - k) + h2_depth)`` — so the fill-phase ticks
+1F1B leaves idle are filled with real forward work, while the dW FIFO
+(its capacity raised to ``min(k + h2_depth, M)``) drains into
+whatever bubble remains. In the decoupled-stage occupancy model
+(``pipeline_tick_stats``) the bubble at depth ``d`` is
+``(K-1-d)(K-d)/2`` once ``M >= 2K - 1`` — zero at the full depth
+``d = K - 1``. The lockstep SPMD scan cannot literally run ahead
+(stage ``k`` has no input before tick ``k``), so the scan's zb_h2
+branch replays the *deferred-dW half* of the schedule: the deeper
+FIFO timetable (with forced just-in-time pops so nothing leaks past
+the last tick) and the deeper cotangent ring (``K + h2_depth + 1``
+rows — the HBM the schedule spends) — proving the numerics and the
+queue machinery an MPMD runtime (ROADMAP item 4) would execute for
+the wall-clock win. Gradients stay bitwise-equal to 1F1B: pops are
+FIFO in microbatch order, so the fp32 accumulation order never
+changes. The analytic per-stage byte model and the ``zb_auto``
+schedule chooser live in ``parallel/pp_memory.py``.
 """
 
 from __future__ import annotations
@@ -147,46 +169,67 @@ def _slot_keys(base_rng: jax.Array, m_arr: jax.Array,
     return jax.vmap(key_for)(m_arr, k_arr)
 
 
-def zb_queue_bound(num_microbatches: int, num_virtual_stages: int) -> int:
-    """Upper bound on the zb per-slot dW-queue depth: virtual stage
-    ``k`` defers at most ``min(k, M)`` weight-grad jobs (it has exactly
-    ``k`` drain-bubble ticks to spend them in), so no slot ever queues
-    more than ``min(K - 1, M)`` microbatch cotangents."""
-    return min(num_virtual_stages - 1, num_microbatches)
+def zb_queue_bound(num_microbatches: int, num_virtual_stages: int,
+                   h2_depth: int = 0) -> int:
+    """Upper bound on the zb/zb_h2 per-slot dW-queue depth: virtual
+    stage ``k`` defers at most ``min(k + h2_depth, M)`` weight-grad
+    jobs (``h2_depth = 0`` is plain zb: stage ``k`` has exactly ``k``
+    drain-bubble ticks to spend them in), so no slot ever queues more
+    than ``min(K - 1 + h2_depth, M)`` microbatch cotangents."""
+    return min(num_virtual_stages - 1 + max(int(h2_depth), 0),
+               num_microbatches)
 
 
-def zb_dw_schedule(num_microbatches: int, num_virtual_stages: int):
-    """Static dW drain timetable for the zero-bubble schedule.
+def zb_dw_schedule(num_microbatches: int, num_virtual_stages: int,
+                   h2_depth: int = 0):
+    """Static dW drain timetable for the zero-bubble schedule family.
 
     Pure host math — the 1F1B tick grid is a fixed function of
     ``(M, K)``, so *when* each deferred weight-grad job runs is decided
     here, not inside the scan. Per virtual stage ``k`` a FIFO of
-    capacity ``min(k, M)`` receives one job at each dX tick; a job pops
-    (and its dW runs) either when the push would overflow the capacity
-    (steady state — the same tick, exactly like 1F1B, for ``k = 0``) or
-    at a tick where the slot's backward wave is idle (the former
-    drain-bubble ticks, which the deferred jobs now fill).
+    capacity ``min(k + h2_depth, M)`` receives one job at each dX
+    tick; a job pops (and its dW runs) when the push would overflow
+    the capacity (steady state — the same tick, exactly like 1F1B, for
+    ``k = 0`` at depth 0), at a tick where the slot's backward wave is
+    idle (the former drain-bubble ticks, which the deferred jobs now
+    fill), or — with ``h2_depth > 0``, whose deeper FIFOs can outlast
+    the ``k`` trailing idle ticks — just in time: whenever the jobs
+    still outstanding (queued or yet to be pushed) need every
+    remaining tick to drain one-per-tick, a pop runs alongside that
+    tick's dX. At depth 0 the JIT rule fires exactly when the
+    overflow rule already does, so the zb timetable is bit-identical
+    with and without it;
+    at any depth it keeps every pop of microbatch ``m`` at or before
+    tick ``m + 2K - 1`` (pops are FIFO, one per tick, and all land by
+    ``T - 1``), which is what lets the activation ring stay at depth
+    ``2K``: the forward entry for ``(m, k)`` is overwritten at tick
+    ``m + k + 2K``, strictly later.
 
     Returns ``(dw_m, max_depth)``: ``dw_m`` is an int ``[T, K]`` array
     (``T = M + 2K - 1``) whose entry is the microbatch whose dW runs at
     that (tick, virtual stage), or ``-1``; ``max_depth`` is the deepest
-    any FIFO ever got (``<= zb_queue_bound(M, K)``).
+    any FIFO ever got (``<= zb_queue_bound(M, K, h2_depth)``).
     """
     M, K = num_microbatches, num_virtual_stages
+    d = int(h2_depth)
+    if d < 0:
+        raise ValueError(f"h2_depth must be >= 0, got {h2_depth}")
     T = M + 2 * K - 1
     dw_m = np.full((T, K), -1, np.int32)
     max_depth = 0
     for k in range(K):
-        cap = min(k, M)
+        cap = min(k + d, M)
         fifo: list = []
+        npop = 0
         for t in range(T):
             m_b = t - (2 * K - 1 - k)
-            if 0 <= m_b < M:
+            pushed = 0 <= m_b < M
+            if pushed:
                 fifo.append(m_b)
-                if len(fifo) > cap:
-                    dw_m[t, k] = fifo.pop(0)
-            elif fifo:
+            if fifo and (len(fifo) > cap or not pushed
+                         or M - npop >= T - t):
                 dw_m[t, k] = fifo.pop(0)
+                npop += 1
             max_depth = max(max_depth, len(fifo))
         if fifo:   # every job must drain within the schedule
             raise AssertionError(
@@ -194,49 +237,143 @@ def zb_dw_schedule(num_microbatches: int, num_virtual_stages: int):
     return dw_m, max_depth
 
 
-def pipeline_tick_stats(num_microbatches: int, num_virtual_stages: int,
-                        schedule: str = "1f1b") -> dict:
-    """Analytic (slot, tick) occupancy of a pipeline schedule.
+def h2_fwd_caps(num_microbatches: int, num_virtual_stages: int,
+                h2_depth: int) -> list:
+    """Per-virtual-stage in-flight forward caps (forwards done minus
+    dXs done) for the schedule family. 1f1b/zb warm up ``K - k``
+    forwards at stage ``k``; zb_h2 at depth ``d`` warms up
+    ``min(2(K - k) - 1, (K - k) + d)`` — each extra in-flight forward
+    is one more stashed microbatch activation (the HBM the schedule
+    spends, priced by ``parallel/pp_memory.py``)."""
+    M, K, d = num_microbatches, num_virtual_stages, h2_depth
+    return [min(min(2 * (K - k) - 1, (K - k) + d), M) for k in range(K)]
 
-    The scan runs in SPMD lockstep, so tick counts are trace-time
-    constants — this is the single source for the
+
+def pipeline_tick_stats(num_microbatches: int, num_virtual_stages: int,
+                        schedule: str = "1f1b",
+                        h2_depth: Optional[int] = None) -> dict:
+    """Analytic per-stage occupancy of a pipeline schedule.
+
+    For the training schedules (1f1b / zb / zb_h2) this simulates the
+    *decoupled-stage unit model*: each virtual stage executes at most
+    one work unit (forward, dX, or dW — all unit-cost) per tick, dX
+    has priority (critical path), forwards run work-conserving up to
+    the stage's in-flight cap (``h2_fwd_caps``), and deferred dW jobs
+    drain FIFO into ticks the stage would otherwise idle. A stage's
+    ``total`` is its active span (first to last unit), its ``bubble``
+    the idle ticks inside that span — so
+    ``fwd + bwd_dx + bwd_dw + bubble == total_slot_ticks`` holds
+    exactly (the conservation identity the property tests pin). This
+    models what each schedule buys on a decoupled MPMD runtime
+    (ROADMAP item 4); the lockstep scan replays the matching dW
+    timetable to prove the numerics. Closed forms at ``M >= K``:
+    1f1b bubble ``K(K-1)``, zb ``K(K-1)/2``, and zb_h2 at depth ``d``
+    ``(K-1-d)(K-d)/2`` once ``M >= 2K - 1`` — zero at ``d = K - 1``.
+
+    ``schedule="gpipe"`` keeps the lockstep forward-only fill/drain
+    grid (that IS what ``pipeline_forward`` executes): ``M*K`` forward
+    slot-ticks inside a ``(M + K - 1) * K`` grid, the rest bubble —
+    the same conservation identity, different accounting basis.
+
+    ``h2_depth`` (zb_h2 only): extra warm-up forwards per stage;
+    ``None`` or negative picks the full depth ``K - 1``.
+
+    This is the single source for the
     ``pipeline/{fwd,bwd_dx,bwd_dw,bubble}_ticks`` counters and the
-    engine's ``pipeline_bubble`` goodput bucket. A slot-tick counts as
-    ``bubble`` when the slot schedules NO useful work there: no valid
-    forward, no valid dX/backward, and (zb) no drained dW job. For
-    ``M >= 2K - 1`` the zb drain fills every trailing bubble slot-tick,
-    halving ``bubble_ticks`` vs 1f1b — the fill-phase half precedes any
-    runnable job and is irreducible in a lockstep schedule.
+    engine's ``pipeline_bubble`` goodput bucket.
     """
     M, K = num_microbatches, num_virtual_stages
-    sched = str(schedule).lower()
+    sched = str(schedule).lower().replace("-", "_")
     if sched == "gpipe":
         T = M + K - 1
-        fwd = np.zeros((T, K), bool)
-        for k in range(K):
-            fwd[k:k + M, k] = True
-        return {"fwd_ticks": int(fwd.sum()), "bwd_dx_ticks": 0,
+        return {"fwd_ticks": M * K, "bwd_dx_ticks": 0,
                 "bwd_dw_ticks": 0,
-                "bubble_ticks": int(T * K - fwd.sum()),
-                "total_slot_ticks": T * K}
-    if sched not in ("1f1b", "zb"):
+                "bubble_ticks": T * K - M * K,
+                "total_slot_ticks": T * K,
+                "makespan_ticks": T,
+                "per_stage_bubble_ticks": [K - 1] * K,
+                "h2_depth": 0,
+                "dw_queue_peak": 0}
+    if sched not in ("1f1b", "zb", "zb_h2"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
-    T = M + 2 * K - 1
-    fwd = np.zeros((T, K), bool)
-    bwd = np.zeros((T, K), bool)
-    for k in range(K):
-        fwd[k:k + M, k] = True
-        bwd[2 * K - 1 - k:2 * K - 1 - k + M, k] = True
-    if sched == "zb":
-        dw = zb_dw_schedule(M, K)[0] >= 0
+    d = 0
+    if sched == "zb_h2":
+        d = (K - 1) if (h2_depth is None or h2_depth < 0) \
+            else min(int(h2_depth), K - 1)
+    if sched == "zb_h2":
+        cap = h2_fwd_caps(M, K, d)
     else:
-        dw = bwd   # 1f1b computes dW in the same tick as dX
-    busy = fwd | bwd | dw
-    return {"fwd_ticks": int(fwd.sum()),
-            "bwd_dx_ticks": int(bwd.sum()),
-            "bwd_dw_ticks": int(dw.sum()),
-            "bubble_ticks": int(T * K - busy.sum()),
-            "total_slot_ticks": T * K}
+        cap = [min(K - k, M) for k in range(K)]
+
+    t_first = [None] * K
+    t_last = [0] * K
+    nF = [0] * K            # forwards done per stage
+    nD = [0] * K            # dXs done
+    nW = [0] * K            # dWs done
+    fin_F = [[-1] * M for _ in range(K)]   # completion tick of F(m, k)
+    fin_D = [[-1] * M for _ in range(K)]
+    pend_W: list = [[] for _ in range(K)]  # FIFO of mbs whose dX ran
+    pair_W = [-1] * K       # 1f1b: dW bound to the dX one tick earlier
+    q_peak = 0
+    done, total_units = 0, 3 * M * K
+    t = 0
+    limit = 4 * (M + K) + 8 * K + 8
+    while done < total_units and t < limit:
+        for k in range(K):
+            ran = -1
+            # 1f1b's combined backward: dW immediately follows its dX
+            if sched == "1f1b" and pair_W[k] >= 0:
+                pair_W[k] = -1
+                nW[k] += 1
+                ran = t
+            else:
+                m = nD[k]
+                d_ready = m < M and (
+                    fin_D[k + 1][m] >= 0 and fin_D[k + 1][m] < t
+                    if k < K - 1
+                    else fin_F[k][m] >= 0 and fin_F[k][m] < t)
+                m_f = nF[k]
+                f_ready = m_f < M and (nF[k] - nD[k]) < cap[k] and (
+                    k == 0 or (fin_F[k - 1][m_f] >= 0
+                               and fin_F[k - 1][m_f] < t))
+                if d_ready:
+                    fin_D[k][m] = t
+                    nD[k] += 1
+                    ran = t
+                    if sched == "1f1b":
+                        pair_W[k] = m
+                    else:
+                        pend_W[k].append(m)
+                        q_peak = max(q_peak, len(pend_W[k]))
+                elif f_ready:
+                    fin_F[k][m_f] = t
+                    nF[k] += 1
+                    ran = t
+                elif pend_W[k]:
+                    pend_W[k].pop(0)
+                    nW[k] += 1
+                    ran = t
+            if ran >= 0:
+                done += 1
+                if t_first[k] is None:
+                    t_first[k] = t
+                t_last[k] = t
+        t += 1
+    if done != total_units:
+        raise AssertionError(
+            f"pipeline unit-model deadlock: {done}/{total_units} units "
+            f"at (M={M}, K={K}, schedule={sched!r}, depth={d})")
+    spans = [t_last[k] - t_first[k] + 1 for k in range(K)]
+    per_stage_bubble = [spans[k] - 3 * M for k in range(K)]
+    return {"fwd_ticks": M * K,
+            "bwd_dx_ticks": M * K,
+            "bwd_dw_ticks": M * K,
+            "bubble_ticks": sum(per_stage_bubble),
+            "total_slot_ticks": sum(spans),
+            "makespan_ticks": max(t_last) + 1,
+            "per_stage_bubble_ticks": per_stage_bubble,
+            "h2_depth": d,
+            "dw_queue_peak": q_peak}
 
 
 def pipeline_forward(
@@ -383,6 +520,7 @@ def pipeline_value_and_grad(
     extras: Any = None,
     rng: Optional[jax.Array] = None,
     schedule: str = "1f1b",
+    h2_depth: int = -1,
     layer_has_aux: bool = False,
 ) -> Tuple[jax.Array, Any, Any, jax.Array]:
     """Explicit 1F1B (or zero-bubble) schedule: loss AND gradients in
@@ -409,12 +547,22 @@ def pipeline_value_and_grad(
         dhead_mb)`` — per-microbatch loss, its cotangent wrt ``y_mb``,
         and the gradient pytree for any head/criterion parameters
         closed over by the caller (summed over microbatches here).
-      schedule: ``"1f1b"`` (the combined backward above) or ``"zb"``
+      schedule: ``"1f1b"`` (the combined backward above), ``"zb"``
         (zero-bubble: dX-only vjp on the critical path, dW replayed
         from the stashed input at the statically precomputed drain
-        tick — see the module docstring). Gradients are identical
-        between the two: the dW FIFO drains in microbatch order, so
-        even the fp32 accumulation order matches.
+        tick — see the module docstring), or ``"zb_h2"`` (the same
+        machinery with the dW FIFO deepened by ``h2_depth``: the
+        timetable an MPMD runtime running ``h2_depth`` extra warm-up
+        forwards would drain, priced by the deeper cotangent ring).
+        Gradients are identical across all three: the dW FIFO drains
+        in microbatch order, so even the fp32 accumulation order
+        matches.
+      h2_depth: zb_h2 only — extra warm-up forwards per virtual
+        stage, ``0 <= h2_depth <= K - 1`` (``-1`` picks the full
+        depth ``K - 1``; depth 0 degenerates to plain zb). Raises the
+        per-slot dW FIFO capacity to ``min(k + h2_depth, M)`` and the
+        cotangent ring to ``K + h2_depth + 1`` rows — the HBM spend
+        ``parallel/pp_memory.py`` prices and validates.
       layer_has_aux: ``layer_apply`` returns ``(h, aux_scalar)`` (MoE
         router aux loss). The aux of every valid (microbatch, virtual
         stage) is added to ``loss_sum`` at its forward tick, and a
@@ -433,18 +581,23 @@ def pipeline_value_and_grad(
     B = x.shape[0]
     if B % M != 0:
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
-    sched = str(schedule).lower()
-    if sched not in ("1f1b", "zb"):
+    sched = str(schedule).lower().replace("-", "_")
+    if sched not in ("1f1b", "zb", "zb_h2"):
         raise ValueError(
-            f"unknown pipeline schedule {schedule!r} (expected '1f1b' "
-            f"or 'zb'; GPipe routes through pipeline_forward)")
+            f"unknown pipeline schedule {schedule!r} (expected '1f1b', "
+            f"'zb' or 'zb_h2'; GPipe routes through pipeline_forward)")
+    h2 = 0
+    if sched == "zb_h2":
+        h2 = (K - 1) if h2_depth < 0 else min(int(h2_depth), K - 1)
     # trace-time occupancy counters: the tick grid is a static function
     # of (M, K), so one inc per compilation records the whole schedule
-    ts = pipeline_tick_stats(M, K, schedule=sched)
+    ts = pipeline_tick_stats(M, K, schedule=sched, h2_depth=h2)
     metrics.inc("pipeline/fwd_ticks", ts["fwd_ticks"])
     metrics.inc("pipeline/bwd_dx_ticks", ts["bwd_dx_ticks"])
     metrics.inc("pipeline/bwd_dw_ticks", ts["bwd_dw_ticks"])
     metrics.inc("pipeline/bubble_ticks", ts["bubble_ticks"])
+    if sched == "zb_h2":
+        metrics.inc("pipeline/h2_depth", h2)
     slot_params, Lc = _slot_params(stacked_params, S, vpp)
 
     x_mb = x.reshape(M, B // M, *x.shape[1:])
@@ -646,14 +799,20 @@ def pipeline_value_and_grad(
             tick, carry0, jnp.arange(M + 2 * K - 1))
     else:
         # ---- zero-bubble: dX on the critical path, dW drained at the
-        # statically precomputed tick (module docstring) --------------
-        dw_np, _ = zb_dw_schedule(M, K)
+        # statically precomputed tick (module docstring). zb_h2 is the
+        # same scan with the FIFO deepened by h2 — only the cotangent
+        # ring grows; the activation ring stays 2K because the forced
+        # just-in-time pops keep every drain of microbatch m at or
+        # before tick m + 2K - 1 (zb_dw_schedule docstring) ----------
+        dw_np, _ = zb_dw_schedule(M, K, h2_depth=h2)
         dw_rows = jnp.asarray(dw_np.reshape(len(dw_np), vpp, S))
-        # cotangent ring: the dW queue holds at most min(k, M) + 1
-        # entries per slot (<= K), indexed m % K; row K is scratch so
-        # masked writes never clobber a live entry
+        # cotangent ring: the dW queue holds at most min(k + h2, M)
+        # entries per slot (<= K + h2 - 1), indexed m % (K + h2) plus
+        # the in-flight push; row K + h2 is scratch so masked writes
+        # never clobber a live entry
+        Rg = K + h2
         gstash0 = _constrain(
-            jnp.zeros((vpp, S, K + 1) + mb_shape, x.dtype),
+            jnp.zeros((vpp, S, Rg + 1) + mb_shape, x.dtype),
             P(None, PP_AXIS, None, DATA_AXES))
 
         def tick(carry, xs):
@@ -690,7 +849,8 @@ def pipeline_value_and_grad(
             # enqueue the cotangent for the deferred dW. The write
             # happens before the drain read on purpose: the k=0 slot
             # (capacity 0) pops the entry it pushed this very tick.
-            gdepth = jnp.where(valid_b, jnp.clip(m_b, 0, M - 1) % K, K)
+            gdepth = jnp.where(valid_b, jnp.clip(m_b, 0, M - 1) % Rg,
+                               Rg)
             gstash = jax.vmap(jax.vmap(
                 lambda gs, d, gg:
                 jax.lax.dynamic_update_index_in_dim(gs, gg, d, 0)))(
@@ -705,7 +865,7 @@ def pipeline_value_and_grad(
             # forward of mb m at slot k ran at tick m + k, so its
             # stashed input lives at ring depth (m + k) % D
             x_w = _gather_ring(stash, (w_m + k_arr) % D)
-            g_w = _gather_ring(gstash, jnp.where(valid_w, w_m % K, K))
+            g_w = _gather_ring(gstash, jnp.where(valid_w, w_m % Rg, Rg))
             w_keys = _slot_keys(base_rng, w_m, K).reshape(vpp, S)
             if layer_has_aux:
                 dp = slot_backward_dw_aux(

@@ -1043,39 +1043,60 @@ def test_disabled_registry_overhead_under_one_percent_of_step():
 
 def test_bench_pipeline_runs_offline(monkeypatch, capsys):
     """The pipeline bench's tiny CPU path must execute end to end on
-    the 8-device mesh and emit the pinned A/B pair — the 1F1B
-    baseline row first, then the zb headline whose analytic bubble
-    split shows the deferred-dW drain reclaiming at least half the
-    1F1B bubble at the default M=8, K=4 shape — with bitwise loss
-    agreement between the schedules (the same record shapes the
-    on-chip 345M run emits)."""
+    the 8-device mesh and emit the pinned three-arm A/B — the 1F1B
+    baseline row, the zb row, then the zb_h2 headline whose analytic
+    bubble split hits zero at the default M=8, K=4 shape (full depth,
+    M >= 2K-1) — with bitwise loss agreement between the schedules
+    and the per-stage memory prediction riding next to the HBM
+    watermark in every row (the same record shapes the on-chip 345M
+    run emits)."""
     monkeypatch.setenv("PFX_BENCH_PIPELINE_STEPS", "1")
     bench.bench_pipeline()
     lines = capsys.readouterr().out.strip().splitlines()
     recs = [json.loads(ln) for ln in lines if ln.startswith("{")]
-    base, rec = recs[-2], recs[-1]
+    base, zb, rec = recs[-3], recs[-2], recs[-1]
     assert base["metric"] == \
         "gpt345m_pp4_pipeline_1f1b_baseline_tokens_per_sec_per_chip"
     assert base["value"] > 0 and base["unit"] == "tokens/s"
+    assert zb["metric"] == \
+        "gpt345m_pp4_pipeline_zb_tokens_per_sec_per_chip"
     assert rec["metric"] == bench.METRIC_BY_MODE["pipeline"]
     assert rec["metric"] == \
-        "gpt345m_pp4_pipeline_zb_tokens_per_sec_per_chip"
+        "gpt345m_pp4_pipeline_zb_h2_tokens_per_sec_per_chip"
     assert rec["value"] > 0 and rec["unit"] == "tokens/s"
-    # the A/B is self-describing: shape rides in both rows
-    assert rec["pp"] == base["pp"] == 4
+    # the A/B is self-describing: shape rides in all rows
+    assert rec["pp"] == zb["pp"] == base["pp"] == 4
     assert rec["vpp"] == base["vpp"] == 1
     assert rec["microbatches"] == base["microbatches"] == 8
     assert rec["step_time_ms"] > 0 and base["step_time_ms"] > 0
-    # analytic occupancy: zb reclaims >= half the 1F1B bubble at
-    # M=8, K=4 (the ISSUE's acceptance shape)
-    assert base["bubble_share"] == pytest.approx(12 / 60)
-    assert rec["bubble_share"] == pytest.approx(6 / 60)
-    assert rec["bubble_ticks_1f1b"] == 12
-    assert rec["bubble_ticks_zb"] == 6
-    assert rec["bubble_fill_ratio"] >= 0.5
-    assert rec["dw_queue_bound"] == 3
+    assert rec["h2_depth"] == 3   # full depth K-1
+    # analytic occupancy under the decoupled-stage unit model: zb
+    # reclaims >= half the 1F1B bubble at M=8, K=4 and zb_h2 kills it
+    # shares are rounded to 4 decimals in the record
+    assert base["bubble_share"] == pytest.approx(12 / 108, abs=5e-5)
+    assert zb["bubble_share"] == pytest.approx(6 / 102, abs=5e-5)
+    assert rec["bubble_share"] == 0.0
+    assert rec["bubble_ticks_1f1b"] == zb["bubble_ticks_1f1b"] == 12
+    assert rec["bubble_ticks_zb"] == zb["bubble_ticks_zb"] == 6
+    assert rec["bubble_ticks_zb_h2"] == 0
+    assert zb["bubble_fill_ratio"] >= 0.5
+    assert rec["bubble_fill_ratio"] == 1.0
+    assert rec["bubble_fill_ratio"] > zb["bubble_fill_ratio"]
+    assert zb["dw_queue_bound"] == 3        # min(K-1, M)
+    assert rec["dw_queue_bound"] == 6       # min(K-1+d, M)
+    # the analytic memory prediction rides next to the measured
+    # watermark (null off-TPU) in every row, H2 costing the most
+    for r in (base, zb, rec):
+        assert r["predicted_stage_bytes"] > 0
+        assert "hbm_peak_bytes" in r
+        assert r["memory_tolerance"] == 0.5
+    assert rec["predicted_stage_bytes"] > zb["predicted_stage_bytes"] \
+        > base["predicted_stage_bytes"]
+    assert "hbm_budget_bytes" in rec
+    assert "memory_within_tolerance" in rec
     # the schedules compute the identical loss (grad parity is pinned
     # in test_pipeline.py; the bench re-checks the cheap scalar)
+    assert zb["loss_delta_vs_1f1b"] == 0.0
     assert rec["loss_delta_vs_1f1b"] == 0.0
     assert rec["baseline_1f1b_tokens_per_sec"] == base["value"]
     assert rec["speedup_vs_1f1b"] is not None
@@ -1085,20 +1106,25 @@ def test_bench_pipeline_knobs(monkeypatch, capsys):
     """PFX_BENCH_PIPELINE_MICROBATCHES / _STEPS pin the A/B shape and
     are echoed back; the analytic bubble split tracks the requested M
     (at M=4 < 2K-1 the drain window is shorter than the backlog, so
-    the fill ratio drops below the M=8 half)."""
+    neither zb's fill ratio nor zb_h2's reaches its M=8 value)."""
     from paddlefleetx_tpu.parallel.pipeline import pipeline_tick_stats
     monkeypatch.setenv("PFX_BENCH_PIPELINE_MICROBATCHES", "4")
     monkeypatch.setenv("PFX_BENCH_PIPELINE_STEPS", "1")
     bench.bench_pipeline()
     lines = capsys.readouterr().out.strip().splitlines()
     recs = [json.loads(ln) for ln in lines if ln.startswith("{")]
-    base, rec = recs[-2], recs[-1]
+    base, zb, rec = recs[-3], recs[-2], recs[-1]
     assert rec["microbatches"] == base["microbatches"] == 4
     assert rec["steps"] == base["steps"] == 1
     ts1 = pipeline_tick_stats(4, 4, schedule="1f1b")
     tsz = pipeline_tick_stats(4, 4, schedule="zb")
+    tsh = pipeline_tick_stats(4, 4, schedule="zb_h2", h2_depth=3)
     assert rec["bubble_ticks_1f1b"] == ts1["bubble_ticks"]
     assert rec["bubble_ticks_zb"] == tsz["bubble_ticks"]
-    assert rec["bubble_ticks_zb"] < rec["bubble_ticks_1f1b"]
-    assert rec["dw_queue_bound"] == 3   # min(K-1, M)
+    assert rec["bubble_ticks_zb_h2"] == tsh["bubble_ticks"]
+    assert rec["bubble_ticks_zb_h2"] < rec["bubble_ticks_zb"] \
+        < rec["bubble_ticks_1f1b"]
+    assert zb["dw_queue_bound"] == 3    # min(K-1, M)
+    assert rec["dw_queue_bound"] == 4   # min(K-1+d, M) clamps at M
+    assert zb["loss_delta_vs_1f1b"] == 0.0
     assert rec["loss_delta_vs_1f1b"] == 0.0

@@ -309,9 +309,10 @@ def test_moe_pp_gpipe_rejected():
 
     with pytest.raises(ValueError, match="MoE.*pipeline"):
         process_model_configs(_cfg(pipeline_schedule="GPipe"))
-    # the default (1F1B) and zb schedules compose with MoE
+    # the default (1F1B) and the zb schedule family compose with MoE
     process_model_configs(_cfg())
-    process_model_configs(_cfg(pipeline_schedule="zb"))
+    for sched in ("zb", "zb_h2", "zb_auto"):
+        process_model_configs(_cfg(pipeline_schedule=sched))
 
 
 def test_ep_must_divide_experts():

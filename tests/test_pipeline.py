@@ -365,18 +365,20 @@ def test_zb_grad_parity_matrix(pp, vpp, M):
         return l, dy, db
 
     out = {}
-    for sched in ("1f1b", "zb"):
+    for sched in ("1f1b", "zb", "zb_h2"):
         out[sched] = pipeline_value_and_grad(
             _dropout_layer, w, x, pp=pp, num_microbatches=M, vpp=vpp,
             loss_and_grad=loss_and_grad, extras=tgt, rng=base_rng,
             schedule=sched)
     l1, dw1, db1, dx1 = out["1f1b"]
+    for sched in ("zb", "zb_h2"):
+        l2, dw2, db2, dx2 = out[sched]
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(dw1), np.asarray(dw2))
+        np.testing.assert_allclose(np.asarray(db1), np.asarray(db2),
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(dx1), np.asarray(dx2))
     l2, dw2, db2, dx2 = out["zb"]
-    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
-    np.testing.assert_array_equal(np.asarray(dw1), np.asarray(dw2))
-    np.testing.assert_allclose(np.asarray(db1), np.asarray(db2),
-                               rtol=1e-6)
-    np.testing.assert_array_equal(np.asarray(dx1), np.asarray(dx2))
 
     K = pp * vpp
     ref_loss, (ref_dw, ref_db) = jax.value_and_grad(
@@ -392,37 +394,108 @@ def test_zb_grad_parity_matrix(pp, vpp, M):
                                atol=1e-5, rtol=1e-4)
 
 
+@pytest.mark.parametrize("h2_depth", [0, 1, 3])
 @pytest.mark.parametrize("M, K", [(1, 2), (4, 2), (3, 4), (4, 4),
                                   (8, 4), (8, 8), (16, 4)])
-def test_zb_dw_schedule_bounds(M, K):
+def test_zb_dw_schedule_bounds(M, K, h2_depth):
     """The dW timetable drains every (microbatch, slot) job exactly
-    once, in microbatch order, never before its dX tick, and the FIFO
-    depth stays within the documented bound."""
-    dw, max_depth = zb_dw_schedule(M, K)
+    once, in microbatch order, never before its dX tick, never after
+    the tick-``m + 2K - 1`` bound that keeps the activation ring at
+    depth 2K, and the FIFO depth stays within the documented bound —
+    at every H2 depth."""
+    dw, max_depth = zb_dw_schedule(M, K, h2_depth=h2_depth)
     assert dw.shape == (M + 2 * K - 1, K)
-    assert max_depth <= zb_queue_bound(M, K)
+    assert max_depth <= zb_queue_bound(M, K, h2_depth=h2_depth)
     for k in range(K):
         drained = [int(m) for m in dw[:, k] if m >= 0]
         assert drained == list(range(M))   # exactly once, FIFO order
         for t in range(dw.shape[0]):
             if dw[t, k] >= 0:
                 assert t >= int(dw[t, k]) + 2 * K - 1 - k
+                assert t <= int(dw[t, k]) + 2 * K - 1
+
+
+def test_zb_dw_schedule_depth0_is_zb():
+    """h2_depth=0 reproduces the plain-zb timetable bit for bit (the
+    just-in-time pop rule fires exactly when the overflow rule does)."""
+    for M, K in [(1, 2), (4, 2), (8, 4), (7, 4), (16, 8)]:
+        a, da = zb_dw_schedule(M, K)
+        b, db = zb_dw_schedule(M, K, h2_depth=0)
+        np.testing.assert_array_equal(a, b)
+        assert da == db
 
 
 def test_zb_tick_stats_fill_half_bubble():
-    """Acceptance shape (pp4, M=8): zb's dW work occupies exactly the
-    K-1 trailing drain ticks per slot — half the 1f1b bubble."""
+    """Acceptance shape (pp4, M=8) under the decoupled-stage unit
+    model: zb's deferred-dW drain halves the 1f1b bubble, and zb_h2 at
+    full depth (M >= 2K - 1) eliminates it."""
     a = pipeline_tick_stats(8, 4, schedule="1f1b")
     b = pipeline_tick_stats(8, 4, schedule="zb")
-    assert a["fwd_ticks"] == b["fwd_ticks"] == 32
+    h = pipeline_tick_stats(8, 4, schedule="zb_h2")
+    assert a["fwd_ticks"] == b["fwd_ticks"] == h["fwd_ticks"] == 32
     assert a["bwd_dx_ticks"] == b["bwd_dx_ticks"] == 32
-    assert b["bwd_dw_ticks"] == 32
-    assert a["total_slot_ticks"] == b["total_slot_ticks"] == 60
+    assert a["bwd_dw_ticks"] == b["bwd_dw_ticks"] == 32
+    # span accounting: total = 3MK work + bubble inside the spans
+    assert a["total_slot_ticks"] == 108
+    assert b["total_slot_ticks"] == 102
     # dW occupies >= half of the former fill/drain bubble (integer
-    # math; at M >= 2K-1 it is exactly half — all K-1 trailing ticks)
+    # math; at M >= 2K-1 it is exactly half — K(K-1)/2)
     assert 2 * (a["bubble_ticks"] - b["bubble_ticks"]) >= \
         a["bubble_ticks"], (a, b)
     assert a["bubble_ticks"] == 12 and b["bubble_ticks"] == 6
+    # zb_h2 at full depth d = K-1: zero bubble, makespan 3M + K - 1
+    assert h["h2_depth"] == 3
+    assert h["bubble_ticks"] == 0
+    assert h["total_slot_ticks"] == 96
+    assert h["makespan_ticks"] == 27
+    # intermediate depth: (K-1-d)(K-d)/2
+    assert pipeline_tick_stats(8, 4, schedule="zb_h2",
+                               h2_depth=1)["bubble_ticks"] == 3
+
+
+@pytest.mark.parametrize("M, K", [(1, 2), (2, 2), (4, 2), (3, 4),
+                                  (4, 4), (7, 4), (8, 4), (16, 4),
+                                  (8, 8), (15, 8)])
+def test_tick_stats_conservation_and_monotonicity(M, K):
+    """Property grid: for every schedule the slot-tick split conserves
+    (fwd + bwd_dx + bwd_dw + bubble == total_slot_ticks), the bubble
+    is monotonically non-increasing along 1f1b -> zb -> zb_h2 (and in
+    H2 depth), strictly decreasing zb -> zb_h2 at M >= K (except
+    (M=2, K=2), where zb is already bubble-optimal), zero at full
+    depth once M >= 2K - 1 — and no replayed dW timetable ever
+    exceeds ``zb_queue_bound``."""
+    stats = {}
+    for sched in ("gpipe", "1f1b", "zb", "zb_h2"):
+        ts = pipeline_tick_stats(M, K, schedule=sched)
+        assert ts["fwd_ticks"] + ts["bwd_dx_ticks"] + \
+            ts["bwd_dw_ticks"] + ts["bubble_ticks"] == \
+            ts["total_slot_ticks"], (sched, ts)
+        stats[sched] = ts
+    assert stats["1f1b"]["bubble_ticks"] >= stats["zb"]["bubble_ticks"]
+    assert stats["zb"]["bubble_ticks"] >= stats["zb_h2"]["bubble_ticks"]
+    if M >= K and (M, K) != (2, 2):
+        assert stats["zb_h2"]["bubble_ticks"] < \
+            stats["zb"]["bubble_ticks"]
+    if M >= 2 * K - 1:
+        assert stats["1f1b"]["bubble_ticks"] == K * (K - 1)
+        assert stats["zb"]["bubble_ticks"] == K * (K - 1) // 2
+        assert stats["zb_h2"]["bubble_ticks"] == 0
+    prev = None
+    for d in range(K):
+        ts = pipeline_tick_stats(M, K, schedule="zb_h2", h2_depth=d)
+        assert ts["fwd_ticks"] + ts["bwd_dx_ticks"] + \
+            ts["bwd_dw_ticks"] + ts["bubble_ticks"] == \
+            ts["total_slot_ticks"]
+        if M >= 2 * K - 1:
+            assert ts["bubble_ticks"] == (K - 1 - d) * (K - d) // 2
+        if prev is not None:
+            assert ts["bubble_ticks"] <= prev
+        prev = ts["bubble_ticks"]
+        # the scan-side timetable honors the documented queue bound
+        _, max_depth = zb_dw_schedule(M, K, h2_depth=d)
+        assert max_depth <= zb_queue_bound(M, K, h2_depth=d)
+        # the unit model defers at most one dW per microbatch
+        assert ts["dw_queue_peak"] <= M
 
 
 @pytest.fixture
@@ -456,7 +529,7 @@ def test_pipeline_tick_counters(_registry):
         return l, dy, db
 
     bubbles = {}
-    for sched in ("1f1b", "zb"):
+    for sched in ("1f1b", "zb", "zb_h2"):
         _registry.reset()
         pipeline_value_and_grad(
             _dropout_layer, w, x, pp=pp, num_microbatches=M,
@@ -465,8 +538,13 @@ def test_pipeline_tick_counters(_registry):
         assert _registry.counter("pipeline/bwd_dx_ticks") == M * pp
         assert _registry.counter("pipeline/bwd_dw_ticks") == M * pp
         bubbles[sched] = _registry.counter("pipeline/bubble_ticks")
+        if sched == "zb_h2":
+            # full depth K-1 recorded; M=8 >= 2K-1 -> zero bubble
+            assert _registry.counter("pipeline/h2_depth") == pp - 1
+            assert bubbles[sched] == 0
     assert 2 * (bubbles["1f1b"] - bubbles["zb"]) >= bubbles["1f1b"], \
         bubbles
+    assert bubbles["zb_h2"] < bubbles["zb"]
 
 
 @pytest.mark.parametrize("topo_kw, microbatches, vpp", [
@@ -491,6 +569,36 @@ def test_pipelined_zb_matches_single_device(golden, topo_kw,
 
     with mesh, nn.logical_axis_rules(list(rules)):
         loss, grads = jax.jit(f_zb)(params, ids, labels, mask)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, rtol=1e-3),
+        ref_grads, grads)
+
+
+@pytest.mark.parametrize("topo_kw, microbatches, vpp", [
+    ({"pp_degree": 2}, 4, 1),
+    ({"pp_degree": 2, "mp_degree": 2, "dp_degree": 2}, 4, 2),
+], ids=["h2-pp2", "h2-pp2xmp2xdp2-vpp2"])
+def test_pipelined_h2_matches_single_device(golden, topo_kw,
+                                            microbatches, vpp):
+    """The full GPT model under schedule zb_h2 (full depth) on a real
+    pp mesh matches the non-pipelined single-device loss/grads (the CI
+    zb_h2 parity smoke)."""
+    params, ids, labels, mask, ref_loss, ref_grads = golden
+    topo = TopologyConfig(**topo_kw)
+    mesh = build_mesh(topo, devices=jax.devices()[:topo.world_size])
+    set_mesh(mesh)
+    rules = make_sharding_rules(topo)
+
+    def f_h2(p, i, l, m):
+        return pipelined_lm_loss_and_grad(
+            CFG, p, i, l, m, pp=topo.pp_degree,
+            num_microbatches=microbatches, vpp=vpp,
+            deterministic=True, schedule="zb_h2", h2_depth=-1)
+
+    with mesh, nn.logical_axis_rules(list(rules)):
+        loss, grads = jax.jit(f_h2)(params, ids, labels, mask)
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(

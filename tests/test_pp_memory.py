@@ -1,0 +1,157 @@
+"""Analytic per-stage HBM model + schedule resolution
+(``parallel/pp_memory.py``): byte accounting, the budget ladder, and
+the reject-before-trace contract for infeasible zb_h2 depths.
+"""
+
+import pytest
+
+from paddlefleetx_tpu.parallel import pp_memory
+
+MK = dict(microbatch_tokens=2 * 32, hidden_size=64, param_count=100_000,
+          compute_dtype="float32", param_dtype="float32")
+
+
+def _total(schedule, d=0, pp=4, vpp=1, **over):
+    kw = {**MK, **over}
+    return pp_memory.stage_memory_bytes(
+        schedule=schedule, pp=pp, vpp=vpp, h2_depth=d,
+        **kw)["total_bytes"]
+
+
+def test_dtype_bytes():
+    assert pp_memory.dtype_bytes("float32") == 4
+    assert pp_memory.dtype_bytes("bfloat16") == 2
+    assert pp_memory.dtype_bytes("bf16") == 2
+    import numpy as np
+    assert pp_memory.dtype_bytes(np.dtype("float32")) == 4
+    with pytest.raises(ValueError, match="unknown dtype"):
+        pp_memory.dtype_bytes("float77")
+
+
+def test_stage_bytes_schedule_ordering():
+    """1f1b < zb == zb_h2@0 < zb_h2@d, monotone in depth — the exact
+    ladder the resolver walks."""
+    b_1f1b = _total("1f1b")
+    b_zb = _total("zb")
+    assert b_1f1b < b_zb
+    assert _total("zb_h2", 0) == b_zb
+    prev = b_zb
+    for d in range(1, 4):
+        cur = _total("zb_h2", d)
+        assert cur > prev
+        prev = cur
+    # the increment per depth step is exactly one microbatch
+    # activation per vpp chunk (one extra cotangent-ring row)
+    mb_act = MK["microbatch_tokens"] * MK["hidden_size"] * 4
+    assert _total("zb_h2", 2) - _total("zb_h2", 1) == mb_act
+
+
+def test_stage_bytes_dtype_aware():
+    """bf16 compute halves the ring bytes; bf16 params halve the param
+    term while grads stay fp32."""
+    full = pp_memory.stage_memory_bytes(
+        schedule="zb_h2", pp=4, h2_depth=3, **MK)
+    half = pp_memory.stage_memory_bytes(
+        schedule="zb_h2", pp=4, h2_depth=3,
+        **{**MK, "compute_dtype": "bfloat16",
+           "param_dtype": "bfloat16"})
+    assert half["act_ring_bytes"] == full["act_ring_bytes"] // 2
+    assert half["gstash_bytes"] == full["gstash_bytes"] // 2
+    assert half["params_bytes"] == full["params_bytes"] // 2
+    assert half["grads_bytes"] == full["grads_bytes"]  # fp32 accum
+
+
+def test_hbm_budget_env_knob(monkeypatch):
+    monkeypatch.setenv("PFX_PP_HBM_BUDGET_BYTES", "12345")
+    assert pp_memory.hbm_budget_bytes() == 12345
+    monkeypatch.setenv("PFX_PP_HBM_BUDGET_BYTES", "0")
+    assert pp_memory.hbm_budget_bytes() is None
+    monkeypatch.setenv("PFX_PP_HBM_BUDGET_BYTES", "lots")
+    with pytest.raises(ValueError, match="not an integer"):
+        pp_memory.hbm_budget_bytes()
+
+
+def test_resolve_passthrough_and_unknown():
+    r = pp_memory.resolve_pipeline_schedule("zb", pp=4)
+    assert (r["schedule"], r["h2_depth"]) == ("zb", 0)
+    r = pp_memory.resolve_pipeline_schedule("1F1B", pp=4)
+    assert (r["schedule"], r["h2_depth"]) == ("1F1B", 0)
+    with pytest.raises(ValueError, match="unknown pipeline schedule"):
+        pp_memory.resolve_pipeline_schedule("zb_h9", pp=4)
+
+
+def test_resolve_blind_is_optimistic_full_depth():
+    """No budget info: zb_h2/zb_auto assume full depth K-1 (keeps CPU
+    runs and the engine's estimate consistent) and say so."""
+    for sched in ("zb_h2", "zb_auto"):
+        r = pp_memory.resolve_pipeline_schedule(sched, pp=4)
+        assert (r["schedule"], r["h2_depth"]) == ("zb_h2", 3)
+        assert "no HBM budget information" in r["reason"] or \
+            "without HBM budget" in r["reason"]
+        assert r["predicted_stage_bytes"] is None
+
+
+def test_resolve_zb_auto_budget_ladder():
+    """zb_auto walks 1F1B -> zb -> zb_h2@d to the deepest feasible
+    rung for the budget."""
+    cases = [(_total("zb_h2", 3), ("zb_h2", 3)),
+             (_total("zb_h2", 2), ("zb_h2", 2)),
+             (_total("zb_h2", 1), ("zb_h2", 1)),
+             (_total("zb"), ("zb", 0)),
+             (_total("1f1b"), ("1F1B", 0))]
+    for budget, want in cases:
+        r = pp_memory.resolve_pipeline_schedule(
+            "zb_auto", pp=4, budget_bytes=budget, mem_kwargs=MK)
+        assert (r["schedule"], r["h2_depth"]) == want, (budget, r)
+        assert r["predicted_stage_bytes"] <= budget
+
+
+def test_resolve_zb_h2_rejects_infeasible_depth():
+    """An explicitly configured depth that exceeds the budget raises a
+    config-time ValueError — never an OOM at trace time."""
+    with pytest.raises(ValueError, match="bytes per stage"):
+        pp_memory.resolve_pipeline_schedule(
+            "zb_h2", pp=4, requested_depth=3,
+            budget_bytes=_total("zb"), mem_kwargs=MK)
+    # depth -1 clamps to the deepest feasible depth instead
+    r = pp_memory.resolve_pipeline_schedule(
+        "zb_h2", pp=4, requested_depth=-1,
+        budget_bytes=_total("zb_h2", 1), mem_kwargs=MK)
+    assert (r["schedule"], r["h2_depth"]) == ("zb_h2", 1)
+    # nothing feasible at all: zb_h2 refuses outright
+    with pytest.raises(ValueError, match="any depth"):
+        pp_memory.resolve_pipeline_schedule(
+            "zb_h2", pp=4, requested_depth=-1,
+            budget_bytes=_total("1f1b"), mem_kwargs=MK)
+
+
+def test_module_rejects_infeasible_depth_before_trace(monkeypatch):
+    """End to end through GPTModule._resolve_pp_schedule: a pinned
+    budget below the requested depth's bytes raises before any
+    pipeline tracing happens."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddlefleetx_tpu.models.gpt.config import GPTConfig
+    from paddlefleetx_tpu.models.gpt.modules import GPTModule
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=4,
+                    num_attention_heads=4,
+                    pipeline_schedule="zb_h2", zb_h2_depth=1)
+    tokens = jnp.zeros((4, 16), jnp.int32)
+    params = {"w": jnp.zeros((100,), jnp.float32)}
+    mod = GPTModule.__new__(GPTModule)   # skip engine-level __init__
+    mod.model_config = cfg
+    monkeypatch.setenv("PFX_PP_HBM_BUDGET_BYTES", "1024")
+    with pytest.raises(ValueError, match="bytes per stage"):
+        mod._resolve_pp_schedule("zb_h2", params, tokens, pp=2,
+                                 num_microbatches=4)
+    # zb_auto under the same starvation degrades instead of raising
+    sched, depth = mod._resolve_pp_schedule(
+        "zb_auto", params, tokens, pp=2, num_microbatches=4)
+    assert sched == "1F1B" and depth == 0
+    # and with headroom it climbs back to full depth
+    monkeypatch.setenv("PFX_PP_HBM_BUDGET_BYTES", str(1 << 40))
+    sched, depth = mod._resolve_pp_schedule(
+        "zb_auto", params, tokens, pp=2, num_microbatches=4)
+    assert sched == "zb_h2" and depth == 1
