@@ -1151,8 +1151,20 @@ def bench_serving():
     ``PFX_BENCH_SERVING_SPEC`` / ``_SPEC_TOKENS``, the int8-KV A/B
     knob ``PFX_BENCH_SERVING_KV_DTYPE``, the hierarchical-cache A/B
     knobs ``PFX_BENCH_SERVING_TIERED`` / ``_HOST_POOL_MB`` /
-    ``_TURNS``, and the device-resident-decode sweep knob
+    ``_TURNS``, the multi-tenant LoRA A/B knobs
+    ``PFX_BENCH_SERVING_ADAPTERS`` / ``_LORA_RANK``, and the
+    device-resident-decode sweep knob
     ``PFX_BENCH_SERVING_LOOP_TICKS`` (below).
+
+    Multi-tenant LoRA A/B: with ``PFX_BENCH_SERVING_ADAPTERS=N``
+    (default off) the SAME trace is served twice from one
+    LoRA-enabled twin of the model (rank ``_LORA_RANK``, default 8):
+    once all-base (adapter id 0) and once spread round-robin over N
+    seeded adapters, so decode batches mix adapter ids through the
+    grouped LoRA dispatch. One record — metric suffix ``_adapters`` —
+    reports both arms' tokens/s, their ratio (``adapter_slowdown``)
+    and the adapter-cache hit/miss/eviction counters (docs/lora.md).
+    The bf16 headline never loads a LoRA model.
 
     Tiered-cache A/B: unless ``PFX_BENCH_SERVING_TIERED=0`` (paged
     mode only), a seeded multi-turn conversational trace — shared
@@ -1493,6 +1505,85 @@ def bench_serving():
         }
         _log_success(kv_rec)
         print(json.dumps(kv_rec))
+
+    # Multi-tenant LoRA A/B (PFX_BENCH_SERVING_ADAPTERS=N, default
+    # off): the SAME trace served twice from one LoRA-enabled model —
+    # every request as the base adapter (id 0, structurally masked to
+    # a zero delta), then spread round-robin over N live adapters so
+    # one decode batch mixes adapter ids through the grouped LoRA
+    # GEMM (docs/lora.md). The record carries both arms' tokens/s and
+    # their ratio — the "near-base-model throughput" claim as a
+    # number — plus the server's adapter cache counters. Emitted
+    # BEFORE the headline (pinned last-two contract); the headline
+    # itself never loads a LoRA model.
+    n_adapters = int(os.environ.get("PFX_BENCH_SERVING_ADAPTERS",
+                                    "0"))
+    if n_adapters:
+        import flax.linen as nn
+        from paddlefleetx_tpu.core.adapters import extract_adapter
+        lora_rank = int(os.environ.get(
+            "PFX_BENCH_SERVING_LORA_RANK", "8"))
+        lcfg = dataclasses.replace(
+            cfg, fuse_attn_qkv=True, lora_rank=lora_rank,
+            lora_num_adapters=n_adapters + 1)
+        lmodel = GPTForPretraining(lcfg)
+        lparams = nn.meta.unbox(jax.jit(lmodel.init)(
+            {"params": jax.random.key(0)},
+            jnp.asarray(prompts[0], jnp.int32)[None])["params"])
+        ref_tree = extract_adapter(lparams, 0)
+
+        def _adapter_source(aid):
+            r = np.random.default_rng(seed + int(aid))
+            return {k: r.normal(0.0, 0.02, v.shape).astype(np.float32)
+                    for k, v in ref_tree.items()}
+
+        def _serve_lora(aids):
+            srv = GenerationServer(lmodel, lparams, gen_cfg,
+                                   num_slots=num_slots,
+                                   rng=jax.random.key(seed + 1),
+                                   adapter_source=_adapter_source,
+                                   **paged_kw)
+            srv.run(prompts, adapter_ids=aids)
+            warm = srv.summary()
+            srv.run(prompts, adapter_ids=aids)
+            tot = srv.summary()
+            tokens = tot["decode_tokens"] - warm["decode_tokens"]
+            dt = tot["decode_time_sec"] - warm["decode_time_sec"]
+            return (tokens / dt if dt > 0 else 0.0), tot
+
+        base_tps, _ = _serve_lora([0] * n_requests)
+        aids = [(i % n_adapters) + 1 for i in range(n_requests)]
+        lora_tps, lora_total = _serve_lora(aids)
+        lora_rec = {
+            "metric": METRIC_BY_MODE["serving"] + "_adapters",
+            "value": round(lora_tps, 1),
+            "unit": "tokens/s",
+            "vs_baseline": None,
+            "requests": n_requests,
+            "slots": num_slots,
+            "prompt_len_range": [min_p, max_p],
+            "max_dec_len": dec_len,
+            "seed": seed,
+            "paged": paged,
+            "page_size": page_size if paged else 0,
+            "pool_pages": pool_pages if paged else 0,
+            "loop_ticks": 1,
+            "adapters": n_adapters,
+            "lora_rank": lora_rank,
+            "base_tokens_per_sec": round(base_tps, 1),
+            "adapter_slowdown": round(base_tps / lora_tps, 3)
+                if lora_tps > 0 else 0.0,
+            "adapter_hits": lora_total.get("adapter_hits", 0),
+            "adapter_misses": lora_total.get("adapter_misses", 0),
+            "adapter_evictions": lora_total.get(
+                "adapter_evictions", 0),
+            "adapters_resident": lora_total.get(
+                "adapters_resident", 0),
+            "ttft_p50_ms": lora_total.get("ttft_p50_ms", 0.0),
+            "ttft_p99_ms": lora_total.get("ttft_p99_ms", 0.0),
+        }
+        _log_success(lora_rec)
+        print(json.dumps(lora_rec))
 
     decode_tps, ticks, rounds, total = _serve(gen_cfg)
     common = {

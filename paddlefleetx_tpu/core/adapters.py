@@ -1,0 +1,307 @@
+"""Multi-tenant LoRA adapter trees and the serving-side HBM bank cache.
+
+The model holds every resident adapter in stacked per-site banks —
+``{site}_lora/lora_a [A, K, r]`` / ``lora_b [A, r, N]`` parameters
+created by ``models/gpt/model.py::_LoRADelta`` (scanned training params
+carry a leading ``[num_layers, ...]`` axis; the serving server's
+unrolled twin splits that into per-layer ``decoder_{i}`` leaves). Bank
+row 0 is the reserved zero adapter; rows ``1..A-1`` are cache capacity
+the serving layer fills and evicts at runtime.
+
+Two pieces live here:
+
+- **Adapter trees** — the canonical single-adapter format:
+  ``{"<site>/<leaf>": [num_layers, ...]}`` keyed by the eight
+  ``(site, leaf)`` pairs (``qkv_proj_lora``/``out_proj_lora``/
+  ``linear1_lora``/``linear2_lora`` x ``lora_a``/``lora_b``), each
+  value stacked over layers. :func:`extract_adapter` /
+  :func:`insert_adapter` convert between this format and a live params
+  tree in EITHER layout (scanned ``[L, A, ...]`` or unrolled
+  ``decoder_{i} [A, ...]``), so an adapter fine-tuned on the scanned
+  training model drops straight into an unrolled serving bank.
+  ``core/checkpoint.py`` persists the format with the same npz +
+  fingerprinted-manifest discipline as any checkpoint.
+
+- **:class:`AdapterCache`** — host bookkeeping mapping adapter id ->
+  bank row with KV-page-style refcounts (docs/lora.md): a row is
+  PINNED while any slot serves its adapter and only refcount-0
+  residents are LRU-evictable; a miss loads the tree from the
+  ``source`` and claims a free or evicted row. The cache owns no
+  device state — the server applies :func:`insert_adapter` to its
+  live params when a lease reports a load. Counted
+  ``serving/adapter_{hits,misses,evictions}`` with the
+  ``serving/adapters_resident`` gauge.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+from typing import (
+    Any, Callable, Dict, Mapping, NamedTuple, Optional, Tuple,
+)
+
+import jax
+import jax.numpy as jnp
+
+from ..observability import metrics
+
+#: leaf names a LoRA site module owns (models/gpt/model.py _LoRADelta)
+LORA_LEAVES = ("lora_a", "lora_b")
+
+_LAYER_IDX = re.compile(r"_(\d+)$")
+
+
+def _lora_path(path) -> Optional[Tuple[str, str, Optional[int]]]:
+    """``(site, leaf, layer_index)`` when ``path`` names a LoRA bank
+    leaf, else None. ``layer_index`` comes from the nearest enclosing
+    ``decoder_{i}``-style component (None for scanned params, whose
+    layer axis is in the array itself)."""
+    keys = [str(getattr(k, "key", k)) for k in path]
+    if len(keys) < 2 or keys[-1] not in LORA_LEAVES or \
+            not keys[-2].endswith("_lora"):
+        return None
+    layer = None
+    for k in reversed(keys[:-2]):
+        m = _LAYER_IDX.search(k)
+        if m:
+            layer = int(m.group(1))
+            break
+    return keys[-2], keys[-1], layer
+
+
+def extract_adapter(params, row: int) -> Dict[str, jax.Array]:
+    """One bank row as a canonical adapter tree: ``{"site/leaf":
+    [num_layers, ...]}`` stacked over layers, whatever layout
+    ``params`` is in (scanned ``[L, A, ...]`` leaves slice axis 1 of
+    the stack; unrolled per-layer ``[A, ...]`` leaves stack over their
+    ``decoder_{i}`` indices). Raises ``ValueError`` when ``params``
+    holds no LoRA banks or ``row`` is out of range."""
+    per_layer: Dict[str, Dict[int, jax.Array]] = {}
+    stacked: Dict[str, jax.Array] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        hit = _lora_path(path)
+        if hit is None:
+            continue
+        site, name, layer = hit
+        key = f"{site}/{name}"
+        if leaf.ndim == 4:       # scanned: [L, A, K, r] / [L, A, r, N]
+            if not 0 <= row < leaf.shape[1]:
+                raise ValueError(
+                    f"adapter row {row} out of range for bank "
+                    f"{key} with {leaf.shape[1]} rows")
+            stacked[key] = leaf[:, row]
+        else:                    # unrolled per layer: [A, K, r]
+            if not 0 <= row < leaf.shape[0]:
+                raise ValueError(
+                    f"adapter row {row} out of range for bank "
+                    f"{key} with {leaf.shape[0]} rows")
+            per_layer.setdefault(key, {})[layer or 0] = leaf[row]
+    for key, rows in per_layer.items():
+        stacked[key] = jnp.stack(
+            [rows[i] for i in sorted(rows)], axis=0)
+    if not stacked:
+        raise ValueError(
+            "params hold no LoRA banks (lora_rank is off?)")
+    return stacked
+
+
+def insert_adapter(params, tree: Mapping[str, Any], row: int):
+    """Functionally write a canonical adapter tree into bank row
+    ``row`` of ``params`` (either layout), casting values to each
+    leaf's dtype. Every ``tree`` entry must land somewhere and shapes
+    must match — a silent partial insert would serve a chimera
+    adapter."""
+    used = set()
+
+    def put(path, leaf):
+        """Write the tree's matching slice into this leaf's row."""
+        hit = _lora_path(path)
+        if hit is None:
+            return leaf
+        site, name, layer = hit
+        key = f"{site}/{name}"
+        if key not in tree:
+            raise ValueError(f"adapter tree missing {key}")
+        val = jnp.asarray(tree[key], leaf.dtype)
+        used.add(key)
+        if leaf.ndim == 4:       # scanned stack
+            if val.shape != (leaf.shape[0],) + leaf.shape[2:]:
+                raise ValueError(
+                    f"adapter {key} shape {val.shape} does not fit "
+                    f"bank {leaf.shape}")
+            return leaf.at[:, row].set(val)
+        li = layer or 0
+        if li >= val.shape[0] or val.shape[1:] != leaf.shape[1:]:
+            raise ValueError(
+                f"adapter {key} shape {val.shape} does not fit "
+                f"layer {li} bank {leaf.shape}")
+        return leaf.at[row].set(val[li])
+
+    out = jax.tree_util.tree_map_with_path(put, params)
+    if not used:
+        raise ValueError(
+            "params hold no LoRA banks (lora_rank is off?)")
+    missing = set(tree) - used
+    if missing:
+        raise ValueError(
+            f"adapter tree keys matched no bank: {sorted(missing)}")
+    return out
+
+
+class AdapterCacheFull(RuntimeError):
+    """Every bank row is pinned by a live slot — admission must wait
+    for a release (the queue-head blocking rule, like page
+    starvation)."""
+
+
+class AdapterLease(NamedTuple):
+    """Result of :meth:`AdapterCache.acquire`. ``tree`` is non-None on
+    a miss — the caller must :func:`insert_adapter` it into row
+    ``row`` before serving. ``evicted`` names the refcount-0 resident
+    whose row was reclaimed, if any."""
+    row: int
+    tree: Optional[Dict[str, Any]]
+    evicted: Optional[Any]
+
+
+class AdapterCache:
+    """Adapter id -> bank row with refcounts and LRU eviction.
+
+    ``num_rows`` is the bank's adapter axis (``lora_num_adapters``);
+    usable capacity is ``num_rows - 1`` (row 0 = reserved zero
+    adapter). ``source`` maps adapter id -> canonical adapter tree —
+    a Mapping or a callable; unknown ids raise ``KeyError``. Pure
+    host bookkeeping behind its own lock: admission mutates the map
+    under the serving surface lock while ``summary()`` and the fleet's
+    affinity probes read it from router threads.
+
+    Invariants (pinned by tests/test_lora.py):
+    - a row is never reassigned while its adapter's refcount > 0;
+    - eviction only ever takes the LRU refcount-0 resident;
+    - ``acquire`` with no free and no evictable row raises
+      :class:`AdapterCacheFull` and changes nothing.
+    """
+
+    def __init__(self, num_rows: int,
+                 source: Callable[[Any], Mapping[str, Any]]):
+        if num_rows < 2:
+            raise ValueError(
+                f"num_rows must be >= 2 (row 0 is the reserved zero "
+                f"adapter), got {num_rows}")
+        self._lock = threading.Lock()
+        self._free = list(range(num_rows - 1, 0, -1))   # pop() -> row 1
+        self._source = source
+        self._rows: Dict[Any, int] = {}        # adapter id -> row
+        self._refs: Dict[Any, int] = {}        # adapter id -> pins
+        #: refcount-0 residents, least recently released first
+        self._lru: "OrderedDict[Any, None]" = OrderedDict()
+        self.stats = {"adapter_hits": 0, "adapter_misses": 0,
+                      "adapter_evictions": 0}
+
+    @property
+    def resident(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    @property
+    def capacity(self) -> int:
+        """Total usable bank rows (free + resident)."""
+        with self._lock:
+            return len(self._free) + len(self._rows)
+
+    def resident_ids(self):
+        with self._lock:
+            return list(self._rows)
+
+    def is_resident(self, adapter_id) -> bool:
+        with self._lock:
+            return adapter_id in self._rows
+
+    def refcount(self, adapter_id) -> int:
+        with self._lock:
+            return self._refs.get(adapter_id, 0)
+
+    def can_admit(self, adapter_id) -> bool:
+        """Would :meth:`acquire` find a row right now? (Source errors
+        still surface from acquire itself.)"""
+        with self._lock:
+            return adapter_id in self._rows or bool(self._free) or \
+                bool(self._lru)
+
+    def _load(self, adapter_id) -> Mapping[str, Any]:
+        if callable(self._source):
+            return self._source(adapter_id)
+        return self._source[adapter_id]
+
+    def acquire(self, adapter_id) -> AdapterLease:
+        """Pin ``adapter_id`` to a bank row. Hit: bump the refcount.
+        Miss: load the tree from the source FIRST (an unknown id must
+        not evict anyone), then claim a free row or evict the LRU
+        refcount-0 resident. Raises :class:`AdapterCacheFull` when
+        every row is pinned, ``KeyError`` from the source for unknown
+        ids."""
+        with self._lock:
+            if adapter_id in self._rows:
+                self._refs[adapter_id] += 1
+                self._lru.pop(adapter_id, None)
+                self.stats["adapter_hits"] += 1
+                metrics.inc("serving/adapter_hits")
+                self._gauge()
+                return AdapterLease(self._rows[adapter_id], None, None)
+            if not self._free and not self._lru:
+                raise AdapterCacheFull(
+                    f"all {len(self._rows)} adapter rows pinned by "
+                    f"live slots")
+            tree = self._load(adapter_id)
+            evicted = None
+            if self._free:
+                row = self._free.pop()
+            else:
+                evicted, _ = self._lru.popitem(last=False)
+                row = self._rows.pop(evicted)
+                del self._refs[evicted]
+                self.stats["adapter_evictions"] += 1
+                metrics.inc("serving/adapter_evictions")
+            self._rows[adapter_id] = row
+            self._refs[adapter_id] = 1
+            self.stats["adapter_misses"] += 1
+            metrics.inc("serving/adapter_misses")
+            self._gauge()
+            return AdapterLease(row, dict(tree), evicted)
+
+    def release(self, adapter_id) -> None:
+        """Drop one pin. At refcount 0 the adapter STAYS resident (its
+        weights keep their row — the warm-cache win) but becomes LRU
+        eviction fodder."""
+        with self._lock:
+            refs = self._refs.get(adapter_id)
+            if refs is None:
+                raise KeyError(f"release of non-resident adapter "
+                               f"{adapter_id!r}")
+            if refs < 1:
+                raise AssertionError(
+                    f"adapter {adapter_id!r} refcount underflow")
+            self._refs[adapter_id] = refs - 1
+            if refs == 1:
+                self._lru[adapter_id] = None
+            self._gauge()
+
+    def check(self) -> None:
+        """Test hook: internal invariants."""
+        with self._lock:
+            assert set(self._lru) <= set(self._rows)
+            assert set(self._refs) == set(self._rows)
+            for aid, refs in self._refs.items():
+                assert refs >= 0
+                assert (refs == 0) == (aid in self._lru), \
+                    f"{aid!r}: refs={refs}, lru={aid in self._lru}"
+            rows = list(self._rows.values()) + self._free
+            assert len(rows) == len(set(rows)), \
+                "row leaked or double-used"
+            assert 0 not in rows, "reserved row 0 entered circulation"
+
+    def _gauge(self) -> None:
+        metrics.get_registry().set_gauge("serving/adapters_resident",
+                                         len(self._rows))

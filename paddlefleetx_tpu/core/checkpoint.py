@@ -269,6 +269,76 @@ def load_prefix_store(path: str, recorder=None
     }
 
 
+def save_adapter(path: str, tree: Dict[str, Any],
+                 meta: Optional[Dict[str, Any]] = None) -> str:
+    """Persist one canonical LoRA adapter tree (``core/adapters.py``:
+    ``{"site/leaf": [num_layers, ...]}``) as a committed-last
+    directory: all leaves in one ``.npz`` plus a JSON descriptor
+    carrying per-key shapes/dtypes, then the :func:`write_manifest`
+    rename commit. ``meta`` rides along verbatim (adapter id, base
+    model fingerprint, training step...). Returns the manifest path."""
+    os.makedirs(path, exist_ok=True)
+    # same overwrite-in-place discipline as save_prefix_store: a crash
+    # mid-rewrite must not leave a marker attesting to half-new bytes
+    stale = os.path.join(path, MANIFEST_NAME)
+    if os.path.exists(stale):
+        os.remove(stale)
+    arrays: Dict[str, Any] = {}
+    index: Dict[str, Dict[str, Any]] = {}
+    for i, key in enumerate(sorted(tree)):
+        arr = np.asarray(tree[key])
+        arrays[f"leaf{i}"] = arr
+        index[key] = {"npz": f"leaf{i}", "shape": list(arr.shape),
+                      "dtype": str(arr.dtype)}
+    if not index:
+        raise ValueError("refusing to save an empty adapter tree")
+    np.savez(os.path.join(path, "adapter.npz"), **arrays)
+    desc = {"kind": "lora_adapter", "meta": meta or {}, "leaves": index}
+    with open(os.path.join(path, "adapter.json"), "w") as f:
+        json.dump(desc, f, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    return write_manifest(path, {"kind": "lora_adapter",
+                                 "leaves": len(index)})
+
+
+def load_adapter(path: str) -> Tuple[Dict[str, np.ndarray],
+                                     Dict[str, Any]]:
+    """Load a :func:`save_adapter` directory back into ``(tree,
+    meta)``. Raises :class:`CheckpointCorrupt` when the directory was
+    never committed, fails manifest verification, or a leaf's
+    shape/dtype disagrees with the descriptor — a torn adapter
+    silently serves wrong deltas, so unlike the prefix store (a pure
+    cache) there is no cold-start fallback here."""
+    reason = verify_checkpoint(path)
+    if reason is not None:
+        raise CheckpointCorrupt(f"adapter at {path} refused: {reason}")
+    try:
+        with open(os.path.join(path, "adapter.json")) as f:
+            desc = json.load(f)
+        if desc.get("kind") != "lora_adapter":
+            raise CheckpointCorrupt(
+                f"{path} is not an adapter dir "
+                f"(kind={desc.get('kind')!r})")
+        tree: Dict[str, np.ndarray] = {}
+        with np.load(os.path.join(path, "adapter.npz")) as npz:
+            for key, ent in desc.get("leaves", {}).items():
+                arr = npz[ent["npz"]]
+                if list(arr.shape) != list(ent["shape"]) or \
+                        str(arr.dtype) != ent["dtype"]:
+                    raise CheckpointCorrupt(
+                        f"adapter leaf {key} at {path}: descriptor "
+                        f"says {ent['shape']}/{ent['dtype']}, npz "
+                        f"holds {list(arr.shape)}/{arr.dtype}")
+                tree[key] = arr
+    except (OSError, ValueError, KeyError) as err:
+        raise CheckpointCorrupt(
+            f"adapter at {path} unreadable: {err}") from err
+    if not tree:
+        raise CheckpointCorrupt(f"adapter at {path} holds no leaves")
+    return tree, desc.get("meta", {})
+
+
 def save_checkpoint(output_dir: str, epoch: int, step: int, state,
                     meta: Dict[str, Any],
                     async_save: bool = False) -> str:

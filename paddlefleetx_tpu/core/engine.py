@@ -226,6 +226,32 @@ class Engine(BasicEngine):
 
     # -- state ----------------------------------------------------------
 
+    def _maybe_lora_tx(self, tx):
+        """LoRA fine-tune (docs/lora.md): a training model carrying
+        adapter banks (``lora_rank > 0``) updates ONLY the ``*_lora``
+        leaves. ``optax.multi_transform`` routes base weights through
+        ``set_to_zero`` — they stay frozen bit-for-bit and carry NO
+        optimizer state, so Adam moments exist for the tiny A/B banks
+        alone (the reference freezes via ``stop_gradient`` flags and
+        still allocates full-size moments)."""
+        mcfg = getattr(getattr(self.module, "model", None), "config",
+                       None)
+        if not getattr(mcfg, "lora_rank", 0):
+            return tx
+
+        def labels(params):
+            def lab(path, _leaf):
+                keys = [str(getattr(k, "key", k)) for k in path]
+                return "lora" if any(k.endswith("_lora")
+                                     for k in keys) else "frozen"
+            return jax.tree_util.tree_map_with_path(lab, params)
+
+        logger.info(
+            "LoRA fine-tune: base weights frozen (zero optimizer "
+            "state), training only *_lora adapter leaves")
+        return optax.multi_transform(
+            {"lora": tx, "frozen": optax.set_to_zero()}, labels)
+
     def _abstract_state(self):
         model = self.module.model
         spec = self.module.input_spec() or [((1, 8), "int32")]
@@ -312,7 +338,8 @@ class Engine(BasicEngine):
             self.lr_schedule = build_lr_scheduler(opt_cfg.lr) \
                 if "lr" in opt_cfg else (
                     lambda step: opt_cfg.get("learning_rate", 1e-4))
-            self.tx = build_optimizer(opt_cfg, self.lr_schedule)
+            self.tx = self._maybe_lora_tx(
+                build_optimizer(opt_cfg, self.lr_schedule))
         else:
             self.lr_schedule = lambda step: 0.0
             self.tx = None
@@ -643,7 +670,8 @@ class Engine(BasicEngine):
         opt_cfg = self.configs.Optimizer
         opt_cfg.lr["step_each_epoch"] = steps
         self.lr_schedule = build_lr_scheduler(opt_cfg.lr)
-        self.tx = build_optimizer(opt_cfg, self.lr_schedule)
+        self.tx = self._maybe_lora_tx(
+            build_optimizer(opt_cfg, self.lr_schedule))
         self._build_steps()
 
     def _on_sigterm(self, signum, frame):

@@ -194,9 +194,10 @@ class FleetRouter:
         #: (replica index, replica-local request id) -> fleet id
         self._local: Dict[Tuple[int, int], int] = {}
         self._counts = {k: 0 for k in (
-            "submitted", "routed_affinity", "routed_least_depth",
-            "spillover", "shed", "handoffs", "handoff_pages",
-            "handoff_d2d", "handoff_host", "failovers", "restarts")}
+            "submitted", "routed_affinity", "routed_adapter",
+            "routed_least_depth", "spillover", "shed", "handoffs",
+            "handoff_pages", "handoff_d2d", "handoff_host",
+            "failovers", "restarts")}
         # fleet-level latency histogram lives in an always-on local
         # registry, same discipline as the per-server ones
         self._metrics = metrics.MetricsRegistry(enabled=True)
@@ -334,46 +335,70 @@ class FleetRouter:
     # -- routing -------------------------------------------------------
 
     def _ranked(self, tokens: Sequence[int],
-                roles: Tuple[str, ...]) -> List[Tuple[int, int, int]]:
+                roles: Tuple[str, ...],
+                adapter_id: int = 0) -> List[Tuple[int, int, int]]:
         """Candidate replicas as ``(affinity, depth, index)``, best
         first: highest registry affinity, then least queue depth, then
-        index (a stable tiebreak keeps routing reproducible)."""
+        index (a stable tiebreak keeps routing reproducible).
+
+        Adapter requests score by :meth:`GenerationServer.
+        adapter_affinity` instead — a replica already holding the
+        adapter resident in its HBM bank beats one that would load
+        (and maybe evict) on admission, so a fleet with disjoint hot
+        adapters settles into per-replica working sets rather than
+        thrashing every bank. Prefix affinity is meaningless for these
+        requests anyway: adapter deltas change the KV, so the server
+        never shares or registers their pages (docs/lora.md)."""
         scored = []
         for i, rep in enumerate(self._snapshot()):
             if rep.role not in roles or rep.server.draining:
                 continue
-            aff = rep.server.prefix_affinity(tokens)
+            if adapter_id:
+                # base-only replicas reject adapter ids outright
+                # (ValueError, not a shed) — never candidates
+                if not getattr(rep.server, "has_adapters", False):
+                    continue
+                aff = rep.server.adapter_affinity(adapter_id)
+            else:
+                aff = rep.server.prefix_affinity(tokens)
             depth = rep.server.pending + rep.server.occupancy
             scored.append((-aff, depth, i))
         scored.sort()
         return [(-naff, depth, i) for naff, depth, i in scored]
 
     def submit(self, prompt: Sequence[int],
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               adapter_id: int = 0) -> int:
         """Route one request; returns its fleet-wide id (the id on
         :class:`Completion`).  Raises :class:`RequestShed` only after
-        EVERY eligible replica refused admission."""
+        EVERY eligible replica refused admission.  A non-zero
+        ``adapter_id`` routes by adapter affinity (counted
+        ``fleet/routed_adapter`` when residency decided the pick) and
+        rides every handoff/failover resubmission token-exactly."""
         prompt = [int(t) for t in prompt]
+        adapter_id = int(adapter_id)
         gid = self._next_gid
         self._next_gid += 1
         self.inc("fleet/submitted")
         span = self._tracer.start_trace(
-            "fleet/route", request=gid, prompt_len=len(prompt))
+            "fleet/route", request=gid, prompt_len=len(prompt),
+            adapter=adapter_id)
         tid = span.trace_id
         roles = ("prefill",) if self._split else ("mixed",)
         for rank, (aff, depth, i) in enumerate(
-                self._ranked(prompt, roles)):
+                self._ranked(prompt, roles, adapter_id)):
             rep = self._replica(i)
             nonce = self._nonce
             try:
                 lid = rep.server.submit(
                     prompt, deadline_s=deadline_s, trace_id=tid,
-                    nonce=nonce)
+                    nonce=nonce, adapter_id=adapter_id)
             except RequestShed:
                 continue   # spill over to the next-ranked replica
             self._nonce += 1
             if aff > 0:
-                self.inc("fleet/routed_affinity")
+                self.inc("fleet/routed_adapter" if adapter_id
+                         else "fleet/routed_affinity")
             else:
                 self.inc("fleet/routed_least_depth")
             if rank:
@@ -385,6 +410,7 @@ class FleetRouter:
                 "replica": i, "local_id": lid,
                 "stage": "prefill" if self._split else "decode",
                 "deadline_s": deadline_s, "tokens": [],
+                "adapter_id": adapter_id,
                 "imports": []}
             self._local[(i, lid)] = gid
             self._emit("fleet_route", request=gid, replica=rep.name,
@@ -691,7 +717,8 @@ class FleetRouter:
         data, last, n_pages = req.get("kv", (None, None, 0))
         roles = ("decode",) if self._split else ("mixed",)
         seq = req["prompt"] + req["tokens"]
-        for aff, depth, i in self._ranked(seq, roles):
+        aid = req.get("adapter_id", 0)
+        for aff, depth, i in self._ranked(seq, roles, aid):
             srv = self._replica(i).server
             imported = data is not None and srv.kv_import(
                 seq, data, last, n_pages)
@@ -700,7 +727,8 @@ class FleetRouter:
                     req["prompt"],
                     resume_tokens=req["tokens"] or None,
                     deadline_s=req.get("deadline_s"),
-                    trace_id=req["trace_id"], nonce=req["nonce"])
+                    trace_id=req["trace_id"], nonce=req["nonce"],
+                    adapter_id=aid)
             except RequestShed:
                 if imported:
                     srv.kv_import_release(seq)
@@ -734,8 +762,9 @@ class FleetRouter:
         # when every decode peer is down (e.g. a 1+1 rolling restart)
         roles = ("decode", "prefill") if self._split else ("mixed",)
         seq = req["prompt"] + req["tokens"]
+        aid = req.get("adapter_id", 0)
         ranked = [r for role in roles
-                  for r in self._ranked(seq, (role,))]
+                  for r in self._ranked(seq, (role,), aid)]
         for aff, depth, i in ranked:
             rep = self._replica(i)
             srv = rep.server
@@ -744,7 +773,8 @@ class FleetRouter:
                     req["prompt"],
                     resume_tokens=req["tokens"] or None,
                     deadline_s=req.get("deadline_s"),
-                    trace_id=req["trace_id"], nonce=req["nonce"])
+                    trace_id=req["trace_id"], nonce=req["nonce"],
+                    adapter_id=aid)
             except RequestShed:
                 continue
             self.inc("fleet/failovers")

@@ -152,6 +152,7 @@ from ..observability import timeline
 from ..observability.recorder import FlightRecorder
 from ..observability.spans import Tracer
 from ..utils.log import logger
+from .adapters import AdapterCache, AdapterCacheFull, insert_adapter
 from .paging import (
     NULL_PAGE, PageAllocator, PagePoolExhausted, page_prefix_keys,
     pool_pages_for_bytes, prompt_key,
@@ -233,7 +234,8 @@ class GenerationServer:
                  max_queue_depth: Optional[int] = None,
                  drain_on_sigterm: bool = False,
                  fault_injector: Optional[FaultInjector] = None,
-                 device_loop_ticks: int = 1):
+                 device_loop_ticks: int = 1,
+                 adapter_source=None):
         if gen_cfg.decode_strategy == "beam_search":
             raise ValueError(
                 "GenerationServer serves sampling/greedy_search; beam "
@@ -405,6 +407,28 @@ class GenerationServer:
         self._nonce = 0
         self._counts = {"admitted": 0, "evicted": 0, "preempted": 0,
                         "shed": 0, "deadline_exceeded": 0}
+        # multi-tenant LoRA (docs/lora.md): adapter_source maps
+        # adapter id -> canonical adapter tree (core/adapters.py);
+        # the cache LRUs loaded adapters in the params' HBM bank rows
+        # with KV-page-style refcounts, and each slot's bank row rides
+        # down with every tick as a traced [slots] array (the
+        # per-slot adapter ids of the grouped LoRA GEMM). Without a
+        # source the server serves the base model (adapter_ids=None —
+        # zero delta, no grouped dispatch).
+        self._adapters: Optional[AdapterCache] = None
+        if adapter_source is not None:
+            if not cfg.lora_rank:
+                raise ValueError(
+                    "adapter_source requires a LoRA model "
+                    "(lora_rank > 0)")
+            self._adapters = AdapterCache(cfg.lora_num_adapters,
+                                          adapter_source)
+            self._aid_np = np.zeros((num_slots,), np.int32)
+            self._aid_dev = jnp.asarray(self._aid_np)
+            self._aid_dirty = False
+        #: admission-time request failures (e.g. unknown adapter id)
+        #: surfaced as completions from the next step()
+        self._dead: List[Completion] = []
         self._ticks = 0
         # graceful degradation (docs/robustness.md)
         self.request_ttl_s = request_ttl_s
@@ -480,7 +504,9 @@ class GenerationServer:
                    if self.paged else 0,
                    spec=self.spec,
                    spec_tokens=self._spec_k if self.spec else 0,
-                   loop_ticks=self._loop_ticks)
+                   loop_ticks=self._loop_ticks,
+                   adapter_rows=self._adapters.capacity
+                   if self._adapters else 0)
         if self.paged:
             logger.info(
                 "GenerationServer (paged): %d slots, %d-page pool of "
@@ -606,6 +632,8 @@ class GenerationServer:
             if self._tiered and (self._spill_pin or
                                  self._spill_outbox):
                 return True
+            if self._dead:
+                return True
             return False
 
     def check_alloc(self) -> None:
@@ -621,7 +649,8 @@ class GenerationServer:
                deadline_s: Optional[float] = None,
                resume_tokens: Optional[Sequence[int]] = None,
                trace_id: Optional[str] = None,
-               nonce: Optional[int] = None) -> int:
+               nonce: Optional[int] = None,
+               adapter_id: int = 0) -> int:
         """Queue a request; returns its id. Raises ``ValueError`` when
         the prompt can never fit (``prompt + max_dec_len >
         max_position_embeddings``) — an oversized request must fail
@@ -648,17 +677,24 @@ class GenerationServer:
         a failed-over request keeps its stream — leave it None
         everywhere else.
 
+        ``adapter_id`` serves the request through that LoRA adapter
+        (0 = base model): admission pins the adapter's bank row until
+        eviction, and preemption/resume re-pins it, so a resumed
+        request keeps decoding under the same weights token-exactly
+        (docs/lora.md). Requires an ``adapter_source``.
+
         Thread-safe: serialized on the surface lock against a
         concurrently ticking fleet worker thread."""
         with self._surface_lock:
             return self._submit_impl(prompt, deadline_s, resume_tokens,
-                                     trace_id, nonce)
+                                     trace_id, nonce, adapter_id)
 
     def _submit_impl(self, prompt: Sequence[int],
                      deadline_s: Optional[float],
                      resume_tokens: Optional[Sequence[int]],
                      trace_id: Optional[str],
-                     nonce: Optional[int]) -> int:
+                     nonce: Optional[int],
+                     adapter_id: int = 0) -> int:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -673,6 +709,14 @@ class GenerationServer:
             raise ValueError(
                 f"resume_tokens ({len(tokens)}) already meets "
                 f"max_dec_len ({self.gen_cfg.max_dec_len})")
+        adapter_id = int(adapter_id)
+        if adapter_id < 0:
+            raise ValueError(f"adapter_id must be >= 0, got "
+                             f"{adapter_id}")
+        if adapter_id and self._adapters is None:
+            raise ValueError(
+                "adapter_id requires an adapter_source (this server "
+                "serves the base model only)")
         self._submits += 1
         if self._draining:
             return self._shed("draining")
@@ -687,6 +731,7 @@ class GenerationServer:
         ttl = deadline_s if deadline_s is not None else \
             self.request_ttl_s
         req = {"id": rid, "prompt": prompt, "tokens": tokens,
+               "adapter_id": adapter_id,
                "submit_t": time.time(),
                "deadline": time.time() + ttl
                if ttl is not None else None}
@@ -764,14 +809,113 @@ class GenerationServer:
         # are rare enough that a one-off shape beats a new bucket)
         return n
 
+    # -- adapter cache (multi-tenant LoRA, docs/lora.md) --------------
+    #
+    # The host maps each slot to the bank ROW of its request's adapter
+    # (_aid_np, row 0 = base/zero adapter) and uploads the int32
+    # [slots] array to ride down with every tick — the grouped LoRA
+    # GEMM's per-slot ids. Rows are refcounted by the AdapterCache:
+    # pinned at admission, released at evict/preempt, LRU-evicted only
+    # at refcount 0. A request whose adapter cannot claim a row yet
+    # blocks the queue HEAD, exactly like page starvation.
+
+    def _adapter_admissible(self, req: dict) -> bool:
+        aid = req.get("adapter_id", 0)
+        if not aid or self._adapters is None:
+            return True
+        return self._adapters.can_admit(aid)
+
+    def _acquire_adapter(self, req: dict, slot: int) -> None:
+        """Pin the request's adapter and point ``slot`` at its bank
+        row (row 0 for base requests). On a miss the loaded tree is
+        written into the live params' bank. Raises ``KeyError`` for
+        an unknown adapter id — the caller fails the admission."""
+        if self._adapters is None:
+            return
+        aid = req.get("adapter_id", 0)
+        if not aid:
+            if self._aid_np[slot] != 0:
+                self._aid_np[slot] = 0
+                self._aid_dirty = True
+            return
+        lease = self._adapters.acquire(aid)
+        if lease.evicted is not None:
+            self._emit("serving_adapter_evict", adapter=lease.evicted,
+                       row=lease.row)
+        if lease.tree is not None:
+            # cast-on-insert: the bank leaves already carry the
+            # server's compute dtype. The unlocked params read in
+            # _model_fingerprint cannot race this write: the
+            # fingerprint is computed eagerly at __init__, before any
+            # request (or router thread) exists.
+            self.params = insert_adapter(  # pfxlint: disable=PFX301
+                self.params, lease.tree, lease.row)
+            self._emit("serving_adapter_load", adapter=aid,
+                       row=lease.row, request=req["id"])
+        if self._aid_np[slot] != lease.row:
+            self._aid_np[slot] = lease.row
+            self._aid_dirty = True
+
+    def _release_adapter(self, slot: int, req: dict) -> None:
+        """Unpin a departing request's adapter (stays resident/warm at
+        refcount 0) and park the slot back on the zero row."""
+        if self._adapters is None:
+            return
+        aid = req.get("adapter_id", 0)
+        if aid:
+            self._adapters.release(aid)
+        if self._aid_np[slot] != 0:
+            self._aid_np[slot] = 0
+            self._aid_dirty = True
+
+    def _fail_admission(self, req: dict, reason: str) -> None:
+        """An admission-time request failure (unknown adapter id):
+        complete the request with its partial tokens instead of
+        wedging the queue."""
+        self._counts["evicted"] += 1
+        metrics.inc("serving/evicted")
+        self._end_request_spans(req, reason)
+        self._emit("serving_evict", request=req["id"], slot=-1,
+                   reason=reason, tokens=len(req["tokens"]),
+                   trace=self._trace_id(req))
+        self._dead.append(Completion(
+            request_id=req["id"], prompt=req["prompt"],
+            tokens=req["tokens"], finish_reason=reason,
+            trace_id=self._trace_id(req)))
+
+    def _take_dead(self) -> List[Completion]:
+        out, self._dead = self._dead, []
+        return out
+
+    def _sync_aid(self) -> None:
+        if self._adapters is not None and self._aid_dirty:
+            self._aid_dev = jnp.asarray(self._aid_np)
+            self._aid_dirty = False
+
+    def _aid_arg(self):
+        """The traced per-slot adapter-row array for tick launches —
+        None on base-only servers (skips the LoRA compute entirely)."""
+        return self._aid_dev if self._adapters is not None else None
+
     def _admit(self) -> None:
         """Move queued requests into free slots."""
         if self.paged:
             self._admit_paged()
             return
         while self._queue and None in self._slots:
-            req = self._queue.popleft()
+            req = self._queue[0]
+            if not self._adapter_admissible(req):
+                # every bank row pinned by a live slot: block the
+                # queue head until an eviction releases one (the
+                # page-starvation rule)
+                break
+            self._queue.popleft()
             slot = self._slots.index(None)
+            try:
+                self._acquire_adapter(req, slot)
+            except KeyError:
+                self._fail_admission(req, "adapter_missing")
+                continue
             # resume re-entry: prefill prompt + already-emitted tokens
             # (same contract as paged re-admission), then restore the
             # decode count below so the sampling stream and length
@@ -790,7 +934,9 @@ class GenerationServer:
                 self.model, self.params, self._cache, self._state,
                 jnp.asarray([slot], jnp.int32), jnp.asarray(row),
                 jnp.asarray([len(seq)], jnp.int32),
-                jnp.asarray([req["nonce"]], jnp.int32))
+                jnp.asarray([req["nonce"]], jnp.int32),
+                jnp.asarray([int(self._aid_np[slot])], jnp.int32)
+                if self._adapters is not None else None)
             if req["tokens"]:
                 self._state = self._state._replace(
                     dec_count=self._state.dec_count.at[slot].set(
@@ -874,11 +1020,21 @@ class GenerationServer:
         over it would starve long prompts."""
         while self._queue and None in self._slots:
             req = self._queue[0]
+            if not self._adapter_admissible(req):
+                # every adapter row pinned: block the queue head until
+                # an eviction releases one (the starvation rule shared
+                # with the owned-pages check below)
+                break
             seq = req["prompt"] + req["tokens"]
             L = len(seq)
             slot = self._slots.index(None)
+            # prefix/prompt registries hold BASE-model KV: a non-zero
+            # adapter changes every layer's KV for the same tokens, so
+            # adapter requests neither share nor (in _prefill_pump)
+            # register pages — correctness, not policy (docs/lora.md)
+            share = self._prefix_sharing and not req.get("adapter_id")
             hit = self._alloc.lookup_prompt(prompt_key(seq)) \
-                if self._prefix_sharing else None
+                if share else None
             if hit is not None:
                 pages, last = hit
                 host_ids = [p for p in pages
@@ -890,6 +1046,11 @@ class GenerationServer:
                     # as the chunked path's owned-pages check)
                     break
                 self._queue.popleft()
+                try:
+                    self._acquire_adapter(req, slot)
+                except KeyError:
+                    self._fail_admission(req, "adapter_missing")
+                    continue
                 try:
                     # every spilled page of the hit comes back in ONE
                     # stacked scatter; each fresh id's refcount-1
@@ -903,6 +1064,7 @@ class GenerationServer:
                     # dead page's registrations, so the retry
                     # re-prefills cold on the next pass
                     self._drop_evicted_host_data()
+                    self._release_adapter(slot, req)
                     self._queue.appendleft(req)
                     continue
                 mapped = []
@@ -926,7 +1088,7 @@ class GenerationServer:
                            trace=self._trace_id(req))
                 continue
             shared_pids: List[int] = []
-            if self._prefix_sharing:
+            if share:
                 # share only FULL pages strictly before the one
                 # holding the last prompt token: that page must
                 # recompute locally so the first sampling logits exist
@@ -955,6 +1117,11 @@ class GenerationServer:
                     total_pages - len(shared_pids) + n_host:
                 break
             self._queue.popleft()
+            try:
+                self._acquire_adapter(req, slot)
+            except KeyError:
+                self._fail_admission(req, "adapter_missing")
+                continue
             self._pt[slot, :] = NULL_PAGE
             host_ids = [p for p in shared_pids
                         if self._alloc.is_host(p)]
@@ -966,6 +1133,7 @@ class GenerationServer:
                 # page's registration is gone, so the retry shares
                 # fewer pages and prefills the rest
                 self._drop_evicted_host_data()
+                self._release_adapter(slot, req)
                 self._queue.appendleft(req)
                 continue
             for j, pid in enumerate(shared_pids):
@@ -1007,7 +1175,9 @@ class GenerationServer:
         self._sync_pt()
         self._cache, logits = prefill_chunk_paged(
             self.model, self.params, self._cache, jnp.asarray(row),
-            jnp.asarray([c0], jnp.int32), self._pt_dev[slot:slot + 1])
+            jnp.asarray([c0], jnp.int32), self._pt_dev[slot:slot + 1],
+            jnp.asarray([int(self._aid_np[slot])], jnp.int32)
+            if self._adapters is not None else None)
         req["prefill_pos"] = c0 + self._chunk
         self._prefill_chunk_count += 1
         metrics.inc("serving/prefill_chunks")
@@ -1033,7 +1203,9 @@ class GenerationServer:
         # the last real token sits at chunk row L - 1 - c0
         last = np.asarray(logits[0, L - 1 - c0])
         self._activate(slot, last)
-        if self._prefix_sharing:
+        # adapter-tinted KV must never enter the shared registries
+        # (_admit_paged's share rule — base-only content addressing)
+        if self._prefix_sharing and not req.get("adapter_id"):
             keys = page_prefix_keys(seq, self._page)
             for j, kk in enumerate(keys):
                 self._alloc.register_prefix(kk, int(self._pt[slot, j]))
@@ -1407,6 +1579,9 @@ class GenerationServer:
             req["spec_rejected"] = int(
                 np.asarray(self._state.rejected)[victim])
         self._release_pages(victim)
+        # the pin drops but the adapter stays resident/warm —
+        # re-admission re-pins it (a hit) and resumes token-exactly
+        self._release_adapter(victim, req)
         if victim in self._prefilling:
             self._prefilling.remove(victim)
         self._slots[victim] = None
@@ -1474,6 +1649,7 @@ class GenerationServer:
             self._release_pages(slot)
             if slot in self._prefilling:
                 self._prefilling.remove(slot)
+        self._release_adapter(slot, req)
         self._slots[slot] = None
         self._state = self._state._replace(
             active=self._state.active.at[slot].set(False),
@@ -1537,6 +1713,26 @@ class GenerationServer:
     # Everything stays host-orchestrated: the device only sees the
     # jitted gather/scatter ops, and all refcount/registry bookkeeping
     # lands in this server's own PageAllocator.
+
+    @property
+    def has_adapters(self) -> bool:
+        """Whether this server can serve non-zero adapter ids at all
+        (LoRA banks + an adapter source). The router filters adapter
+        requests to capable replicas with this — a base-only server
+        would reject them with ValueError, not a shed."""
+        return self._adapters is not None
+
+    def adapter_affinity(self, adapter_id: int) -> int:
+        """Router scoring hook, the adapter twin of
+        :meth:`prefix_affinity`: 1 when this replica already holds
+        ``adapter_id`` resident in its HBM bank (admission is a hit —
+        no load, no eviction pressure), else 0. Base requests
+        (``adapter_id`` 0) and base-only servers score 0 everywhere —
+        adapter affinity then never tilts the ranking."""
+        with self._surface_lock:
+            if not adapter_id or self._adapters is None:
+                return 0
+            return int(self._adapters.is_resident(adapter_id))
 
     def prefix_affinity(self, tokens: Sequence[int]) -> int:
         """Router scoring hook: how much of ``tokens`` this replica
@@ -1902,7 +2098,8 @@ class GenerationServer:
             # nothing decodable yet (empty, or every occupant is still
             # mid-chunked-prefill) — the pump above still made progress
             reg.set_gauge("serving/slot_occupancy", self.occupancy)
-            return expired
+            return expired + self._take_dead()
+        self._sync_aid()
         if self._watchdog is not None:
             self._watchdog.arm(tag=f"tick {self._ticks + 1}")
         t0 = time.time()
@@ -1925,13 +2122,15 @@ class GenerationServer:
                         verify_step(
                             self.model, self.params, self._cache,
                             self._state, jnp.asarray(drafts),
-                            self._rng, self.gen_cfg, self._pt_dev_dec)
+                            self._rng, self.gen_cfg, self._pt_dev_dec,
+                            self._aid_arg())
                 else:
                     self._cache, self._state, window, counts = \
                         verify_step(
                             self.model, self.params, self._cache,
                             self._state, jnp.asarray(drafts),
-                            self._rng, self.gen_cfg)
+                            self._rng, self.gen_cfg, None,
+                            self._aid_arg())
                 window = np.asarray(window)   # device sync in-timer
                 counts = np.asarray(counts)
             else:
@@ -1944,11 +2143,12 @@ class GenerationServer:
                     self._cache, self._state, tok = decode_step(
                         self.model, self.params, self._cache,
                         self._state, self._rng, self.gen_cfg,
-                        self._pt_dev_dec)
+                        self._pt_dev_dec, self._aid_arg())
                 else:
                     self._cache, self._state, tok = decode_step(
                         self.model, self.params, self._cache,
-                        self._state, self._rng, self.gen_cfg)
+                        self._state, self._rng, self.gen_cfg, None,
+                        self._aid_arg())
                 tok = np.asarray(tok)   # device sync inside the timer
                 window = tok[:, None]
                 counts = np.ones((self.num_slots,), np.int32)
@@ -2022,7 +2222,7 @@ class GenerationServer:
         # tick_ms to show the amortization win
         self._metrics.observe("serving/host_roundtrip_ms",
                               (time.time() - step_t0) * 1000.0)
-        return expired + done
+        return expired + self._take_dead() + done
 
     # -- device-resident decode (device_loop_ticks > 1) ---------------
     #
@@ -2101,7 +2301,8 @@ class GenerationServer:
                 if r is not None and (not self.paged or r.get("active"))]
         if not live:
             reg.set_gauge("serving/slot_occupancy", self.occupancy)
-            return expired
+            return expired + self._take_dead()
+        self._sync_aid()
         T = self._loop_ticks
         host_flag = self._loop_host_flag(live)
         # flag up -> the loop exits after one tick, so drafting and
@@ -2135,7 +2336,7 @@ class GenerationServer:
                     jnp.asarray(drafts), self._rng, self.gen_cfg,
                     jnp.int32(host_flag),
                     self._pt_dev_dec if self.paged else None,
-                    loop_ticks=T)
+                    self._aid_arg(), loop_ticks=T)
                 window_np = np.asarray(window_buf)
                 counts_np = np.asarray(counts_buf)
                 n_ticks = int(ticks_run)
@@ -2148,7 +2349,7 @@ class GenerationServer:
                     self.model, self.params, self._cache, self._state,
                     self._rng, self.gen_cfg, jnp.int32(host_flag),
                     self._pt_dev_dec if self.paged else None,
-                    loop_ticks=T)
+                    self._aid_arg(), loop_ticks=T)
                 # device sync inside the timer, like the T=1 path
                 window_np = np.asarray(tokens_buf)[:, :, None]
                 n_ticks = int(ticks_run)
@@ -2250,7 +2451,7 @@ class GenerationServer:
         self._metrics.observe("serving/host_roundtrip_ms",
                               (time.time() - step_t0) * 1000.0)
         self._refresh_health()
-        return expired + done
+        return expired + self._take_dead() + done
 
     def drain(self, max_ticks: Optional[int] = None
               ) -> List[Completion]:
@@ -2286,6 +2487,7 @@ class GenerationServer:
         # a pool-exhaustion preempt during the tick loop requeues to
         # the (no longer admitting) queue — hand those back too
         out.extend(self._flush_queue())
+        out.extend(self._take_dead())
         self._refresh_health()
         self._emit("serving_drain_end", completions=len(out),
                    ticks=ticks)
@@ -2330,13 +2532,20 @@ class GenerationServer:
             signal.signal(signal.SIGTERM, self._prev_sigterm)
             self._sigterm_installed = False
 
-    def run(self, prompts: Sequence[Sequence[int]]) -> List[Completion]:
+    def run(self, prompts: Sequence[Sequence[int]],
+            adapter_ids: Optional[Sequence[int]] = None
+            ) -> List[Completion]:
         """Serve a batch of prompts to completion; completions return
         in SUBMISSION order (slot/finish order is an implementation
-        detail the caller should not see). A drain — SIGTERM under
-        ``drain_on_sigterm``, or a concurrent :meth:`drain` — ends the
-        loop early with partials in place of unfinished requests."""
-        ids = [self.submit(p) for p in prompts]
+        detail the caller should not see). ``adapter_ids`` optionally
+        pairs each prompt with a LoRA adapter (0 = base model). A
+        drain — SIGTERM under ``drain_on_sigterm``, or a concurrent
+        :meth:`drain` — ends the loop early with partials in place of
+        unfinished requests."""
+        if adapter_ids is None:
+            adapter_ids = [0] * len(prompts)
+        ids = [self.submit(p, adapter_id=a)
+               for p, a in zip(prompts, adapter_ids)]
         done: Dict[int, Completion] = {}
         while self.pending or self.occupancy:
             if self.draining:
@@ -2409,5 +2618,9 @@ class GenerationServer:
                 s["host_pages_cap"] = self._alloc.host_pages
                 s["host_pages"] = self._alloc.host_pages_resident
             s.update(self._alloc.stats)
+        if self._adapters is not None:
+            s["adapter_rows"] = self._adapters.capacity
+            s["adapters_resident"] = self._adapters.resident
+            s.update(self._adapters.stats)
         self._emit("serving_summary", **s)
         return s

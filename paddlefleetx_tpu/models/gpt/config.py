@@ -133,6 +133,26 @@ class GPTConfig:
     #: (ops/pallas/quantized_matmul.py; ``quant/*`` counters, per-site
     #: XLA dequantize-then-dot fallback).
     quant_execution: str = "off"
+    #: Multi-tenant LoRA (docs/lora.md). 0 = off — the param tree is
+    #: byte-identical to the base model (the ``_CollectiveDense``
+    #: knob-off convention). > 0: every qkv/out-proj/fc1/fc2 site
+    #: grows a stacked adapter pair ``lora_a [A, K, r]`` /
+    #: ``lora_b [A, r, N]`` (A = ``lora_num_adapters`` resident bank
+    #: rows) and the forward adds ``(alpha/r)·B[id](A[id](x))`` per
+    #: batch row keyed by the traced ``adapter_ids`` argument —
+    #: grouped Pallas GEMMs when the kernel admits the shape, XLA
+    #: gather-einsum otherwise (``lora/{grouped,fallback}`` counters).
+    lora_rank: int = 0
+    #: Adapter bank rows (the stacked leading dim of every
+    #: ``lora_a``/``lora_b``). Row 0 is the RESERVED zero adapter:
+    #: adapter id 0 means "base model" and its delta is masked out
+    #: structurally, so the parity pin never depends on bank contents.
+    #: Must be >= 2 when ``lora_rank`` > 0 (at least one real adapter
+    #: beside the reserved row).
+    lora_num_adapters: int = 0
+    #: LoRA scale numerator: the delta is ``(lora_alpha / lora_rank) *
+    #: B(A(x))``. 0.0 (default) means alpha = rank, i.e. scale 1.0.
+    lora_alpha: float = 0.0
     dtype: str = "float32"                # compute dtype (bf16 for AMP-O2)
     param_dtype: str = "float32"
 
@@ -301,6 +321,40 @@ class GPTConfig:
                 f"unknown quant_execution {self.quant_execution!r} "
                 f"(expected 'off' or 'weight_only_int8' — "
                 f"docs/quantization.md)")
+        # LoRA knobs fail construction loudly for the same reason: a
+        # typo'd rank silently serving the base model would defeat the
+        # multi-tenant A/B entirely.
+        if self.lora_rank < 0:
+            raise ValueError(
+                f"lora_rank must be >= 0, got {self.lora_rank}")
+        if self.lora_alpha < 0:
+            raise ValueError(
+                f"lora_alpha must be >= 0, got {self.lora_alpha}")
+        if self.lora_num_adapters and not self.lora_rank:
+            raise ValueError(
+                f"lora_num_adapters ({self.lora_num_adapters}) is set "
+                f"but lora_rank is 0; multi-tenant LoRA needs both "
+                f"(docs/lora.md)")
+        if self.lora_rank:
+            if self.lora_num_adapters < 2:
+                raise ValueError(
+                    f"lora_num_adapters ({self.lora_num_adapters}) "
+                    f"must be >= 2 with lora_rank > 0 — row 0 is the "
+                    f"reserved zero adapter (base model), so at least "
+                    f"one real adapter row must exist (docs/lora.md)")
+            if not self.fuse_attn_qkv:
+                raise ValueError(
+                    "lora_rank > 0 requires fuse_attn_qkv=True: the "
+                    "adapter sites are exactly qkv/out-proj/fc1/fc2 "
+                    "(docs/lora.md); the non-fused q/k/v projections "
+                    "carry no adapter pair and would silently serve "
+                    "partial adapters")
+            if self.moe_num_experts:
+                raise ValueError(
+                    "lora_rank > 0 is incompatible with "
+                    "moe_num_experts > 0: the MoE block replaces the "
+                    "fc1/fc2 sites the adapter pair rides on "
+                    "(docs/lora.md)")
         if self.quant_execution != "off" and self.use_collective_matmul:
             from ...utils.log import logger
             logger.warning(
@@ -328,6 +382,16 @@ class GPTConfig:
         bounded by ``max_position_embeddings`` (the embedding table
         size) and causal/validity masking never reads them."""
         return -(-self.max_position_embeddings // 128) * 128
+
+    @property
+    def lora_scale(self) -> float:
+        """Effective LoRA delta scale ``alpha / rank`` (1.0 when
+        ``lora_alpha`` is 0.0 — the alpha = rank convention)."""
+        if not self.lora_rank:
+            return 0.0
+        if not self.lora_alpha:
+            return 1.0
+        return self.lora_alpha / self.lora_rank
 
     @property
     def max_kv_pages(self) -> int:

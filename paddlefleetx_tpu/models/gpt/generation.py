@@ -602,7 +602,7 @@ def _scatter_slot_rows(cache, rows, slot_ids):
 def prefill_into_slots(model, params, cache, state: SlotState,
                        slot_ids: jax.Array, input_ids: jax.Array,
                        true_lengths: jax.Array,
-                       nonce: jax.Array):
+                       nonce: jax.Array, adapter_ids=None):
     """Admit requests into free slots: prefill + scatter.
 
     ``input_ids`` is RIGHT-padded ``[n, bucket]`` (prompts start at
@@ -622,7 +622,8 @@ def prefill_into_slots(model, params, cache, state: SlotState,
         jnp.arange(bucket, dtype=jnp.int32)[None, :], (n, bucket))
     logits, mutated = model.apply(
         {"params": params}, input_ids, position_ids=pos,
-        use_cache=True, deterministic=True, mutable=["cache"])
+        use_cache=True, deterministic=True, adapter_ids=adapter_ids,
+        mutable=["cache"])
     last = jnp.take_along_axis(
         logits.astype(jnp.float32),
         jnp.maximum(true_lengths, 1)[:, None, None] - 1, axis=1)[:, 0]
@@ -648,7 +649,7 @@ def prefill_into_slots(model, params, cache, state: SlotState,
 
 def _decode_tick_impl(model, params, cache, state: SlotState,
                       rng: jax.Array, gen_cfg: GenerationConfig,
-                      page_table=None):
+                      page_table=None, adapter_ids=None):
     """Trace-level body of one plain decode tick — the SHARED step
     function of the standalone :func:`decode_step` jit and the fused
     :func:`decode_loop` ``lax.while_loop``; both paths trace exactly
@@ -694,7 +695,8 @@ def _decode_tick_impl(model, params, cache, state: SlotState,
         {"params": params, "cache": cache}, token[:, None],
         position_ids=step_pos[:, None], use_cache=True,
         deterministic=True, cache_lengths=state.lengths,
-        page_table=page_table, mutable=["cache"])
+        page_table=page_table, adapter_ids=adapter_ids,
+        mutable=["cache"])
     cache = _constrain_slot_cache(mutated["cache"])
     new_state = SlotState(
         lengths=jnp.where(state.active, state.lengths + 1,
@@ -713,7 +715,7 @@ def _decode_tick_impl(model, params, cache, state: SlotState,
 @partial(jax.jit, static_argnames=("model", "gen_cfg"))
 def decode_step(model, params, cache, state: SlotState,
                 rng: jax.Array, gen_cfg: GenerationConfig,
-                page_table=None):
+                page_table=None, adapter_ids=None):
     """One shared decode tick over the whole slot batch.
 
     Mirrors the lockstep ``body`` of :func:`generate` slot-for-slot —
@@ -731,7 +733,7 @@ def decode_step(model, params, cache, state: SlotState,
     each slot emitted this tick (pad for finished/inactive slots).
     """
     return _decode_tick_impl(model, params, cache, state, rng,
-                             gen_cfg, page_table)
+                             gen_cfg, page_table, adapter_ids)
 
 
 #: fold_in salt separating a verify tick's ACCEPT uniform at request
@@ -744,7 +746,8 @@ SPEC_ACCEPT_SALT = 7919
 
 def _verify_tick_impl(model, params, cache, state: SlotState,
                       drafts: jax.Array, rng: jax.Array,
-                      gen_cfg: GenerationConfig, page_table=None):
+                      gen_cfg: GenerationConfig, page_table=None,
+                      adapter_ids=None):
     """Trace-level body of one speculative verify tick — the SHARED
     step function of the standalone :func:`verify_step` jit and the
     fused :func:`verify_loop`; see :func:`verify_step` for the full
@@ -802,7 +805,7 @@ def _verify_tick_impl(model, params, cache, state: SlotState,
         {"params": params, "cache": cache}, window,
         position_ids=pos, use_cache=True, deterministic=True,
         cache_lengths=state.lengths, page_table=page_table,
-        mutable=["cache"])
+        adapter_ids=adapter_ids, mutable=["cache"])
     cache = _constrain_slot_cache(mutated["cache"])
     logits_w = logits2.astype(jnp.float32)     # [slots, k+1, V]
 
@@ -861,7 +864,8 @@ def _verify_tick_impl(model, params, cache, state: SlotState,
 @partial(jax.jit, static_argnames=("model", "gen_cfg"))
 def verify_step(model, params, cache, state: SlotState,
                 drafts: jax.Array, rng: jax.Array,
-                gen_cfg: GenerationConfig, page_table=None):
+                gen_cfg: GenerationConfig, page_table=None,
+                adapter_ids=None):
     """One SPECULATIVE tick: score ``k`` drafted tokens per slot in a
     single forward and commit the accepted prefix (+1 sampled token).
 
@@ -904,7 +908,7 @@ def verify_step(model, params, cache, state: SlotState,
     ``window[slot, :counts[slot]]``).
     """
     return _verify_tick_impl(model, params, cache, state, drafts,
-                             rng, gen_cfg, page_table)
+                             rng, gen_cfg, page_table, adapter_ids)
 
 
 # -- device-resident decode: T ticks per host round-trip ---------------
@@ -971,8 +975,8 @@ def _loop_exit_reason(state: SlotState, gen_cfg: GenerationConfig,
 @partial(jax.jit, static_argnames=("model", "gen_cfg", "loop_ticks"))
 def decode_loop(model, params, cache, state: SlotState,
                 rng: jax.Array, gen_cfg: GenerationConfig,
-                host_flag: jax.Array, page_table=None, *,
-                loop_ticks: int = 1):
+                host_flag: jax.Array, page_table=None,
+                adapter_ids=None, *, loop_ticks: int = 1):
     """Up to ``loop_ticks`` plain decode ticks in ONE device program.
 
     Each iteration runs exactly :func:`decode_step`'s tick body, so
@@ -1007,7 +1011,8 @@ def decode_loop(model, params, cache, state: SlotState,
     def body(carry):
         cache, st, buf, tick = carry
         cache, st, tok = _decode_tick_impl(
-            model, params, cache, st, rng, gen_cfg, page_table)
+            model, params, cache, st, rng, gen_cfg, page_table,
+            adapter_ids)
         buf = _ring_write(buf, tok, tick, loop_ticks)
         return cache, st, buf, tick + 1
 
@@ -1021,7 +1026,8 @@ def decode_loop(model, params, cache, state: SlotState,
 def verify_loop(model, params, cache, state: SlotState,
                 drafts: jax.Array, rng: jax.Array,
                 gen_cfg: GenerationConfig, host_flag: jax.Array,
-                page_table=None, *, loop_ticks: int = 1):
+                page_table=None, adapter_ids=None, *,
+                loop_ticks: int = 1):
     """Up to ``loop_ticks`` speculative verify ticks in ONE device
     program — the spec twin of :func:`decode_loop`.
 
@@ -1064,7 +1070,8 @@ def verify_loop(model, params, cache, state: SlotState,
         d = jax.lax.dynamic_index_in_dim(
             drafts, jnp.mod(tick, loop_ticks), axis=1, keepdims=False)
         cache, st, window, counts = _verify_tick_impl(
-            model, params, cache, st, d, rng, gen_cfg, page_table)
+            model, params, cache, st, d, rng, gen_cfg, page_table,
+            adapter_ids)
         wbuf = _ring_write(wbuf, window, tick, loop_ticks)
         cbuf = _ring_write(cbuf, counts, tick, loop_ticks)
         return cache, st, wbuf, cbuf, tick + 1
@@ -1107,7 +1114,8 @@ def init_page_pool(model, params, num_slots: int):
 
 @partial(jax.jit, static_argnames=("model",))
 def prefill_chunk_paged(model, params, cache, input_chunk: jax.Array,
-                        chunk_start: jax.Array, page_table: jax.Array):
+                        chunk_start: jax.Array, page_table: jax.Array,
+                        adapter_ids=None):
     """One page-aligned chunk of a chunked prefill.
 
     ``input_chunk`` is ``[n, chunk]`` token ids (the tail past the
@@ -1133,7 +1141,7 @@ def prefill_chunk_paged(model, params, cache, input_chunk: jax.Array,
         {"params": params, "cache": cache}, input_chunk,
         position_ids=pos, use_cache=True, deterministic=True,
         chunk_start=chunk_start, page_table=page_table,
-        mutable=["cache"])
+        adapter_ids=adapter_ids, mutable=["cache"])
     return (_constrain_slot_cache(mutated["cache"]),
             logits.astype(jnp.float32))
 

@@ -208,6 +208,81 @@ class _QuantDense(nn.Module):
         return y + bias.astype(dtype)
 
 
+class _LoRADelta(nn.Module):
+    """Stacked multi-adapter LoRA delta for one dense site
+    (``lora_rank > 0``, docs/lora.md).
+
+    Parameter contract — the additive twin of the ``_CollectiveDense``
+    knob-off convention: the base site's ``kernel``/``bias`` (and the
+    int8 ``kernel_scale``) are created by the base modules exactly as
+    ever, so knob-off is param-tree-identical; this module adds ONLY
+    the sibling pair ``lora_a [A, K, r]`` (normal init) / ``lora_b
+    [A, r, N]`` (zero init — a fresh bank is a zero delta for every
+    adapter). A = ``lora_num_adapters`` resident bank rows; row 0 is
+    the reserved zero adapter and is masked structurally, so adapter
+    id 0 reproduces the base model token-exactly whatever the bank
+    holds. Adapter checkpoints save exactly these ``*_lora`` subtrees
+    (core/checkpoint.py ``save_adapter``), base weights absent.
+
+    Compute dispatch mirrors ``_QuantDense``: flatten the site to
+    ``[M, K]`` rows keyed by per-row adapter ids, try the grouped
+    Pallas GEMM pair (sort by id → scalar-prefetched group boundaries
+    → grouped A/B GEMMs — adapters instead of experts; counted
+    ``lora/grouped``), fall back PER SITE to the XLA gather-einsum
+    form (``lora/fallback``). ``adapter_ids=None`` (training the base
+    model, abstract init, export) skips the compute entirely and
+    returns a zero delta — the params still materialize so the tree
+    shape never depends on the call.
+    """
+    config: GPTConfig
+    features: Tuple[int, ...]
+    contract_ndim: int = 1
+
+    @nn.compact
+    def __call__(self, x, adapter_ids=None):
+        from ...observability import metrics
+        cfg = self.config
+        cn = self.contract_ndim
+        num_adapters, rank = cfg.lora_num_adapters, cfg.lora_rank
+        k_dim = int(np.prod(x.shape[-cn:]))
+        n_dim = int(np.prod(self.features))
+        lora_a = self.param(
+            "lora_a",
+            nn.with_logical_partitioning(
+                _dense_init(cfg), ("adapters", "lora_in", "lora_rank")),
+            (num_adapters, k_dim, rank), jnp.dtype(cfg.param_dtype))
+        lora_b = self.param(
+            "lora_b",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init(),
+                ("adapters", "lora_rank", "lora_out")),
+            (num_adapters, rank, n_dim), jnp.dtype(cfg.param_dtype))
+        out_shape = x.shape[:-cn] + tuple(self.features)
+        if adapter_ids is None:
+            return jnp.zeros(out_shape, x.dtype)
+        dtype = jnp.dtype(cfg.dtype)
+        x2 = x.astype(dtype).reshape(-1, k_dim)        # [M, K]
+        # one id per leading batch row, repeated over the flattened
+        # row-major positions (M = batch * seq)
+        ids = jnp.repeat(jnp.asarray(adapter_ids, jnp.int32),
+                         x2.shape[0] // x.shape[0])
+        live = ids != 0
+        x2 = jnp.where(live[:, None], x2, 0)
+        a = lora_a.astype(dtype)
+        b = lora_b.astype(dtype)
+        try:
+            from ...ops.lora import grouped_lora_delta
+            d = grouped_lora_delta(x2, ids, a, b)
+            metrics.inc("lora/grouped")
+        except (ImportError, NotImplementedError):
+            from ...ops.lora import fallback_lora_delta
+            metrics.inc("lora/fallback")
+            d = fallback_lora_delta(x2, ids, a, b)
+        d = d * jnp.asarray(cfg.lora_scale, dtype)
+        d = jnp.where(live[:, None], d, 0)
+        return d.reshape(out_shape).astype(x.dtype)
+
+
 def _quantize_kv(t):
     """Symmetric per-(row, token, head) abs-max int8 quantization of a
     ``[b, W, h, d]`` K/V tensor: ``(int8 values, [b, W, h, 1] fp32
@@ -263,7 +338,7 @@ class MultiHeadAttention(nn.Module):
     @nn.compact
     def __call__(self, x, attn_bias=None, use_cache: bool = False,
                  deterministic: bool = True, cache_lengths=None,
-                 page_table=None, chunk_start=None):
+                 page_table=None, chunk_start=None, adapter_ids=None):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         h, nh, hd = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
@@ -292,6 +367,10 @@ class MultiHeadAttention(nn.Module):
             else:
                 qkv = dense((3, nh, hd), "qkv_proj",
                             (None, "heads", "kv"))(x)
+            if cfg.lora_rank:
+                qkv = qkv + _LoRADelta(
+                    cfg, features=(3, nh, hd),
+                    name="qkv_proj_lora")(x, adapter_ids)
             q, k, v = (qkv[..., i, :, :] for i in range(3))
         elif quant:
             q = _QuantDense(cfg, features=(nh, hd),
@@ -577,6 +656,7 @@ class MultiHeadAttention(nn.Module):
                 out, ("batch", "seq", "act_heads", None))
         out = checkpoint_name(out, "attn")
 
+        attn_inner = out
         if quant:
             out = _QuantDense(
                 cfg, features=(h,),
@@ -595,6 +675,10 @@ class MultiHeadAttention(nn.Module):
                     _dense_init(cfg), ("heads", "kv", "embed")),
                 bias_init=nn.with_logical_partitioning(
                     nn.initializers.zeros_init(), ("embed",)))(out)
+        if cfg.lora_rank:
+            out = out + _LoRADelta(
+                cfg, features=(h,), contract_ndim=2,
+                name="out_proj_lora")(attn_inner, adapter_ids)
         return checkpoint_name(out, "attn_out")
 
 
@@ -613,7 +697,7 @@ class TransformerDecoderLayer(nn.Module):
     @nn.compact
     def __call__(self, x, attn_bias=None, use_cache: bool = False,
                  deterministic: bool = True, cache_lengths=None,
-                 page_table=None, chunk_start=None):
+                 page_table=None, chunk_start=None, adapter_ids=None):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         pdtype = jnp.dtype(cfg.param_dtype)
@@ -628,7 +712,7 @@ class TransformerDecoderLayer(nn.Module):
         y = ln("norm1")(x)
         y = MultiHeadAttention(cfg, name="self_attn")(
             y, attn_bias, use_cache, deterministic, cache_lengths,
-            page_table, chunk_start)
+            page_table, chunk_start, adapter_ids)
         y = nn.Dropout(cfg.hidden_dropout_prob, name="dropout1")(
             y, deterministic=deterministic)
         x = residual + y
@@ -640,48 +724,54 @@ class TransformerDecoderLayer(nn.Module):
         if cfg.moe_num_experts:
             from .moe import MoEMLP
             y, moe_aux = MoEMLP(cfg, name="moe_mlp")(y, deterministic)
-        elif cfg.quant_execution == "weight_only_int8":
-            y = _QuantDense(cfg, features=(cfg.ffn_hidden_size,),
-                            kernel_axes=("embed", "mlp"),
-                            name="linear1")(y)
-            y = checkpoint_name(y, "mlp1")
-            y = nn.gelu(y, approximate=True)
-            y = with_logical_constraint(y, ("batch", None, "act_mlp"))
-            y = _QuantDense(cfg, features=(cfg.hidden_size,),
-                            kernel_axes=("mlp", "embed"),
-                            name="linear2")(y)
-            y = checkpoint_name(y, "mlp2")
-        elif cfg.use_collective_matmul:
-            y = _CollectiveDense(
-                cfg, features=(cfg.ffn_hidden_size,),
-                kernel_axes=("embed", "mlp"), mode="column",
-                name="linear1")(y)
-            y = checkpoint_name(y, "mlp1")
-            y = nn.gelu(y, approximate=True)
-            y = with_logical_constraint(y, ("batch", None, "act_mlp"))
-            y = _CollectiveDense(
-                cfg, features=(cfg.hidden_size,),
-                kernel_axes=("mlp", "embed"), mode="row",
-                name="linear2")(y)
-            y = checkpoint_name(y, "mlp2")
         else:
-            y = nn.DenseGeneral(
-                cfg.ffn_hidden_size, name="linear1", dtype=dtype,
-                param_dtype=pdtype,
-                kernel_init=nn.with_logical_partitioning(
-                    _dense_init(cfg), ("embed", "mlp")),
-                bias_init=nn.with_logical_partitioning(
-                    nn.initializers.zeros_init(), ("mlp",)))(y)
+            mlp_in = y
+            if cfg.quant_execution == "weight_only_int8":
+                y = _QuantDense(cfg, features=(cfg.ffn_hidden_size,),
+                                kernel_axes=("embed", "mlp"),
+                                name="linear1")(y)
+            elif cfg.use_collective_matmul:
+                y = _CollectiveDense(
+                    cfg, features=(cfg.ffn_hidden_size,),
+                    kernel_axes=("embed", "mlp"), mode="column",
+                    name="linear1")(y)
+            else:
+                y = nn.DenseGeneral(
+                    cfg.ffn_hidden_size, name="linear1", dtype=dtype,
+                    param_dtype=pdtype,
+                    kernel_init=nn.with_logical_partitioning(
+                        _dense_init(cfg), ("embed", "mlp")),
+                    bias_init=nn.with_logical_partitioning(
+                        nn.initializers.zeros_init(), ("mlp",)))(y)
+            if cfg.lora_rank:
+                y = y + _LoRADelta(
+                    cfg, features=(cfg.ffn_hidden_size,),
+                    name="linear1_lora")(mlp_in, adapter_ids)
             y = checkpoint_name(y, "mlp1")
             y = nn.gelu(y, approximate=True)
             y = with_logical_constraint(y, ("batch", None, "act_mlp"))
-            y = nn.DenseGeneral(
-                cfg.hidden_size, name="linear2", dtype=dtype,
-                param_dtype=pdtype,
-                kernel_init=nn.with_logical_partitioning(
-                    _dense_init(cfg), ("mlp", "embed")),
-                bias_init=nn.with_logical_partitioning(
-                    nn.initializers.zeros_init(), ("embed",)))(y)
+            mlp_mid = y
+            if cfg.quant_execution == "weight_only_int8":
+                y = _QuantDense(cfg, features=(cfg.hidden_size,),
+                                kernel_axes=("mlp", "embed"),
+                                name="linear2")(y)
+            elif cfg.use_collective_matmul:
+                y = _CollectiveDense(
+                    cfg, features=(cfg.hidden_size,),
+                    kernel_axes=("mlp", "embed"), mode="row",
+                    name="linear2")(y)
+            else:
+                y = nn.DenseGeneral(
+                    cfg.hidden_size, name="linear2", dtype=dtype,
+                    param_dtype=pdtype,
+                    kernel_init=nn.with_logical_partitioning(
+                        _dense_init(cfg), ("mlp", "embed")),
+                    bias_init=nn.with_logical_partitioning(
+                        nn.initializers.zeros_init(), ("embed",)))(y)
+            if cfg.lora_rank:
+                y = y + _LoRADelta(
+                    cfg, features=(cfg.hidden_size,),
+                    name="linear2_lora")(mlp_mid, adapter_ids)
             y = checkpoint_name(y, "mlp2")
         y = nn.Dropout(cfg.hidden_dropout_prob, name="dropout2")(
             y, deterministic=deterministic)
@@ -726,7 +816,7 @@ class GPTModel(nn.Module):
     def __call__(self, input_ids, position_ids=None, attn_bias=None,
                  use_cache: bool = False, deterministic: bool = True,
                  position_offset=0, cache_lengths=None,
-                 page_table=None, chunk_start=None):
+                 page_table=None, chunk_start=None, adapter_ids=None):
         cfg = self.config
         static_offset = position_offset if isinstance(position_offset, int) \
             else 0
@@ -760,7 +850,7 @@ class GPTModel(nn.Module):
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, scanned=True, name="decoder")(
                 x, attn_bias, use_cache, deterministic, cache_lengths,
-                page_table, chunk_start)
+                page_table, chunk_start, adapter_ids)
             moe_aux = aux_stack.sum() if cfg.moe_num_experts else None
         else:
             moe_aux = jnp.zeros((), jnp.float32) \
@@ -768,7 +858,8 @@ class GPTModel(nn.Module):
             for i in range(cfg.num_layers):
                 x = block(cfg, name=f"decoder_{i}")(
                     x, attn_bias, use_cache, deterministic,
-                    cache_lengths, page_table, chunk_start)
+                    cache_lengths, page_table, chunk_start,
+                    adapter_ids)
                 if cfg.moe_num_experts:
                     x, aux = x
                     moe_aux = moe_aux + aux
@@ -808,10 +899,11 @@ class GPTForPretraining(nn.Module):
     def __call__(self, input_ids, position_ids=None, attn_bias=None,
                  use_cache: bool = False, deterministic: bool = True,
                  position_offset=0, cache_lengths=None,
-                 page_table=None, chunk_start=None):
+                 page_table=None, chunk_start=None, adapter_ids=None):
         x = GPTModel(self.config, name="gpt")(
             input_ids, position_ids, attn_bias, use_cache, deterministic,
-            position_offset, cache_lengths, page_table, chunk_start)
+            position_offset, cache_lengths, page_table, chunk_start,
+            adapter_ids)
         word_emb = _word_embedding(
             self.variables["params"]["gpt"]["embeddings"])
         return tied_logits(x, word_emb)

@@ -1,0 +1,119 @@
+"""Grouped multi-adapter LoRA matmul for mixed-adapter decode batches.
+
+Multi-tenant LoRA serving (docs/lora.md) puts requests for *different*
+adapters in one decode batch: every batch row carries an adapter id and
+the per-site delta is ``(alpha/r) * (x @ A[id]) @ B[id]`` against that
+row's adapter pair. XLA expresses this as a per-row gather of the
+``[A, K, r]`` / ``[A, r, N]`` adapter banks followed by batched
+einsums — materializing ``[M, K, r]`` gathered weights per site. This
+module instead reuses the grouped-GEMM machinery built for sort-based
+MoE dispatch (``ops/pallas/grouped_matmul.py``), with adapters playing
+the role of experts:
+
+1. sort the ``M`` rows by adapter id (counting-sort layout, same as
+   the MoE sort dispatch);
+2. scatter them into a ``[A, C, K]`` capacity-padded group buffer
+   (``C`` = M rounded to the fp32 sublane tile — decode batches are
+   slot-sized, so the padding is cheap);
+3. run TWO grouped GEMMs — ``x @ A`` then ``(xA) @ B`` — whose
+   scalar-prefetched group boundaries skip adapters no live row uses;
+4. gather the deltas back to the original row order.
+
+Admission mirrors the other Pallas families: the grouped path raises
+``NotImplementedError`` off-TPU (unless ``PFX_PALLAS_INTERPRET=1``) or
+on kernel-indigestible shapes, and the caller
+(``models/gpt/model.py::_LoRADelta``) falls back per site to the XLA
+gather-einsum form — counted as ``lora/grouped`` vs ``lora/fallback``
+so a "grouped configured but silently gathering" run is visible.
+
+Row semantics: adapter id 0 is the reserved zero adapter (base model).
+Callers zero id-0 rows before dispatch and mask the delta after it, so
+whatever bank row 0 holds never reaches the output — the adapter-id-0
+parity pin in tests/test_lora.py is structural, not numerical.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .pallas.flash_attention import _interpret
+from .pallas.grouped_matmul import grouped_matmul
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def grouped_lora_delta(x2: jax.Array, ids: jax.Array,
+                       lora_a: jax.Array,
+                       lora_b: jax.Array) -> jax.Array:
+    """Per-row adapter delta ``out[m] = (x2[m] @ A[ids[m]]) @ B[ids[m]]``
+    through the grouped-GEMM pair.
+
+    Args:
+      x2: ``[M, K]`` flattened site input rows (id-0 rows pre-zeroed
+        by the caller).
+      ids: int32 ``[M]`` adapter id per row, in ``[0, A)``.
+      lora_a: ``[A, K, r]`` stacked down-projection bank.
+      lora_b: ``[A, r, N]`` stacked up-projection bank.
+
+    Returns ``[M, N]`` in ``x2.dtype`` (unscaled — the caller applies
+    ``alpha/r`` and the id-0 mask). Raises ``NotImplementedError``
+    when the kernel cannot take the backend/shape; the caller falls
+    back to the XLA gather-einsum form.
+    """
+    if jax.default_backend() != "tpu" and not _interpret():
+        raise NotImplementedError(
+            "grouped LoRA needs a TPU backend (or "
+            "PFX_PALLAS_INTERPRET=1)")
+    if x2.ndim != 2 or lora_a.ndim != 3 or lora_b.ndim != 3:
+        raise NotImplementedError(
+            f"grouped_lora_delta wants x[M,K] a[A,K,r] b[A,r,N], got "
+            f"{x2.shape} / {lora_a.shape} / {lora_b.shape}")
+    m, k = x2.shape
+    num_adapters, k_a, r = lora_a.shape
+    if k_a != k or lora_b.shape[:2] != (num_adapters, r):
+        raise NotImplementedError(
+            f"grouped_lora_delta bank mismatch: x {x2.shape}, a "
+            f"{lora_a.shape}, b {lora_b.shape}")
+    n = lora_b.shape[2]
+
+    ids = jnp.asarray(ids, jnp.int32)
+    # counting-sort layout: group g holds its rows contiguously at
+    # positions 0..counts[g]-1 of its capacity block. Worst case every
+    # row lands on one adapter, so capacity is M rounded to the fp32
+    # sublane tile (grouped blocks are (1, C, bk)).
+    capacity = _round_up(max(m, 1), 8)
+    order = jnp.argsort(ids)
+    sids = ids[order]
+    counts = jnp.bincount(ids, length=num_adapters)
+    starts = jnp.cumsum(counts) - counts
+    pos = jnp.arange(m, dtype=jnp.int32) - starts[sids]
+    xg = jnp.zeros((num_adapters, capacity, k), x2.dtype)
+    xg = xg.at[sids, pos].set(x2[order])
+
+    # Dispatch contract (counter + try/except fallback) lives in the
+    # one caller, models/gpt/model.py::_LoRADelta — counting per GEMM
+    # here would double-count the single lora site dispatch.
+    h = grouped_matmul(xg, lora_a.astype(x2.dtype), counts,  # pfxlint: disable=PFX205
+                       block_n=128, block_k=512)
+    d = grouped_matmul(h.astype(x2.dtype), lora_b.astype(x2.dtype),  # pfxlint: disable=PFX205
+                       counts, block_n=128, block_k=512)
+
+    out_sorted = d[sids, pos]
+    return jnp.zeros((m, n), x2.dtype).at[order].set(out_sorted)
+
+
+def fallback_lora_delta(x2: jax.Array, ids: jax.Array,
+                        lora_a: jax.Array,
+                        lora_b: jax.Array) -> jax.Array:
+    """XLA gather-einsum oracle of :func:`grouped_lora_delta`: per-row
+    bank gathers plus two batched contractions. Always available; the
+    grouped kernel is parity-pinned against this form
+    (tests/test_lora.py)."""
+    ids = jnp.asarray(ids, jnp.int32)
+    a = lora_a.astype(x2.dtype)[ids]          # [M, K, r]
+    b = lora_b.astype(x2.dtype)[ids]          # [M, r, N]
+    h = jnp.einsum("mk,mkr->mr", x2, a)
+    return jnp.einsum("mr,mrn->mn", h, b)

@@ -136,6 +136,18 @@ def make_sharding_rules(topo: TopologyConfig) -> Rules:
         # pages, like slots, are dataflow-plane content mp must not
         # split (the page-table indirection is per-row host state)
         ("cache_slots", DATA_AXES),
+        # Multi-tenant LoRA adapter banks (models/gpt/model.py
+        # _LoRADelta, docs/lora.md): [A, K, r] / [A, r, N] stacked
+        # pairs. They are small (rank x hidden per adapter), so every
+        # axis stays replicated: the adapter dim is serving-side
+        # content (bank rows are swapped by the adapter cache, like
+        # KV pages — sharding it would turn every cache fill into a
+        # collective), and rank is far below the lane width. The
+        # grouped GEMM then runs fully local per chip.
+        ("adapters", None),
+        ("lora_in", None),
+        ("lora_rank", None),
+        ("lora_out", None),
     )
 
 

@@ -50,11 +50,23 @@ the same prompts then proves the warm restart: the restarted replicas
 serve it with at least one ``serving_rehydrate``, and in the
 post-restart event stream the first rehydrate precedes the first
 ``serving_prefill_chunk`` — host-DRAM hits beat re-prefill
-(docs/inference.md "Hierarchical KV cache"). Run from the repo root:
+(docs/inference.md "Hierarchical KV cache").
+
+With ``--adapters`` a multi-tenant LoRA leg (docs/lora.md) rolling-
+restarts a 2-replica fleet under MIXED-ADAPTER load: six requests
+striped across adapter ids {1,2,3} while every replica goes down in
+turn. Asserted: zero dropped tokens (every completion, first wave and
+a warm second wave, token-identical to a single-server reference),
+nothing shed, at least one request failed over, and the post-restart
+adapter-cache re-warm reconstructs from events.jsonl ALONE — the
+``serving_adapter_load`` events after the restart cover the full
+adapter working set, proving the restarted replicas' cold banks
+re-warmed rather than silently serving base weights. Run from the
+repo root:
 
   python scripts/chaos_smoke.py [--workdir DIR] [--steps 12]
                                 [--kill-step 7] [--save-steps 4]
-                                [--ptq] [--fleet]
+                                [--ptq] [--fleet] [--adapters]
 """
 
 import argparse
@@ -463,6 +475,144 @@ def fleet_leg(work):
         f"rehydrates, first rehydrate ahead of any prefill chunk\n")
 
 
+def adapters_leg(work):
+    """Multi-tenant LoRA drill (docs/lora.md): rolling-restart a
+    2-replica fleet under mixed-adapter load — zero dropped tokens,
+    and the restarted replicas' adapter-cache re-warm proven from
+    ``serving_adapter_load`` events alone."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("PFX_PALLAS_INTERPRET", "1")
+    sys.path.insert(0, REPO)
+    import jax
+    import jax.numpy as jnp
+    import flax.linen as nn
+
+    from paddlefleetx_tpu.core.adapters import extract_adapter
+    from paddlefleetx_tpu.core.fleet import FleetRouter
+    from paddlefleetx_tpu.core.serving import GenerationServer
+    from paddlefleetx_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddlefleetx_tpu.models.gpt.generation import GenerationConfig
+
+    vocab, eos = 96, 95
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=32, num_layers=2,
+                    num_attention_heads=4,
+                    max_position_embeddings=128,
+                    hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0,
+                    fuse_attn_qkv=True, lora_rank=4,
+                    lora_num_adapters=4)
+    model = GPTForPretraining(cfg)
+    params = nn.meta.unbox(
+        model.init({"params": jax.random.key(0)},
+                   jnp.zeros((1, 8), jnp.int32))["params"])
+    gen_cfg = GenerationConfig(max_dec_len=6,
+                               decode_strategy="greedy_search",
+                               eos_token_id=eos, pad_token_id=eos)
+    shapes = {k: np.asarray(v).shape
+              for k, v in extract_adapter(params, 0).items()}
+
+    def source(aid):
+        rng = np.random.default_rng(1000 + int(aid))
+        return {k: rng.normal(0.0, 0.2, s).astype(np.float32)
+                for k, s in shapes.items()}
+
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, eos, 6 + i).tolist() for i in range(6)]
+    aids = [1, 2, 3, 1, 2, 3]    # the adapter working set, striped
+
+    # greedy decode is deterministic whatever the batching, so one
+    # reference server's completions are the fleet's token oracle
+    ref_srv = GenerationServer(model, params, gen_cfg, num_slots=2,
+                               adapter_source=source)
+    ref = [c.tokens for c in ref_srv.run(prompts, adapter_ids=aids)]
+
+    events = os.path.join(work, "adapter_events.jsonl")
+
+    def factory(name):
+        return GenerationServer(model, params, gen_cfg, num_slots=2,
+                                adapter_source=source,
+                                events_path=events)
+
+    fleet = FleetRouter(factory, 2, events_path=events)
+    gids = [fleet.submit(p, adapter_id=a)
+            for p, a in zip(prompts, aids)]
+    done = {}
+    # commit mid-stream state worth restarting under
+    while fleet.summary()["decode_tokens"] < 2 and len(done) < len(gids):
+        for c in fleet.step():
+            done[c.request_id] = c
+    mark = sum(1 for _ in open(events))
+    # the drill: EVERY replica goes down in turn under adapter load
+    for c in fleet.rolling_restart():
+        done[c.request_id] = c
+    while fleet.busy:
+        for c in fleet.step():
+            done[c.request_id] = c
+    summ = fleet.summary()
+
+    missing = [g for g in gids if g not in done]
+    if missing:
+        fail(f"adapter leg lost requests {missing}")
+    bad_reason = [g for g in gids
+                  if done[g].finish_reason not in ("eos", "length")]
+    if bad_reason:
+        fail(f"adapter leg requests {bad_reason} finished "
+             f"{[done[g].finish_reason for g in bad_reason]} — the "
+             f"restart dropped adapters on the floor")
+    got = [done[g].tokens for g in gids]
+    if got != ref:
+        bad = [i for i, (a, b) in enumerate(zip(got, ref)) if a != b]
+        fail(f"adapter leg dropped committed tokens: requests {bad} "
+             f"diverged from the single-server reference after the "
+             f"rolling restart")
+    if summ["shed"] != 0:
+        fail(f"adapter leg shed {summ['shed']} requests while the "
+             f"peer had capacity")
+    if summ["failovers"] < 1:
+        fail("adapter leg exercised no failover — the restart landed "
+             "on an idle replica, drill geometry is broken")
+    if summ["restarts"] != 2:
+        fail(f"expected 2 replica restarts, recorded "
+             f"{summ['restarts']}")
+
+    # warm second wave: the same mixed-adapter trace again, served by
+    # the restarted replicas
+    gids2 = [fleet.submit(p, adapter_id=a)
+             for p, a in zip(prompts, aids)]
+    done2 = {}
+    while fleet.busy:
+        for c in fleet.step():
+            done2[c.request_id] = c
+    fleet.close()
+    got2 = [done2[g].tokens for g in gids2 if g in done2]
+    if got2 != ref:
+        fail("adapter leg warm wave diverged from the single-server "
+             "reference — the re-warmed banks served wrong weights")
+
+    # the re-warm evidence must reconstruct from events ALONE: the
+    # restarted replicas start with cold banks, so the post-restart
+    # stream (failover re-admissions + the warm wave) must show
+    # serving_adapter_load events covering the full working set — a
+    # fleet that silently served base weights would show none
+    with open(events) as f:
+        warm_evs = [json.loads(line)
+                    for line in list(f)[mark:] if line.strip()]
+    reloaded = {e["adapter"] for e in warm_evs
+                if e.get("event") == "serving_adapter_load"}
+    if reloaded != set(aids):
+        fail(f"post-restart stream re-warmed adapters "
+             f"{sorted(reloaded)}, expected the full working set "
+             f"{sorted(set(aids))} — the restarted banks stayed cold")
+
+    sys.stdout.write(
+        f"ADAPTER LEG OK: rolling restart of 2 LoRA replicas under "
+        f"mixed-adapter load — {len(gids)} + {len(gids2)} requests "
+        f"token-exact vs the single-server reference, shed=0, "
+        f"failovers={summ['failovers']}, post-restart re-warm of "
+        f"adapters {sorted(reloaded)} reconstructed from "
+        f"{os.path.basename(events)}\n")
+
+
 def main():
     """Run the baseline/chaos/resume triple and assert continuity."""
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -477,6 +627,11 @@ def main():
                     help="also rolling-restart an in-process "
                          "2-replica serving fleet mid-stream and "
                          "assert zero token loss + trace continuity")
+    ap.add_argument("--adapters", action="store_true",
+                    help="also rolling-restart a 2-replica LoRA "
+                         "fleet under mixed-adapter load and assert "
+                         "zero token loss + adapter-cache re-warm "
+                         "from events alone")
     args = ap.parse_args()
 
     work = args.workdir or tempfile.mkdtemp(prefix="pfx_chaos_")
@@ -551,6 +706,10 @@ def main():
     # 5. optional: rolling-restart a serving fleet under load
     if args.fleet:
         fleet_leg(work)
+
+    # 6. optional: rolling-restart a LoRA fleet under adapter load
+    if args.adapters:
+        adapters_leg(work)
 
     sys.stdout.write(
         f"CHAOS SMOKE OK: killed at step {args.kill_step}, restored "

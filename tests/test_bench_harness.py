@@ -889,6 +889,55 @@ def test_bench_serving_kv_dtype_off_by_default_and_unpaged(
         bench.METRIC_BY_MODE["serving"]
 
 
+def test_bench_serving_adapters_ab_record(monkeypatch, capsys):
+    """PFX_BENCH_SERVING_ADAPTERS=N adds ONE A/B record ahead of the
+    headline: the same trace served from a LoRA-enabled model twin,
+    all-base (adapter id 0) then round-robin over N adapters, with
+    both arms' tokens/s, the slowdown ratio and the adapter-cache
+    counters (docs/lora.md). The headline and spec records keep
+    their pinned last-two positions and never load a LoRA model; no
+    knob -> no record."""
+    monkeypatch.setenv("PFX_BENCH_SERVING_TIERED", "0")
+    monkeypatch.setenv("PFX_BENCH_SERVING_LOOP_TICKS", "1")
+    monkeypatch.setenv("PFX_BENCH_SERVING_REQUESTS", "4")
+    monkeypatch.setenv("PFX_BENCH_SERVING_MAX_PROMPT", "8")
+    monkeypatch.setenv("PFX_BENCH_SERVING_DEC_LEN", "4")
+    monkeypatch.setenv("PFX_BENCH_SERVING_SPEC", "1")
+    monkeypatch.setenv("PFX_BENCH_SERVING_ADAPTERS", "2")
+    monkeypatch.setenv("PFX_BENCH_SERVING_LORA_RANK", "4")
+    bench.bench_serving()
+    lines = capsys.readouterr().out.strip().splitlines()
+    recs = [json.loads(ln) for ln in lines if ln.startswith("{")]
+    ada, rec, spec = recs[-3], recs[-2], recs[-1]
+    assert rec["metric"] == bench.METRIC_BY_MODE["serving"]
+    assert spec["metric"] == \
+        "gpt345m_serving_spec_decode_tokens_per_sec_per_chip"
+    assert ada["metric"] == \
+        "gpt345m_serving_decode_tokens_per_sec_per_chip_adapters"
+    assert ada["value"] > 0 and ada["unit"] == "tokens/s"
+    assert ada["adapters"] == 2 and ada["lora_rank"] == 4
+    assert ada["requests"] == rec["requests"]
+    assert ada["seed"] == rec["seed"]
+    # both arms measured; the ratio is the headline claim
+    assert ada["base_tokens_per_sec"] > 0
+    assert ada["adapter_slowdown"] > 0
+    # the adapter arm actually exercised the cache: each of the 2
+    # adapters loads once (misses), later requests hit
+    assert ada["adapter_misses"] == 2
+    assert ada["adapter_hits"] >= 1
+    assert ada["adapters_resident"] == 2
+    assert ada["adapter_evictions"] == 0
+    # the headline record never carries adapter fields
+    assert "adapters" not in rec and "lora_rank" not in rec
+    # no knob -> no record
+    monkeypatch.delenv("PFX_BENCH_SERVING_ADAPTERS", raising=False)
+    monkeypatch.setenv("PFX_BENCH_SERVING_SPEC", "0")
+    bench.bench_serving()
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert not any("_adapters" in ln for ln in lines
+                   if ln.startswith("{"))
+
+
 def test_bench_serving_tiered_ab_record(monkeypatch, capsys):
     """The tiered-cache A/B (on by default in paged mode) emits ONE
     ``_tiered`` record ahead of the headline: a seeded multi-turn

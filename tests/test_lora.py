@@ -1,0 +1,697 @@
+"""Multi-tenant LoRA: banks, grouped dispatch, cache, serving parity.
+
+The acceptance bars (docs/lora.md):
+
+- adapter id 0 (the reserved zero adapter) is token-exact vs the base
+  model across greedy/sampled x paged/unpaged x spec on/off x
+  device-loop T in {1, 16} — the LoRA machinery must be structurally
+  invisible when no adapter is selected;
+- one decode tick serves >= 3 distinct adapter ids through the grouped
+  path, with the ``lora/grouped`` dispatch counter proving the kernel
+  (not the gather fallback) ran;
+- the HBM adapter cache never evicts a row a live slot has pinned, and
+  eviction under pressure requeues cleanly (queue-head blocking, same
+  rule as page starvation).
+
+Interpret mode (``PFX_PALLAS_INTERPRET=1``) admits the grouped GEMM on
+CPU; the XLA gather-einsum fallback is its oracle.
+"""
+
+import dataclasses
+import json
+import os
+
+os.environ.setdefault("PFX_PALLAS_INTERPRET", "1")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlefleetx_tpu.core.adapters import (
+    AdapterCache, AdapterCacheFull, extract_adapter, insert_adapter,
+)
+from paddlefleetx_tpu.core.checkpoint import (
+    CheckpointCorrupt, MANIFEST_NAME, load_adapter, save_adapter,
+)
+from paddlefleetx_tpu.core.fleet import FleetRouter
+from paddlefleetx_tpu.core.serving import GenerationServer, RequestShed
+from paddlefleetx_tpu.models.gpt import GPTConfig, GPTForPretraining
+from paddlefleetx_tpu.models.gpt.generation import (
+    GenerationConfig, _unstack_layer_params,
+)
+from paddlefleetx_tpu.observability import metrics
+from paddlefleetx_tpu.ops.lora import (
+    fallback_lora_delta, grouped_lora_delta,
+)
+
+import flax.linen as nn
+
+# base/LoRA twins: identical architecture (fused qkv — the LoRA qkv
+# site hooks the fused projection), the LoRA config only adds banks
+BCFG = GPTConfig(vocab_size=96, hidden_size=32, num_layers=2,
+                 num_attention_heads=4, max_position_embeddings=128,
+                 hidden_dropout_prob=0.0,
+                 attention_probs_dropout_prob=0.0,
+                 fuse_attn_qkv=True)
+LCFG = dataclasses.replace(BCFG, lora_rank=4, lora_num_adapters=4)
+# multi-page capacity: prompts span a full 128-token page so prefix
+# registration would trigger if adapter requests (wrongly) shared KV
+LCFG512 = dataclasses.replace(LCFG, max_position_embeddings=512)
+EOS = PAD = 95
+
+PROMPTS = [[5, 9, 2, 7, 1], [11, 3], [4, 4, 8, 1, 2, 6, 9],
+           [13, 2, 2]]
+
+
+@pytest.fixture(scope="module")
+def base_mp():
+    model = GPTForPretraining(BCFG)
+    variables = model.init({"params": jax.random.key(0)},
+                           jnp.zeros((1, 8), jnp.int32))
+    return model, nn.meta.unbox(variables["params"])
+
+
+@pytest.fixture(scope="module")
+def lora_mp():
+    model = GPTForPretraining(LCFG)
+    variables = model.init({"params": jax.random.key(0)},
+                           jnp.zeros((1, 8), jnp.int32))
+    return model, nn.meta.unbox(variables["params"])
+
+
+@pytest.fixture(scope="module")
+def lora512_mp():
+    model = GPTForPretraining(LCFG512)
+    variables = model.init({"params": jax.random.key(0)},
+                           jnp.zeros((1, 8), jnp.int32))
+    return model, nn.meta.unbox(variables["params"])
+
+
+def _make_source(ref_tree, known=frozenset(range(1, 64))):
+    """Seeded adapter id -> tree source shaped like ``ref_tree``;
+    unknown ids raise KeyError like a real store."""
+    shapes = {k: np.asarray(v).shape for k, v in ref_tree.items()}
+
+    def source(aid):
+        if aid not in known:
+            raise KeyError(aid)
+        rng = np.random.default_rng(1000 + int(aid))
+        # large enough that an adapter visibly changes greedy argmax
+        return {k: rng.normal(0.0, 0.2, s).astype(np.float32)
+                for k, s in shapes.items()}
+    return source
+
+
+@pytest.fixture(scope="module")
+def adapter_source(lora_mp):
+    _, params = lora_mp
+    return _make_source(extract_adapter(params, 0))
+
+
+@pytest.fixture()
+def counters():
+    """Enable the global registry; yields a counter-snapshot callable."""
+    reg = metrics.get_registry()
+    prev = reg.enabled
+    reg.enabled = True
+    yield lambda: dict(reg.snapshot()["counters"])
+    reg.enabled = prev
+
+
+def _paths(params):
+    return {jax.tree_util.keystr(p)
+            for p, _ in jax.tree_util.tree_flatten_with_path(params)[0]}
+
+
+def _greedy_cfg(max_dec=6):
+    return GenerationConfig(max_dec_len=max_dec,
+                            decode_strategy="greedy_search",
+                            eos_token_id=EOS, pad_token_id=PAD)
+
+
+def _sampling_cfg(max_dec=6):
+    return GenerationConfig(max_dec_len=max_dec,
+                            decode_strategy="sampling",
+                            top_k=8, top_p=0.9, temperature=0.7,
+                            eos_token_id=EOS, pad_token_id=PAD)
+
+
+def _spec_cfg(base, k=3):
+    return dataclasses.replace(base, spec_method="ngram",
+                               spec_tokens=k)
+
+
+# -- banks: knob-off invisibility, shapes, init ------------------------
+
+
+def test_lora_adds_only_bank_leaves(base_mp, lora_mp):
+    """lora_rank>0 adds exactly the eight stacked bank leaves — every
+    base leaf keeps its path, shape, and (same seed) its values."""
+    _, base = base_mp
+    _, lora = lora_mp
+    extra = _paths(lora) - _paths(base)
+    assert _paths(base) <= _paths(lora)
+    assert len(extra) == 8          # 4 sites x {lora_a, lora_b}
+    assert all("_lora" in p for p in extra)
+    flat_b = dict(jax.tree_util.tree_flatten_with_path(base)[0])
+    flat_l = dict(jax.tree_util.tree_flatten_with_path(lora)[0])
+    for path, leaf in flat_l.items():
+        key = jax.tree_util.keystr(path)
+        if key in {jax.tree_util.keystr(p) for p in flat_b}:
+            match = [v for p, v in flat_b.items()
+                     if jax.tree_util.keystr(p) == key][0]
+            np.testing.assert_array_equal(np.asarray(leaf),
+                                          np.asarray(match))
+        elif key.endswith("['lora_a']"):    # scanned [L, A, K, r]
+            assert leaf.shape[:2] == (LCFG.num_layers,
+                                      LCFG.lora_num_adapters)
+            assert leaf.shape[-1] == LCFG.lora_rank
+            assert np.abs(np.asarray(leaf)).sum() > 0
+        else:                           # lora_b zero-init: knob-on is
+            assert key.endswith("['lora_b']")  # a numeric no-op at step 0
+            assert leaf.shape[:2] == (LCFG.num_layers,
+                                      LCFG.lora_num_adapters)
+            assert leaf.shape[2] == LCFG.lora_rank
+            np.testing.assert_array_equal(np.asarray(leaf), 0.0)
+
+
+def test_knob_off_tree_identical(base_mp):
+    """lora_rank=0 IS the base model — param tree bit-identical."""
+    model = GPTForPretraining(dataclasses.replace(
+        LCFG, lora_rank=0, lora_num_adapters=0))
+    variables = model.init({"params": jax.random.key(0)},
+                           jnp.zeros((1, 8), jnp.int32))
+    params = nn.meta.unbox(variables["params"])
+    _, base = base_mp
+    assert _paths(params) == _paths(base)
+
+
+# -- grouped kernel vs XLA fallback ------------------------------------
+
+
+def test_grouped_matches_fallback():
+    """The grouped GEMM pair equals the per-row gather-einsum oracle
+    for mixed, duplicated, and all-zero adapter id rows."""
+    rng = np.random.default_rng(7)
+    m, k, r, n, a = 6, 32, 4, 24, 5
+    x = jnp.asarray(rng.normal(size=(m, k)), jnp.float32)
+    la = jnp.asarray(rng.normal(size=(a, k, r)), jnp.float32)
+    lb = jnp.asarray(rng.normal(size=(a, r, n)), jnp.float32)
+    for ids in ([1, 3, 1, 0, 4, 2], [2] * m, [0] * m):
+        ids = jnp.asarray(ids, jnp.int32)
+        got = grouped_lora_delta(x, ids, la, lb)
+        want = fallback_lora_delta(x, ids, la, lb)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_grouped_rejects_bad_shapes():
+    x = jnp.zeros((4, 8), jnp.float32)
+    ids = jnp.zeros((4,), jnp.int32)
+    with pytest.raises(NotImplementedError, match="wants"):
+        grouped_lora_delta(x[None], ids, jnp.zeros((2, 8, 2)),
+                           jnp.zeros((2, 2, 8)))
+    with pytest.raises(NotImplementedError, match="mismatch"):
+        grouped_lora_delta(x, ids, jnp.zeros((2, 6, 2)),
+                           jnp.zeros((2, 2, 8)))
+
+
+# -- adapter trees: extract / insert across layouts --------------------
+
+
+def test_extract_insert_roundtrip_scanned(lora_mp, adapter_source):
+    _, params = lora_mp
+    tree = adapter_source(5)
+    p2 = insert_adapter(params, tree, 2)
+    out = extract_adapter(p2, 2)
+    assert set(out) == set(tree)
+    for key in tree:
+        np.testing.assert_allclose(np.asarray(out[key]), tree[key],
+                                   rtol=1e-6)
+    # other rows untouched
+    np.testing.assert_array_equal(
+        np.asarray(extract_adapter(p2, 1)["qkv_proj_lora/lora_b"]),
+        np.asarray(extract_adapter(params, 1)["qkv_proj_lora/lora_b"]))
+
+
+def test_extract_insert_cross_layout(lora_mp, adapter_source):
+    """An adapter written into the scanned training params reads back
+    identically from the unrolled serving layout, and vice versa."""
+    _, params = lora_mp
+    tree = adapter_source(9)
+    scanned = insert_adapter(params, tree, 3)
+    unrolled = _unstack_layer_params(scanned, LCFG.num_layers)
+    out = extract_adapter(unrolled, 3)
+    for key in tree:
+        np.testing.assert_allclose(np.asarray(out[key]), tree[key],
+                                   rtol=1e-6)
+    # and insert into the unrolled layout directly
+    tree2 = adapter_source(10)
+    unrolled2 = insert_adapter(unrolled, tree2, 1)
+    out2 = extract_adapter(unrolled2, 1)
+    for key in tree2:
+        np.testing.assert_allclose(np.asarray(out2[key]), tree2[key],
+                                   rtol=1e-6)
+
+
+def test_insert_rejects_chimera(lora_mp, adapter_source):
+    """Partial or misshapen trees must fail loudly — a silent partial
+    insert would serve a chimera adapter."""
+    _, params = lora_mp
+    tree = adapter_source(4)
+    partial = dict(tree)
+    partial.pop("linear1_lora/lora_a")
+    with pytest.raises(ValueError, match="missing"):
+        insert_adapter(params, partial, 1)
+    bad = dict(tree)
+    bad["linear2_lora/lora_b"] = bad["linear2_lora/lora_b"][:, :2]
+    with pytest.raises(ValueError, match="does not fit"):
+        insert_adapter(params, bad, 1)
+    extra = dict(tree)
+    extra["mystery_lora/lora_a"] = tree["qkv_proj_lora/lora_a"]
+    with pytest.raises(ValueError, match="matched no bank"):
+        insert_adapter(params, extra, 1)
+    with pytest.raises(ValueError, match="out of range"):
+        extract_adapter(params, LCFG.lora_num_adapters)
+    with pytest.raises(ValueError, match="no LoRA banks"):
+        extract_adapter({"wte": jnp.zeros((4, 4))}, 0)
+
+
+# -- adapter checkpoints -----------------------------------------------
+
+
+def test_adapter_checkpoint_roundtrip(tmp_path, adapter_source):
+    tree = adapter_source(7)
+    path = tmp_path / "adapter7"
+    save_adapter(str(path), tree, meta={"adapter": 7, "rank": 4})
+    out, meta = load_adapter(str(path))
+    assert meta == {"adapter": 7, "rank": 4}
+    assert set(out) == set(tree)
+    for key in tree:
+        np.testing.assert_array_equal(np.asarray(out[key]), tree[key])
+
+
+def test_adapter_checkpoint_torn_write(tmp_path, adapter_source):
+    """No committed manifest -> CheckpointCorrupt, never a half-read
+    adapter."""
+    path = tmp_path / "torn"
+    save_adapter(str(path), adapter_source(3))
+    (path / MANIFEST_NAME).unlink()
+    with pytest.raises(CheckpointCorrupt, match="manifest"):
+        load_adapter(str(path))
+
+
+# -- AdapterCache: refcounts, LRU, pinned rows -------------------------
+
+
+def _tiny_source(aid):
+    if int(aid) >= 90:
+        raise KeyError(aid)
+    return {"qkv_proj_lora/lora_a": np.full((2, 4, 2), float(aid))}
+
+
+def test_cache_hit_miss_refcounts():
+    cache = AdapterCache(4, _tiny_source)      # rows 1..3 usable
+    l1 = cache.acquire(11)
+    assert l1.row == 1 and l1.tree is not None and l1.evicted is None
+    l2 = cache.acquire(11)
+    assert l2.row == 1 and l2.tree is None      # warm hit, no reload
+    assert cache.refcount(11) == 2
+    assert cache.stats == {"adapter_hits": 1, "adapter_misses": 1,
+                           "adapter_evictions": 0}
+    cache.release(11)
+    assert cache.refcount(11) == 1 and cache.is_resident(11)
+    cache.release(11)
+    assert cache.refcount(11) == 0 and cache.is_resident(11)
+    cache.check()
+
+
+def test_cache_lru_eviction_order():
+    cache = AdapterCache(3, _tiny_source)      # 2 usable rows
+    cache.acquire(1)
+    cache.acquire(2)
+    cache.release(1)                            # 1 becomes LRU fodder
+    cache.release(2)
+    lease = cache.acquire(3)                    # evicts 1 (least recent)
+    assert lease.evicted == 1 and lease.tree is not None
+    assert sorted(cache.resident_ids()) == [2, 3]
+    # re-acquiring 2 is still a warm hit — it kept its row
+    assert cache.acquire(2).tree is None
+    assert cache.stats["adapter_evictions"] == 1
+    cache.check()
+
+
+def test_cache_pinned_rows_never_evicted():
+    cache = AdapterCache(3, _tiny_source)
+    cache.acquire(1)
+    cache.acquire(2)                            # both rows pinned
+    with pytest.raises(AdapterCacheFull):
+        cache.acquire(3)
+    # the refusal changed nothing
+    assert sorted(cache.resident_ids()) == [1, 2]
+    assert cache.refcount(1) == 1 and cache.refcount(2) == 1
+    assert not cache.can_admit(3)
+    cache.release(2)
+    assert cache.can_admit(3)
+    assert cache.acquire(3).evicted == 2
+    assert cache.refcount(1) == 1               # pinned row untouched
+    cache.check()
+
+
+def test_cache_unknown_id_does_not_evict():
+    """The source load happens BEFORE eviction: an unknown id must not
+    cost a warm resident its row."""
+    cache = AdapterCache(2, _tiny_source)       # 1 usable row
+    cache.acquire(5)
+    cache.release(5)                            # resident, evictable
+    with pytest.raises(KeyError):
+        cache.acquire(99)
+    assert cache.resident_ids() == [5]
+    assert cache.stats["adapter_evictions"] == 0
+    cache.check()
+
+
+def test_cache_release_errors():
+    cache = AdapterCache(3, _tiny_source)
+    with pytest.raises(KeyError, match="non-resident"):
+        cache.release(1)
+    cache.acquire(1)
+    cache.release(1)
+    with pytest.raises(AssertionError, match="underflow"):
+        cache.release(1)
+    with pytest.raises(ValueError, match="num_rows"):
+        AdapterCache(1, _tiny_source)
+
+
+# -- serving: adapter-id-0 parity matrix -------------------------------
+
+
+@pytest.mark.parametrize("loop_ticks", [1, 16])
+@pytest.mark.parametrize("spec", [False, True])
+@pytest.mark.parametrize("paged", [False, True])
+@pytest.mark.parametrize("strategy", ["greedy", "sampling"])
+def test_adapter_id0_parity_matrix(base_mp, lora_mp, adapter_source,
+                                   strategy, paged, spec, loop_ticks):
+    """The zero adapter is structural: a LoRA server serving adapter
+    id 0 is token-exact vs the base model, whatever the decode
+    strategy, KV layout, spec mode, or device-loop depth."""
+    base_model, base_params = base_mp
+    lora_model, lora_params = lora_mp
+    gen_cfg = (_greedy_cfg() if strategy == "greedy"
+               else _sampling_cfg())
+    if spec:
+        gen_cfg = _spec_cfg(gen_cfg)
+    kw = dict(num_slots=2, rng=jax.random.key(5),
+              device_loop_ticks=loop_ticks)
+    if paged:
+        kw.update(page_size=128, prefill_chunk_pages=1)
+    ref_srv = GenerationServer(base_model, base_params, gen_cfg, **kw)
+    ref = [c.tokens for c in ref_srv.run(PROMPTS)]
+    srv = GenerationServer(lora_model, lora_params, gen_cfg,
+                           adapter_source=adapter_source, **kw)
+    comps = srv.run(PROMPTS, adapter_ids=[0] * len(PROMPTS))
+    assert [c.tokens for c in comps] == ref
+    assert all(c.finish_reason in ("eos", "length") for c in comps)
+    assert srv.summary()["adapters_resident"] == 0   # id 0 never loads
+
+
+def test_adapter_changes_tokens(lora_mp, adapter_source):
+    """A non-zero adapter must actually alter decode (the banks are
+    live, not decorative), and the same adapter id is deterministic."""
+    model, params = lora_mp
+    gen_cfg = _greedy_cfg()
+    srv = GenerationServer(model, params, gen_cfg, num_slots=2,
+                           adapter_source=adapter_source)
+    base = [c.tokens for c in srv.run(PROMPTS, adapter_ids=[0] * 4)]
+    tinted = [c.tokens for c in srv.run(PROMPTS, adapter_ids=[1] * 4)]
+    again = [c.tokens for c in srv.run(PROMPTS, adapter_ids=[1] * 4)]
+    assert tinted == again
+    assert tinted != base
+
+
+# -- serving: grouped multi-adapter decode (the acceptance tick) -------
+
+
+def test_three_adapters_one_tick_grouped(lora_mp, adapter_source,
+                                         counters):
+    """One decode tick serves >= 3 distinct adapters and the grouped
+    dispatch counter proves the kernel path took them."""
+    model, params = lora_mp
+    srv = GenerationServer(model, params, _greedy_cfg(max_dec=5),
+                           num_slots=4, adapter_source=adapter_source)
+    before = counters()
+    done = {}
+    ids = [srv.submit(p, adapter_id=a)
+           for p, a in zip(PROMPTS, [1, 2, 3, 0])]
+    max_distinct = 0
+    while srv.pending or srv.occupancy:
+        for c in srv.step():
+            done[c.request_id] = c
+        live = {int(r) for r in srv._aid_np if int(r)}
+        max_distinct = max(max_distinct, len(live))
+    assert max_distinct >= 3
+    assert len(done) == 4
+    assert all(done[i].finish_reason in ("eos", "length") for i in ids)
+    after = counters()
+    assert after.get("lora/grouped", 0) > before.get("lora/grouped", 0)
+    assert after.get("serving/adapter_misses", 0) - \
+        before.get("serving/adapter_misses", 0) == 3
+    summ = srv.summary()
+    assert summ["adapters_resident"] == 3
+    srv._adapters.check()
+
+
+def test_eviction_under_pressure_requeues(lora_mp, counters):
+    """More live adapters than bank rows: the overflow request blocks
+    at the queue head (no row is stolen from a pinned adapter), admits
+    after a release, and its admission evicts the LRU refcount-0
+    resident — every request still completes."""
+    model, params = lora_mp
+    cfg3 = dataclasses.replace(LCFG, lora_num_adapters=3)  # 2 rows
+    m3 = GPTForPretraining(cfg3)
+    p3 = nn.meta.unbox(m3.init({"params": jax.random.key(0)},
+                               jnp.zeros((1, 8), jnp.int32))["params"])
+    source = _make_source(extract_adapter(p3, 0))
+    srv = GenerationServer(m3, p3, _greedy_cfg(), num_slots=2,
+                           adapter_source=source)
+    before = counters()
+    comps = srv.run([PROMPTS[0], PROMPTS[1], PROMPTS[2]],
+                    adapter_ids=[1, 2, 3])
+    assert all(c.finish_reason in ("eos", "length") for c in comps)
+    after = counters()
+    assert after.get("serving/adapter_evictions", 0) - \
+        before.get("serving/adapter_evictions", 0) >= 1
+    cache = srv._adapters
+    assert 3 in cache.resident_ids() and cache.resident == 2
+    cache.check()
+    assert srv.summary()["adapter_evictions"] >= 1
+
+
+def test_lora_serving_smoke(lora_mp, adapter_source, counters,
+                            tmp_path):
+    """CI smoke (named step in .github/workflows/ci.yml): one server,
+    >= 3 distinct adapter ids live in a single decode tick through the
+    grouped path, plus one mid-run adapter-cache eviction — and the
+    flight-recorder events.jsonl alone carries the evidence
+    (serving_adapter_load / serving_adapter_evict), so a failure
+    leaves a diagnosable trail in the CI artifact."""
+    model, params = lora_mp
+    events = tmp_path / "events.jsonl"
+    # num_slots=5 is unique across this file: the dispatch counters
+    # fire at trace time, so the smoke needs a shape no earlier test
+    # compiled — whatever order the suite runs in
+    srv = GenerationServer(model, params, _greedy_cfg(max_dec=5),
+                           num_slots=5, adapter_source=adapter_source,
+                           events_path=str(events))
+    before = counters()
+    done = {}
+    # 5 slots, 5 requests: ids 1/2/3 fill the three usable bank rows
+    # in one tick; id 4's admission blocks at the queue head on the
+    # fully-pinned bank and mid-run must evict the first released
+    # refcount-0 resident
+    ids = [srv.submit(p, adapter_id=a) for p, a in
+           zip(PROMPTS + [PROMPTS[0]], [1, 2, 3, 0, 4])]
+    max_distinct = 0
+    while srv.pending or srv.occupancy:
+        for c in srv.step():
+            done[c.request_id] = c
+        live = {int(r) for r in srv._aid_np if int(r)}
+        max_distinct = max(max_distinct, len(live))
+    assert max_distinct >= 3
+    assert len(done) == 5
+    assert all(done[i].finish_reason in ("eos", "length") for i in ids)
+    after = counters()
+    assert after.get("lora/grouped", 0) > before.get("lora/grouped", 0)
+    srv._adapters.check()
+    # the eviction evidence must reconstruct from events alone
+    evs = [json.loads(l) for l in events.read_text().splitlines()]
+    loads = [e for e in evs if e["event"] == "serving_adapter_load"]
+    evicts = [e for e in evs if e["event"] == "serving_adapter_evict"]
+    assert len({e["adapter"] for e in loads}) == 4    # ids 1,2,3,4
+    assert len(evicts) >= 1
+    assert evicts[0]["adapter"] in (1, 2, 3)
+
+
+def test_unknown_adapter_fails_cleanly(lora_mp, adapter_source):
+    """An unknown adapter id fails ONLY its own request
+    (finish_reason="adapter_missing") — no eviction, no wedged queue."""
+    model, params = lora_mp
+    srv = GenerationServer(model, params, _greedy_cfg(), num_slots=2,
+                           adapter_source=adapter_source)
+    comps = srv.run([PROMPTS[0], PROMPTS[1]], adapter_ids=[1, 99])
+    by_reason = {c.finish_reason for c in comps}
+    assert "adapter_missing" in by_reason
+    assert by_reason & {"eos", "length"}
+    srv._adapters.check()
+    assert srv.summary()["adapter_evictions"] == 0
+    # validation is synchronous where possible
+    with pytest.raises(ValueError, match="adapter_id"):
+        srv.submit(PROMPTS[0], adapter_id=-1)
+    base_srv = GenerationServer(model, params, _greedy_cfg(),
+                                num_slots=2)
+    with pytest.raises(ValueError, match="adapter_source"):
+        base_srv.submit(PROMPTS[0], adapter_id=1)
+
+
+def test_adapter_requests_never_share_prefix_kv(lora512_mp, counters):
+    """Adapter deltas tint every layer's KV, so adapter requests must
+    neither hit nor seed the shared-prefix registry — identical base
+    prompts still share."""
+    from paddlefleetx_tpu.core.paging import prompt_key
+
+    model, params = lora512_mp
+    source = _make_source(extract_adapter(params, 0))
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, EOS, 200).tolist()   # spans a full page
+    srv = GenerationServer(model, params, _greedy_cfg(max_dec=4),
+                           num_slots=2, adapter_source=source,
+                           page_size=128, prefill_chunk_pages=1)
+
+    def staggered_pair(aid):
+        """Admit a twin of ``prompt`` while the first copy is still
+        live (registrations only outlast prefill, not the request)."""
+        done = {}
+        ids = [srv.submit(prompt, adapter_id=aid)]
+        for _ in range(3):          # 2 prefill chunks + 1 decode tick
+            for c in srv.step():
+                done[c.request_id] = c
+        registered = srv._alloc.lookup_prompt(
+            prompt_key(prompt)) is not None
+        ids.append(srv.submit(prompt, adapter_id=aid))
+        while srv.pending or srv.occupancy:
+            for c in srv.step():
+                done[c.request_id] = c
+        return [done[i] for i in ids], registered
+
+    before = counters()
+    tinted, tinted_reg = staggered_pair(1)
+    mid = counters()
+    assert not tinted_reg               # never entered the registry
+    assert mid.get("serving/prefix_hits", 0) == \
+        before.get("serving/prefix_hits", 0)
+    base, base_reg = staggered_pair(0)
+    after = counters()
+    assert base_reg
+    assert after.get("serving/prefix_hits", 0) > \
+        mid.get("serving/prefix_hits", 0)
+    assert tinted[0].tokens == tinted[1].tokens
+    assert base[0].tokens == base[1].tokens
+    assert tinted[0].tokens != base[0].tokens
+    srv._alloc.check()
+
+
+# -- fleet: adapter-affinity routing -----------------------------------
+
+
+def test_fleet_routes_to_warm_adapter(lora_mp, adapter_source,
+                                      counters):
+    """The second request for an adapter routes to the replica already
+    holding it resident (counted fleet/routed_adapter), and tokens are
+    replica-independent."""
+    model, params = lora_mp
+    gen_cfg = _greedy_cfg()
+
+    def factory(name):
+        return GenerationServer(model, params, gen_cfg, num_slots=2,
+                                rng=jax.random.PRNGKey(7),
+                                adapter_source=adapter_source)
+
+    fleet = FleetRouter(factory, 2)
+    before = counters()
+    done = {}
+    first = fleet.submit(PROMPTS[0], adapter_id=1)
+    while fleet.busy:
+        for c in fleet.step():
+            done[c.request_id] = c
+    second = fleet.submit(PROMPTS[0], adapter_id=1)
+    third = fleet.submit(PROMPTS[1], adapter_id=0)   # base rides along
+    while fleet.busy:
+        for c in fleet.step():
+            done[c.request_id] = c
+    after = counters()
+    assert after.get("fleet/routed_adapter", 0) - \
+        before.get("fleet/routed_adapter", 0) >= 1
+    assert done[first].tokens == done[second].tokens
+    assert done[third].finish_reason in ("eos", "length")
+    fleet.close()
+
+
+def test_base_only_fleet_rejects_adapter_requests(base_mp):
+    """A fleet with no LoRA-capable replica has no candidates for an
+    adapter request — it sheds instead of serving the wrong weights."""
+    model, params = base_mp
+    gen_cfg = _greedy_cfg()
+
+    def factory(name):
+        return GenerationServer(model, params, gen_cfg, num_slots=2)
+
+    fleet = FleetRouter(factory, 2)
+    with pytest.raises(RequestShed):
+        fleet.submit(PROMPTS[0], adapter_id=1)
+    comps = fleet.run([PROMPTS[0]])       # base traffic unaffected
+    assert comps[0].finish_reason in ("eos", "length")
+    fleet.close()
+
+
+# -- engine: LoRA fine-tuning (frozen base, adapter-only state) --------
+
+
+def test_engine_lora_finetune_freezes_base(tmp_path):
+    """lora_rank in the Model config flips fit() to adapter-only
+    training: base leaves are bit-frozen, optimizer moments exist only
+    for the lora leaves (set_to_zero keeps no state for frozen)."""
+    from test_engine import _build
+
+    cfg, engine, loader = _build(tmp_path, **{
+        "Engine.max_steps": 3,
+        "Model.fuse_attn_qkv": True,
+        "Model.lora_rank": 4,
+        "Model.lora_num_adapters": 2,
+    })
+    flat = jax.tree_util.tree_flatten_with_path(
+        engine.state["params"])[0]
+    before = {jax.tree_util.keystr(p): np.asarray(v).copy()
+              for p, v in flat}
+    lora_bytes = sum(v.nbytes for k, v in before.items()
+                     if "_lora" in k)
+    assert lora_bytes > 0
+    engine.fit(epoch=1, train_data_loader=loader)
+    flat_after = jax.tree_util.tree_flatten_with_path(
+        engine.state["params"])[0]
+    changed_base, changed_lora = [], []
+    for p, v in flat_after:
+        key = jax.tree_util.keystr(p)
+        if np.array_equal(np.asarray(v), before[key]):
+            continue
+        (changed_lora if "_lora" in key else changed_base).append(key)
+    assert not changed_base, f"frozen base moved: {changed_base[:4]}"
+    assert changed_lora, "no adapter leaf trained"
+    opt_bytes = sum(
+        np.asarray(leaf).nbytes
+        for leaf in jax.tree_util.tree_leaves(engine.state["opt_state"])
+        if hasattr(leaf, "nbytes") or isinstance(leaf, (np.ndarray,)))
+    # Adam keeps two moments per trained leaf; frozen leaves keep none
+    assert opt_bytes <= 2 * lora_bytes + 4096, \
+        f"optimizer state {opt_bytes}B is not adapter-only " \
+        f"(lora {lora_bytes}B)"

@@ -944,7 +944,14 @@ def test_fleet_snapshot_lock_mutation_trips_gate():
     sources = {"paddlefleetx_tpu/core/fleet.py": flt,
                "paddlefleetx_tpu/core/serving.py": srv,
                "paddlefleetx_tpu/observability/server.py": obs}
-    assert run_rules(_ctx(sources), select={"PFX301"}) == []
+    # the adapter-insert params write carries a documented inline
+    # suppression in the real tree (docs/lora.md: its unlocked
+    # reader runs at __init__, before threads); run_rules reports raw
+    # findings, so mask that one key here
+    known = {"paddlefleetx_tpu.core.serving:GenerationServer.params"}
+    base = [f for f in run_rules(_ctx(sources), select={"PFX301"})
+            if f.key not in known]
+    assert base == []
     mutated = flt.replace("with self._health_lock:", "if True:")
     assert mutated != flt, "fleet.py lost its _health_lock guards?"
     sources["paddlefleetx_tpu/core/fleet.py"] = mutated
@@ -1002,19 +1009,23 @@ def test_cli_github_format_emits_error_annotations(tmp_path, capsys):
 
 
 def test_real_tree_suppression_counts_pinned():
-    """The only inline PFX301 suppression is the documented `enabled`
-    fast-path flag in observability/metrics.py; growth here means a
-    new unjustified disable crept in."""
+    """Exactly two documented inline PFX301 suppressions: the
+    `enabled` fast-path flag in observability/metrics.py and the
+    adapter-insert params write in core/serving.py (its unlocked
+    reader, _model_fingerprint, runs eagerly at __init__ before any
+    thread exists); growth here means a new unjustified disable crept
+    in."""
     res = run_lint(REPO)
     counts = res.suppression_counts()
-    assert counts.get("PFX301") == 1, counts
+    assert counts.get("PFX301") == 2, counts
     # and every suppressed thread finding lives where documented
     where = {f.path for f in res.suppressed if f.code == "PFX301"}
-    assert where == {"paddlefleetx_tpu/observability/metrics.py"}
+    assert where == {"paddlefleetx_tpu/observability/metrics.py",
+                     "paddlefleetx_tpu/core/serving.py"}
 
 
 def test_cli_stats_prints_per_rule_suppressions(capsys):
     from codestyle.pfxlint.__main__ import main
     assert main(["--root", REPO, "--stats"]) == 0
     err = capsys.readouterr().err
-    assert "pfxlint: suppressed[PFX301]=1" in err
+    assert "pfxlint: suppressed[PFX301]=2" in err

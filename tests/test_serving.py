@@ -1931,6 +1931,52 @@ def test_tiered_parity_matrix(paged512_model_and_params,
     assert ts["prefill_chunks"] < us["prefill_chunks"]
 
 
+def test_tiered_spill_rehydrate_batched_dispatch(
+        paged512_model_and_params, monkeypatch):
+    """Pinned dispatch-count contract: spilling N pages at a yield
+    point is ONE stacked ``gather_kv_pages`` dispatch and
+    rehydrating N pages at admission is ONE stacked
+    ``scatter_kv_pages`` dispatch — never a per-page device loop.
+    Counted by wrapping the entry points serving.py actually calls;
+    the totals must still reconcile with the spill/rehydrate
+    counters, so a batch can't hide dropped pages."""
+    import paddlefleetx_tpu.core.serving as serving_mod
+    model, params = paged512_model_and_params
+    gathers, scatters = [], []
+    real_gather = serving_mod.gather_kv_pages
+    real_scatter = serving_mod.scatter_kv_pages
+
+    def counting_gather(cache, pids):
+        gathers.append(int(pids.shape[0]))
+        return real_gather(cache, pids)
+
+    def counting_scatter(cache, data, pids):
+        scatters.append(int(pids.shape[0]))
+        return real_scatter(cache, data, pids)
+
+    monkeypatch.setattr(serving_mod, "gather_kv_pages",
+                        counting_gather)
+    monkeypatch.setattr(serving_mod, "scatter_kv_pages",
+                        counting_scatter)
+    # exact-repeat waves: wave 2 resubmits wave 1's prompts verbatim,
+    # so each admission is a whole-prompt registry hit that must
+    # rehydrate BOTH of the prompt's spilled pages at once
+    rng = np.random.default_rng(11)
+    wave = [rng.integers(0, EOS, n).tolist() for n in (260, 270, 280)]
+    waves = [wave, [list(p) for p in wave]]
+    _, ts = _serve_tiered_trace(model, params, _greedy_cfg(max_dec=4),
+                                waves, pool_pages=7,
+                                host_pool_bytes=1 << 20)
+    assert ts["spills"] >= 2 and ts["rehydrates"] >= 2
+    # every spilled/rehydrated page went through a counted dispatch
+    assert sum(gathers) == ts["spills"]
+    assert sum(scatters) == ts["rehydrates"]
+    # batching is real: strictly fewer dispatches than pages, and at
+    # least one dispatch moved several pages at once
+    assert len(gathers) < ts["spills"] and max(gathers) >= 2
+    assert len(scatters) < ts["rehydrates"] and max(scatters) >= 2
+
+
 def test_tiered_cow_divergent_write_splits_in_hbm(
         paged512_model_and_params):
     """COW across tiers: two requests admitting the SAME prompt off a
